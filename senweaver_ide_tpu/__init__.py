@@ -1,0 +1,31 @@
+"""senweaver_ide_tpu — TPU-native (JAX/XLA/Pallas/pjit) online-RL framework.
+
+A ground-up rebuild of the capabilities of senweaver/senweaver-ide's APO
+online-RL engine (reference: /root/reference, snapshot 2026-02-13):
+
+- ``traces``   — conversation-trace collection (8 span types, bounded store,
+                 WAL persistence); semantics of ``common/traceCollectorService.ts``.
+- ``rewards``  — jit-compiled, vmappable 9-dimension chatMode-adaptive reward
+                 head; semantics of ``traceCollectorService.ts:668-788``.
+- ``apo``      — effectiveness reports, 6 problem-pattern detectors, textual
+                 gradient + beam-search prompt optimization executed against a
+                 local TPU-hosted policy; semantics of ``common/apoService.ts``.
+- ``models``   — decoder-only policy LLMs (Qwen2/DeepSeek-coder families) as
+                 shard-annotated JAX pytrees.
+- ``ops``      — core TPU ops: attention (Pallas flash kernels + XLA fallback),
+                 RoPE, RMSNorm, sampling.
+- ``parallel`` — device mesh, named shardings, DP/FSDP/TP/SP/PP/EP layouts,
+                 ring attention over ICI.
+- ``training`` — GRPO trainer (group-relative advantages, PPO-clip objective)
+                 under pjit with Orbax checkpointing.
+- ``rollout``  — TPU sampler (sharded KV cache) + hermetic agent loop and tool
+                 sandbox reproducing ``browser/chatThreadService.ts`` semantics.
+- ``agents``   — declarative agent registry/scheduler (``common/agentService.ts``).
+- ``context``  — context engineering: priority window, compaction, message
+                 fitting (``common/smartContextManager.ts``).
+
+The reference defines the *semantics*; every compute and distributed component
+here is designed TPU-first, not ported.
+"""
+
+__version__ = "0.1.0"

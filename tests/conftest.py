@@ -1,0 +1,24 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the TPU build's analogue of the reference's mocked-service unit
+harness (SURVEY.md §4): multi-chip sharding paths are exercised on a
+CPU-simulated mesh so the suite runs anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    import numpy as np
+
+    return np.random.default_rng(0)

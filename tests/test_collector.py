@@ -1,0 +1,98 @@
+"""Collector semantics tests (ref common/traceCollectorService.ts)."""
+
+import os
+
+from senweaver_ide_tpu.traces import (MAX_SPANS_PER_TRACE, SpanType,
+                                      TraceCollector, TraceStore, export_data)
+
+
+def test_span_bound_enforced():
+    c = TraceCollector(max_spans_per_trace=5)
+    c.start_trace("t")
+    for i in range(10):
+        c.record_user_message("t", i, f"m{i}")
+    assert len(c.get_all_traces()[0].spans) == 5  # ref :275-277
+
+
+def test_trace_bound_keeps_newest():
+    c = TraceCollector(max_traces=3)
+    ids = [c.start_trace(f"t{i}") for i in range(6)]
+    kept = {t.id for t in c.get_all_traces()}
+    assert len(kept) == 3
+    assert set(ids[-3:]) <= kept  # newest survive (ref :339-349)
+
+
+def test_summary_aggregation():
+    c = TraceCollector()
+    c.start_trace("t", metadata={"chatMode": "agent"})
+    c.record_llm_call("t", 0, input_tokens=100, output_tokens=20)
+    c.record_llm_call("t", 1, input_tokens=50, output_tokens=10)
+    c.record_tool_call("t", 1, tool_name="read_file", tool_success=True,
+                       duration_ms=120.0)
+    c.record_tool_call("t", 1, tool_name="read_file", tool_success=False,
+                       duration_ms=80.0)
+    c.record_error("t", 1, "x" * 2000)
+    s = c.get_all_traces()[0].summary
+    assert s.total_llm_calls == 2
+    assert s.total_tokens == 180
+    assert s.total_tool_calls == 2
+    assert s.tool_calls_succeeded == 1 and s.tool_calls_failed == 1
+    assert s.tool_calls_by_name["read_file"].total == 2
+    assert s.total_tool_duration_ms == 200.0
+    assert s.has_errors
+    # error preview capped at 1000 + ellipsis (ref :563 truncate(·, 1000))
+    err_span = [sp for sp in c.get_all_traces()[0].spans
+                if sp.type is SpanType.ERROR][0]
+    assert len(err_span.data.error_message) == 1003
+
+
+def test_feedback_recomputes_reward_immediately():
+    c = TraceCollector()
+    c.start_trace("t")
+    c.record_llm_call("t", 0, input_tokens=10, output_tokens=10)
+    c.record_user_feedback("t", 0, "bad")
+    tr = c.get_all_traces()[0]
+    assert tr.summary.user_feedback == "bad"
+    assert tr.summary.final_reward is not None  # computed without end_trace
+    assert c.get_feedback("t", 0) == "bad"
+
+
+def test_store_roundtrip(tmp_path):
+    path = os.path.join(tmp_path, "traces.jsonl")
+    store = TraceStore(path)
+    c = TraceCollector(store=store)
+    c.start_trace("t", metadata={"chatMode": "agent"})
+    c.record_user_message("t", 0, "hello")
+    c.record_tool_call("t", 0, tool_name="ls_dir", tool_success=True,
+                       duration_ms=5.0)
+    c.end_trace_for_thread("t")
+    c.flush()
+
+    c2 = TraceCollector(store=store)
+    traces = c2.get_all_traces()
+    assert len(traces) == 1
+    tr = traces[0]
+    assert tr.thread_id == "t"
+    assert tr.metadata["chatMode"] == "agent"
+    assert tr.summary.total_tool_calls == 1
+    assert tr.summary.final_reward is not None
+    assert len(tr.spans) == 2
+
+
+def test_feedbacks_persist_across_reload(tmp_path):
+    path = os.path.join(tmp_path, "traces.jsonl")
+    c = TraceCollector(store=TraceStore(path))
+    c.start_trace("t")
+    c.record_user_feedback("t", 3, "good")
+    c.flush()
+    c2 = TraceCollector(store=TraceStore(path))
+    assert c2.get_feedback("t", 3) == "good"  # ref TRACE_FEEDBACK_KEY :354-357
+    assert c2.get_stats()["good_feedbacks"] == 1
+
+
+def test_export_data():
+    c = TraceCollector()
+    c.start_trace("t")
+    c.record_user_message("t", 0, "hi")
+    out = export_data(c)
+    assert '"traces"' in out and '"stats"' in out

@@ -1,0 +1,138 @@
+"""Golden tests: jit reward head vs pure-Python transcription of the TS
+semantics (``traceCollectorService.ts:668-788``).
+
+Strategy per SURVEY.md §7 "Hard parts / Reward parity": the TS head has
+conditionally-present dims and weight renormalization; these tests sweep
+hand-picked boundary fixtures plus randomized traces and require exact
+agreement (to float32) between the branchless head and the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.rewards import (DIM_NAMES, reward_head_batch,
+                                       score_trace, score_traces)
+from senweaver_ide_tpu.rewards.reference_impl import compute_reward_signals
+from senweaver_ide_tpu.traces import (SpanType, TraceCollector, make_trace,
+                                      batch_features)
+
+
+def _mk_trace(*, mode="normal", feedback=None, ended=True, errors=False,
+              tool_ok=0, tool_fail=0, tool_dur=0.0, llm_calls=0, tokens=0,
+              user_msgs=0, asst_msgs=0):
+    c = TraceCollector()
+    tid = "t"
+    c.start_trace(tid, metadata={"chatMode": mode})
+    for i in range(user_msgs):
+        c.record_user_message(tid, i, f"user {i}")
+    for i in range(asst_msgs):
+        c.record_assistant_message(tid, i, f"asst {i}")
+    for i in range(tool_ok):
+        c.record_tool_call(tid, 0, tool_name="read_file", tool_success=True,
+                           duration_ms=tool_dur / max(tool_ok + tool_fail, 1))
+    for i in range(tool_fail):
+        c.record_tool_call(tid, 0, tool_name="run_command", tool_success=False,
+                           duration_ms=tool_dur / max(tool_ok + tool_fail, 1))
+    for i in range(llm_calls):
+        c.record_llm_call(tid, 0, input_tokens=tokens // max(llm_calls, 1) // 2,
+                          output_tokens=tokens // max(llm_calls, 1)
+                          - tokens // max(llm_calls, 1) // 2)
+    if errors:
+        c.record_error(tid, 0, "boom")
+    if feedback:
+        c.record_user_feedback(tid, 0, feedback)
+    tr = c.get_all_traces()[0]
+    if ended:
+        c.end_trace(tr.id)
+    else:
+        tr.end_time = None
+    return tr
+
+
+def _check_parity(trace):
+    ref_dims, ref_final = compute_reward_signals(trace)
+    got_final = score_trace(trace)
+    got = {d["name"]: d["value"] for d in trace.summary.reward_dimensions}
+    want = {d["name"]: d["value"] for d in ref_dims}
+    assert set(got) == set(want), (set(got), set(want))
+    for name in want:
+        assert got[name] == pytest.approx(want[name], abs=1e-6), name
+    assert got_final == pytest.approx(ref_final, abs=1e-6)
+
+
+BOUNDARY_CASES = [
+    # (mode, feedback, ended, errors, ok, fail, dur_ms, llm, tokens, u, a)
+    ("normal", None, True, False, 0, 0, 0, 0, 0, 0, 0),      # minimal
+    ("normal", "good", True, False, 2, 0, 500, 1, 1500, 1, 1),
+    ("normal", "bad", True, True, 1, 3, 40000, 4, 12000, 5, 5),
+    ("agent", "good", True, False, 7, 1, 6000, 3, 4800, 2, 2),
+    ("agent", "bad", False, True, 10, 5, 200000, 9, 40000, 10, 10),
+    ("agent", None, True, False, 16, 0, 0, 3, 15000, 3, 3),  # ==good tokens edge
+    ("normal", None, True, False, 3, 1, 3000, 1, 2000, 2, 2),  # minor fail edge
+    ("normal", None, True, False, 10, 0, 10000, 2, 10000, 4, 4),  # fair edges
+    ("agent", None, True, False, 25, 0, 0, 0, 0, 9, 9),  # turns == 3*T edge
+    ("agent", None, True, False, 0, 25, 250001, 1, 30001, 10, 9),
+    ("normal", "good", False, True, 0, 0, 0, 0, 0, 1, 0),  # good overrides error
+]
+
+
+@pytest.mark.parametrize("case", BOUNDARY_CASES, ids=range(len(BOUNDARY_CASES)))
+def test_boundary_parity(case):
+    mode, fb, ended, errs, ok, fail, dur, llm, tok, u, a = case
+    tr = _mk_trace(mode=mode, feedback=fb, ended=ended, errors=errs,
+                   tool_ok=ok, tool_fail=fail, tool_dur=dur, llm_calls=llm,
+                   tokens=tok, user_msgs=u, asst_msgs=a)
+    _check_parity(tr)
+
+
+def test_randomized_parity(rng):
+    traces = []
+    for _ in range(200):
+        traces.append(_mk_trace(
+            mode=rng.choice(["normal", "agent"]),
+            feedback=rng.choice([None, "good", "bad"]),
+            ended=bool(rng.integers(0, 2)),
+            errors=bool(rng.integers(0, 2)),
+            tool_ok=int(rng.integers(0, 30)),
+            tool_fail=int(rng.integers(0, 8)),
+            tool_dur=float(rng.integers(0, 400000)),
+            llm_calls=int(rng.integers(0, 10)),
+            tokens=int(rng.integers(0, 40000)),
+            user_msgs=int(rng.integers(0, 12)),
+            asst_msgs=int(rng.integers(0, 12)),
+        ))
+    for tr in traces:
+        _check_parity(tr)
+
+
+def test_batch_matches_single(rng):
+    traces = [
+        _mk_trace(mode="agent", feedback="bad", tool_ok=5, tool_fail=2,
+                  tool_dur=9000, llm_calls=4, tokens=20000, user_msgs=4,
+                  asst_msgs=4),
+        _mk_trace(mode="normal", feedback="good", llm_calls=1, tokens=800,
+                  user_msgs=1, asst_msgs=1),
+    ]
+    singles = [score_trace(t) for t in traces]
+    batch = np.asarray(score_traces(traces))
+    np.testing.assert_allclose(batch, np.array(singles), atol=1e-6)
+    # batch head output shapes
+    out = reward_head_batch(batch_features(traces))
+    assert out.dims.shape == (2, 9) and out.mask.shape == (2, 9)
+    assert len(DIM_NAMES) == 9
+
+
+def test_collector_end_trace_computes_reward():
+    c = TraceCollector()
+    c.start_trace("x", metadata={"chatMode": "normal"})
+    c.record_user_message("x", 0, "hello")
+    c.record_assistant_message("x", 0, "hi")
+    c.record_llm_call("x", 0, input_tokens=100, output_tokens=50)
+    c.end_trace_for_thread("x")
+    tr = c.get_all_traces()[0]
+    assert tr.summary.final_reward is not None
+    assert tr.end_time is not None
+    names = {d["name"] for d in tr.summary.reward_dimensions}
+    assert "tool_success_rate" not in names  # no tool calls → dim absent
+    assert {"user_feedback", "task_completion", "response_efficiency",
+            "token_efficiency", "conversation_efficiency"} <= names
