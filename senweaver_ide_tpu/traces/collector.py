@@ -228,9 +228,10 @@ class TraceCollector:
     def get_stats(self) -> Dict[str, Any]:
         with self._lock:
             traces = list(self._traces.values())
+            feedbacks = list(self._feedbacks.values())
         total_spans = sum(len(t.spans) for t in traces)
-        good = sum(1 for f in self._feedbacks.values() if f == "good")
-        bad = sum(1 for f in self._feedbacks.values() if f == "bad")
+        good = sum(1 for f in feedbacks if f == "good")
+        bad = sum(1 for f in feedbacks if f == "bad")
         tool_calls = sum(t.summary.total_tool_calls for t in traces)
         tool_ok = sum(t.summary.tool_calls_succeeded for t in traces)
         tool_fail = sum(t.summary.tool_calls_failed for t in traces)

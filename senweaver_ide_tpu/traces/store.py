@@ -89,8 +89,9 @@ class TraceStore:
             f.write("\n")
 
     def clear(self) -> None:
-        if os.path.exists(self.path):
-            os.unlink(self.path)
+        for p in (self.path, self.feedbacks_path):
+            if os.path.exists(p):
+                os.unlink(p)
 
 
 def export_data(collector, version: str = "1.0.0") -> str:

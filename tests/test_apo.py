@@ -1,0 +1,213 @@
+"""APO subsystem tests: patterns, report, suggestions, rollouts, segments,
+gradient prompts, beam search (ref common/apoService.ts)."""
+
+import pytest
+
+from senweaver_ide_tpu.apo import (APOConfig, APOService, SegmentStore,
+                                   analyze_patterns, beam_search,
+                                   build_apply_edit_prompt, build_report,
+                                   build_textual_gradient_prompt,
+                                   corpus_score_fn, format_apo_rules_section,
+                                   make_six_pattern_corpus, parse_rules,
+                                   new_suggestion, traces_to_rollouts)
+from senweaver_ide_tpu.apo.types import PromptVersion
+from senweaver_ide_tpu.traces import TraceCollector
+
+
+def _corpus_collector(per_pattern=4, good=6):
+    from senweaver_ide_tpu.apo.synthetic import (generate_good_traces,
+                                                 generate_pattern_traces)
+    c = TraceCollector(max_traces=10_000)
+    for p in range(1, 7):
+        generate_pattern_traces(p, per_pattern, c)
+    generate_good_traces(good, c)
+    return c
+
+
+def test_six_patterns_all_detected():
+    traces = make_six_pattern_corpus(per_pattern=5)
+    patterns = analyze_patterns(traces)
+    descs = " | ".join(p.description for p in patterns)
+    assert "errors occur" in descs                      # P1
+    assert "Tool call failures" in descs               # P2
+    assert "high token consumption" in descs           # P3
+    assert "multiple LLM calls" in descs               # P4
+    assert "many turns" in descs                       # P5
+    assert "Slow tool execution" in descs              # P6
+    for p in patterns:
+        assert p.frequency >= 2
+        assert len(p.examples) <= 3
+
+
+def test_pattern_min_occurrence_gates():
+    from senweaver_ide_tpu.apo.synthetic import generate_pattern_traces
+    # no bad feedback at all → no patterns (ref :641 early return)
+    c = TraceCollector(max_traces=10_000)
+    from senweaver_ide_tpu.apo.synthetic import generate_good_traces
+    generate_good_traces(5, c)
+    assert analyze_patterns(c.get_all_traces()) == []
+    # exactly 1 error-trace is below P1's min of 2
+    c2 = TraceCollector(max_traces=10_000)
+    generate_pattern_traces(1, 1, c2)
+    descs = [p.description for p in analyze_patterns(c2.get_all_traces())]
+    assert not any("errors occur" in d for d in descs)
+    # 2 error-traces reach P1's gate with 'medium' severity (<5 occurrences)
+    c3 = TraceCollector(max_traces=10_000)
+    generate_pattern_traces(1, 2, c3)
+    p1 = [p for p in analyze_patterns(c3.get_all_traces())
+          if "errors occur" in p.description]
+    assert len(p1) == 1 and p1[0].severity == "medium" and p1[0].frequency == 2
+
+
+def test_report_good_rate_and_modes():
+    traces = make_six_pattern_corpus(per_pattern=4, good=6)
+    report = build_report(traces)
+    assert report.total_conversations == 30
+    assert report.bad_feedback_count == 24
+    assert report.good_feedback_count == 6
+    assert report.good_rate == pytest.approx(6 / 30)
+    assert report.by_mode["agent"].total == 30
+    assert report.by_mode["agent"].good_rate == pytest.approx(6 / 30)
+    assert report.avg_reward is not None
+    # goodRate<0.5 must produce the systemic high-priority suggestion (:784-797)
+    assert any("Overall approval rate" in s.description
+               for s in report.suggestions)
+    # pattern-driven high-severity suggestions exist
+    assert any(s.description.startswith("High-frequency issue")
+               for s in report.suggestions)
+
+
+def test_rollout_conversion():
+    traces = make_six_pattern_corpus(per_pattern=2, good=1)
+    rollouts = traces_to_rollouts(traces)
+    assert len(rollouts) == len(traces)
+    r_bad = next(r for r in rollouts if r.status == "failed")
+    assert r_bad.final_reward is not None
+    assert r_bad.chat_mode == "agent"
+    r_good = next(r for r in rollouts if r.status == "succeeded")
+    assert r_good.tool_call_stats["succeeded"] == 1
+    roles = {m.role for r in rollouts for m in r.messages}
+    assert roles >= {"user", "assistant"}
+
+
+def test_gradient_prompt_contents():
+    traces = make_six_pattern_corpus(per_pattern=2, good=1)
+    rollouts = traces_to_rollouts(traces[:4])
+    p = build_textual_gradient_prompt(["Always run tests"], rollouts)
+    assert "Always run tests" in p
+    assert "--- Experiment 1 ---" in p
+    assert "Final Reward:" in p
+    assert "Less than 350 words" in p
+    e = build_apply_edit_prompt([], "too many tool calls")
+    assert "(No optimized prompt rules currently active)" in e
+    assert "too many tool calls" in e
+    assert 'starting with "- "' in e
+
+
+def test_parse_rules():
+    text = "- rule one\nnot a rule\n- rule two\n-    \n"
+    assert parse_rules(text) == ["rule one", "rule two"]
+
+
+def test_segment_lifecycle_apply_revert():
+    store = SegmentStore()
+    sug = new_suggestion(target_category="tool_usage", type="add",
+                         priority="high", description="d", reasoning="r",
+                         estimated_impact="i",
+                         suggested_content="Verify tool output before retrying")
+    store.add_suggestions([sug])
+    assert store.apply_suggestion(sug.id)
+    assert store.get_optimized_rules() == ["Verify tool output before retrying"]
+    assert not store.apply_suggestion(sug.id)  # already applied
+    assert store.revert_suggestion(sug.id)
+    assert store.get_optimized_rules() == []
+    assert sug.status == "reverted"
+
+
+def test_segment_modify_rollback():
+    store = SegmentStore()
+    from senweaver_ide_tpu.apo.types import PromptSegment
+    store.segments.append(PromptSegment(id="s1", category="core_behavior",
+                                        content="old rule"))
+    sug = new_suggestion(target_category="core_behavior", type="modify",
+                         priority="high", description="d", reasoning="r",
+                         estimated_impact="i", suggested_content="new rule",
+                         target_segment_id="s1")
+    store.add_suggestions([sug])
+    store.apply_suggestion(sug.id)
+    seg = store.segments[0]
+    assert seg.content == "new rule" and seg.version == 2 and seg.is_optimized
+    store.revert_suggestion(sug.id)
+    assert seg.content == "old rule" and not seg.is_optimized
+
+
+def test_beam_best_prompt_split_into_segments():
+    store = SegmentStore()
+    best = PromptVersion(version="v3",
+                         content="- rule A\n- rule B\nLoose text")
+    store.apply_beam_best_prompt(best)
+    assert sorted(store.get_optimized_rules()) == ["rule A", "rule B"]
+    store.apply_beam_best_prompt(best)  # dedup: no duplicates on re-apply
+    assert len(store.get_optimized_rules()) == 2
+
+
+def test_beam_search_improves_or_keeps_best():
+    c = _corpus_collector(per_pattern=2, good=2)
+    traces = c.get_all_traces()
+    rollouts = traces_to_rollouts(traces[:4])
+    # Deterministic fake policy: always proposes the same improved rules.
+    def fake_llm(prompt: str) -> str:
+        if prompt.startswith("Revise the given prompt rules"):  # apply-edit
+            return "- Cap tool calls at 8 per task\n- Verify failures once then ask"
+        return "- reduce redundant tool calls"  # critique
+    # Scorer that rewards prompts containing 'Verify'
+    def score(rules):
+        return float(sum("Verify" in r for r in rules))
+    cfg = APOConfig(beam_rounds=2, beam_width=2, branch_factor=2)
+    st = beam_search("- be concise", rollouts, fake_llm, score, cfg)
+    assert st.history_best_prompt is not None
+    assert st.history_best_score >= 1.0  # found the 'Verify' rule
+    assert st.current_round == 2
+    assert len(st.beam) <= 2
+
+
+def test_apo_service_gates_and_flow():
+    c = _corpus_collector(per_pattern=4, good=6)  # 30 traces, 30 feedbacks
+    svc = APOService(c, generate_fn=lambda p: "- always verify edits",
+                     config=APOConfig(auto_analyze_interval_ms=0))
+    assert svc.should_auto_analyze()
+    report = svc.maybe_auto_analyze()
+    assert report is not None
+    # goodRate 0.2 < 0.7 with 30 feedbacks → gradient triggered
+    assert svc.should_auto_gradient()
+    assert len(svc.textual_gradients) == 1
+    tg = svc.textual_gradients[0]
+    assert "rollouts" in tg.rollout_summary
+    # gradient produced a pending suggestion with the edited prompt
+    pend = svc.segments.get_pending_suggestions()
+    assert any(s.suggested_content for s in pend)
+    stats = svc.get_stats()
+    assert stats["total_reports"] == 1
+    assert stats["current_good_rate"] == pytest.approx(0.2)
+
+
+def test_apo_service_gates_block_small_corpora():
+    c = _corpus_collector(per_pattern=1, good=1)  # 7 traces < 20
+    svc = APOService(c, config=APOConfig(auto_analyze_interval_ms=0))
+    assert not svc.should_auto_analyze()
+    assert svc.maybe_auto_analyze() is None
+
+
+def test_rules_injection_budget():
+    rules = [f"rule {i} " + "x" * 100 for i in range(40)]
+    section = format_apo_rules_section(rules)
+    assert len(section) <= 2000
+    assert section.startswith("# APO Optimized Rules")
+    assert format_apo_rules_section([]) == ""
+
+
+def test_corpus_score_fn_runs_on_device():
+    traces = make_six_pattern_corpus(per_pattern=2, good=2)
+    score = corpus_score_fn(traces)
+    v = score(["any rules"])
+    assert -1.0 <= v <= 1.0
