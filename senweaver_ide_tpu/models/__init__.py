@@ -1,0 +1,6 @@
+from .config import (ModelConfig, PRESETS, get_config, qwen2_5_coder_0_5b,
+                     qwen2_5_coder_1_5b, qwen2_5_coder_7b, deepseek_coder_1_3b,
+                     deepseek_coder_6_7b, tiny_test)
+from .transformer import (KVCache, Params, count_params, forward,
+                          init_kv_cache, init_params)
+from .tokenizer import ByteTokenizer, HFTokenizer, load_tokenizer

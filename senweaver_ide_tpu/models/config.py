@@ -1,0 +1,113 @@
+"""Model configurations for the policy LLM families.
+
+The reference targets remote/provider-hosted models (capability DB in
+``common/modelCapabilities.ts``); the north star pins the local policy ladder
+Qwen2.5-Coder-1.5B → DeepSeek-Coder-7B (BASELINE.json configs 3-5). Both
+families are decoder-only pre-norm transformers with RoPE + SwiGLU; Qwen2 uses
+GQA + QKV biases, DeepSeek-Coder is LLaMA-architecture (MHA at 1.3B/6.7B,
+no attention biases).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    max_seq_len: int
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False
+    dtype: jnp.dtype = jnp.bfloat16
+    # Sliding-window attention width (None = full causal).
+    sliding_window: Optional[int] = None
+    # jax.default_matmul_precision for the forward pass. None = platform
+    # default (bf16 MXU passes — the fast path for real models). The fp32
+    # test config pins "highest" so cache-vs-full decode parity is exact.
+    matmul_precision: Optional[str] = None
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+def qwen2_5_coder_0_5b() -> ModelConfig:
+    return ModelConfig(
+        name="qwen2.5-coder-0.5b", vocab_size=151_936, hidden_size=896,
+        intermediate_size=4864, num_layers=24, num_heads=14, num_kv_heads=2,
+        head_dim=64, max_seq_len=32_768, rope_theta=1_000_000.0,
+        tie_word_embeddings=True, qkv_bias=True)
+
+
+def qwen2_5_coder_1_5b() -> ModelConfig:
+    """The flagship bench model (BASELINE config 3)."""
+    return ModelConfig(
+        name="qwen2.5-coder-1.5b", vocab_size=151_936, hidden_size=1536,
+        intermediate_size=8960, num_layers=28, num_heads=12, num_kv_heads=2,
+        head_dim=128, max_seq_len=32_768, rope_theta=1_000_000.0,
+        tie_word_embeddings=True, qkv_bias=True)
+
+
+def qwen2_5_coder_7b() -> ModelConfig:
+    return ModelConfig(
+        name="qwen2.5-coder-7b", vocab_size=152_064, hidden_size=3584,
+        intermediate_size=18_944, num_layers=28, num_heads=28, num_kv_heads=4,
+        head_dim=128, max_seq_len=131_072, rope_theta=1_000_000.0,
+        qkv_bias=True)
+
+
+def deepseek_coder_1_3b() -> ModelConfig:
+    return ModelConfig(
+        name="deepseek-coder-1.3b", vocab_size=32_256, hidden_size=2048,
+        intermediate_size=5504, num_layers=24, num_heads=16, num_kv_heads=16,
+        head_dim=128, max_seq_len=16_384, rope_theta=100_000.0)
+
+
+def deepseek_coder_6_7b() -> ModelConfig:
+    """The GRPO target (BASELINE config 4)."""
+    return ModelConfig(
+        name="deepseek-coder-6.7b", vocab_size=32_256, hidden_size=4096,
+        intermediate_size=11_008, num_layers=32, num_heads=32, num_kv_heads=32,
+        head_dim=128, max_seq_len=16_384, rope_theta=100_000.0)
+
+
+def tiny_test() -> ModelConfig:
+    """Small config for unit tests and CPU-mesh dry runs."""
+    return ModelConfig(
+        name="tiny-test", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, qkv_bias=True,
+        dtype=jnp.float32, matmul_precision="highest")
+
+
+PRESETS = {
+    "qwen2.5-coder-0.5b": qwen2_5_coder_0_5b,
+    "qwen2.5-coder-1.5b": qwen2_5_coder_1_5b,
+    "qwen2.5-coder-7b": qwen2_5_coder_7b,
+    "deepseek-coder-1.3b": deepseek_coder_1_3b,
+    "deepseek-coder-6.7b": deepseek_coder_6_7b,
+    "tiny-test": tiny_test,
+}
+
+
+def get_config(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model {name!r}; available: {sorted(PRESETS)}")
+    return PRESETS[name]()

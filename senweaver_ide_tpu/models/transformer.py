@@ -1,0 +1,207 @@
+"""Decoder-only transformer — functional JAX, layer-stacked, scan-compiled.
+
+TPU-first design decisions (vs a PyTorch-style module port):
+- Params are a plain pytree of layer-STACKED arrays (leading axis L) and the
+  forward pass is one ``lax.scan`` over layers: the layer body is traced once,
+  giving O(1) compile time in depth and a natural pipeline-parallel axis.
+- All matmuls are einsums in bf16 with fp32 softmax/norm accumulation — the
+  shapes XLA tiles directly onto the MXU.
+- KV cache is a pre-allocated (L, B, Smax, Hkv, Dh) pair updated with
+  ``dynamic_update_slice`` — static shapes, no reallocation during decode.
+- Sharding lives entirely in ``parallel/sharding.py`` PartitionSpecs; the
+  model code is sharding-agnostic (GSPMD propagates).
+
+Architectures covered: Qwen2.5-Coder (GQA + QKV bias, tied embeddings at
+0.5B/1.5B) and DeepSeek-Coder/LLaMA (MHA, untied) — see models/config.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import attention
+from ..ops.norms import rms_norm
+from ..ops.rotary import apply_rope, rope_cos_sin
+from .config import ModelConfig
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # (L, B, Smax, Hkv, Dh)
+    v: jax.Array  # (L, B, Smax, Hkv, Dh)
+    length: jax.Array  # () int32 — tokens currently in cache
+
+
+def init_kv_cache(config: ModelConfig, batch: int, max_len: int,
+                  dtype=None) -> KVCache:
+    dtype = dtype or config.dtype
+    shape = (config.num_layers, batch, max_len, config.num_kv_heads,
+             config.head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   length=jnp.zeros((), jnp.int32))
+
+
+def init_params(config: ModelConfig, key: jax.Array) -> Params:
+    """Random init (normal / sqrt(fan_in)); layer params stacked on axis 0."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / jnp.sqrt(jnp.asarray(fan_in, jnp.float32))
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+    L, D, F = c.num_layers, c.hidden_size, c.intermediate_size
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": jnp.ones((L, D), c.dtype),
+        "wq": dense(ks[0], (L, D, c.q_dim), D),
+        "wk": dense(ks[1], (L, D, c.kv_dim), D),
+        "wv": dense(ks[2], (L, D, c.kv_dim), D),
+        "wo": dense(ks[3], (L, c.q_dim, D), c.q_dim),
+        "mlp_norm": jnp.ones((L, D), c.dtype),
+        "w_gate": dense(ks[4], (L, D, F), D),
+        "w_up": dense(ks[5], (L, D, F), D),
+        "w_down": dense(ks[6], (L, F, D), F),
+    }
+    if c.qkv_bias:
+        layers["bq"] = jnp.zeros((L, c.q_dim), c.dtype)
+        layers["bk"] = jnp.zeros((L, c.kv_dim), c.dtype)
+        layers["bv"] = jnp.zeros((L, c.kv_dim), c.dtype)
+
+    params: Params = {
+        "embed": (jax.random.normal(k_embed, (c.vocab_size, D), jnp.float32)
+                  * 0.02).astype(c.dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), c.dtype),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = dense(k_head, (D, c.vocab_size), D)
+    return params
+
+
+def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
+         cos: jax.Array, sin: jax.Array):
+    """Project + rotate. h: (B, S, D) → q (B,S,Hq,Dh), k/v (B,S,Hkv,Dh)."""
+    b, s, _ = h.shape
+    q = jnp.einsum("bsd,de->bse", h, lp["wq"])
+    k = jnp.einsum("bsd,de->bse", h, lp["wk"])
+    v = jnp.einsum("bsd,de->bse", h, lp["wv"])
+    if c.qkv_bias:
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = apply_rope(q.reshape(b, s, c.num_heads, c.head_dim), cos, sin)
+    k = apply_rope(k.reshape(b, s, c.num_kv_heads, c.head_dim), cos, sin)
+    v = v.reshape(b, s, c.num_kv_heads, c.head_dim)
+    return q, k, v
+
+
+def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
+           cos: jax.Array, sin: jax.Array,
+           cache_kv: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
+           kv_mask) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One transformer block. x: (B, S, D).
+
+    Without cache_kv: full self-attention over the block's own k/v.
+    With cache_kv=(k_cache, v_cache, length): writes new k/v at ``length``,
+    attends over the whole cache. Returns (x', (k_cache', v_cache')) — in the
+    no-cache case the returned pair is the block's own (k, v).
+    """
+    b, s, _ = x.shape
+    h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+    q, k, v = _qkv(c, lp, h, cos, sin)
+
+    if cache_kv is not None:
+        k_cache, v_cache, length = cache_kv
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+        out = attention(q, k_cache, v_cache, q_offset=length, kv_mask=kv_mask,
+                        causal=True)
+        kv_out = (k_cache, v_cache)
+    else:
+        out = attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True)
+        kv_out = (k, v)
+
+    x = x + jnp.einsum("bse,ed->bsd", out.reshape(b, s, c.q_dim), lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
+    return x + jnp.einsum("bsf,fd->bsd", act, lp["w_down"]), kv_out
+
+
+def forward(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,                 # (B, S) int32
+    *,
+    cache: Optional[KVCache] = None,
+    positions: Optional[jax.Array] = None,   # (B, S) absolute positions
+    attn_mask: Optional[jax.Array] = None,   # (B, S_kv) True = valid
+) -> Tuple[jax.Array, Optional[KVCache]]:
+    """Run the model. Without cache: full causal self-attention over ``tokens``.
+    With cache: ``tokens`` are appended at ``cache.length`` and attend to
+    everything up to that point (prefill and decode use the same path).
+
+    Returns (logits (B, S, V) fp32, updated cache or None).
+    """
+    c = config
+    if c.matmul_precision is not None:
+        with jax.default_matmul_precision(c.matmul_precision):
+            return _forward_impl(params, c, tokens, cache=cache,
+                                 positions=positions, attn_mask=attn_mask)
+    return _forward_impl(params, c, tokens, cache=cache, positions=positions,
+                         attn_mask=attn_mask)
+
+
+def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
+    b, s = tokens.shape
+    x = params["embed"][tokens]  # gather; sharded vocab → XLA collective
+
+    if positions is None:
+        base = cache.length if cache is not None else 0
+        positions = base + jnp.arange(s, dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, (b, s))
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+
+    if cache is None:
+        def body(x, lp):
+            x, _ = _layer(c, lp, x, cos, sin, None, attn_mask)
+            return x, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        new_cache = None
+    else:
+        max_len = cache.k.shape[2]
+        # kv validity: only slots < length + s are real.
+        kv_pos = jnp.arange(max_len)[None, :]
+        valid = kv_pos < (cache.length + s)
+        if attn_mask is not None:
+            valid = valid & attn_mask
+
+        def body(x, inputs):
+            lp, k_cache, v_cache = inputs
+            x, (k_cache, v_cache) = _layer(
+                c, lp, x, cos, sin, (k_cache, v_cache, cache.length), valid)
+            return x, (k_cache, v_cache)
+
+        x, (k_upd, v_upd) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v))
+        new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:  # tied embeddings
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return logits.astype(jnp.float32), new_cache
+
+
+def count_params(params: Params) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,68 @@
+"""Attention ops: GQA causal attention with fp32 softmax.
+
+The XLA path below is the reference implementation — einsum-formulated so XLA
+tiles the two matmuls onto the MXU and fuses mask+softmax between them. The
+Pallas flash-attention kernel (``ops/flash_attention.py``) replaces it for
+long sequences; both share this call signature.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: -inf breaks softmax rows that are fully masked
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, Hkv, D) → (B, S, Hkv*n_rep, D) for GQA."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset) -> jnp.ndarray:
+    """(q_len, kv_len) boolean mask; True = attend. ``q_offset`` is the
+    absolute position of the first query (for decode steps), traced or static."""
+    q_pos = q_offset + jnp.arange(q_len)[:, None]
+    k_pos = jnp.arange(kv_len)[None, :]
+    return k_pos <= q_pos
+
+
+def attention(
+    q: jnp.ndarray,            # (B, Sq, Hq, D)
+    k: jnp.ndarray,            # (B, Skv, Hkv, D)
+    v: jnp.ndarray,            # (B, Skv, Hkv, D)
+    *,
+    q_offset=0,
+    kv_mask: Optional[jnp.ndarray] = None,   # (B, Skv) True = valid
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Grouped-query causal attention. Returns (B, Sq, Hq, D)."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    k = repeat_kv(k, hq // hkv)
+    v = repeat_kv(v, hq // hkv)
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    # (B, H, Sq, Skv) scores in fp32. precision=HIGHEST: the default matmul
+    # precision truncates fp32 operands to bf16 on TPU, which breaks
+    # cache-vs-full decode parity; softmax inputs must be true fp32.
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32),
+                        precision=jax.lax.Precision.HIGHEST) * scale
+
+    if causal:
+        mask = causal_mask(sq, k.shape[1], q_offset)[None, None, :, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    if kv_mask is not None:
+        scores = jnp.where(kv_mask[:, None, None, :], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32),
+                     precision=jax.lax.Precision.HIGHEST)
+    return out.astype(q.dtype)

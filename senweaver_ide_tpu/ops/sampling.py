@@ -1,0 +1,59 @@
+"""Token sampling: temperature, top-k, top-p — jit/vmap-friendly.
+
+All transforms are static-shape (top-p uses a sorted-cumsum mask rather than
+dynamic truncation) so they compile once and run inside decode loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def apply_temperature(logits: jnp.ndarray, temperature: float) -> jnp.ndarray:
+    return logits / jnp.maximum(temperature, 1e-6)
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mask all but the k highest logits (static k)."""
+    if k <= 0:
+        return logits
+    kth = jnp.sort(logits, axis=-1)[..., -k][..., None]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    """Nucleus sampling mask: keep the smallest set of tokens with cumulative
+    probability ≥ p."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep tokens while the cumulative mass *before* them is < p.
+    keep_sorted = (cum - sorted_probs) < p
+    # Threshold = smallest kept logit.
+    kth = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                  axis=-1, keepdims=True)
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def sample_token(
+    logits: jnp.ndarray,           # (..., vocab)
+    key: jax.Array,
+    *,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jnp.ndarray:
+    """Sample token ids from logits. temperature==0 → greedy argmax."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    x = apply_temperature(logits, temperature)
+    if top_k > 0:
+        x = apply_top_k(x, top_k)
+    if top_p < 1.0:
+        x = apply_top_p(x, top_p)
+    return jax.random.categorical(key, x, axis=-1)

@@ -1,0 +1,4 @@
+from .mesh import (AXES, MeshConfig, data_sharding, make_mesh, replicated,
+                   single_device_mesh)
+from .sharding import (ACT_SPEC, KV_CACHE_SPEC, LOGITS_SPEC, PARAM_SPECS,
+                       param_shardings, param_specs, shard_params)

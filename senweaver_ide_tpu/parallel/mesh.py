@@ -1,0 +1,72 @@
+"""Device mesh construction — the framework's communication backend.
+
+The reference has NO distributed compute (SURVEY.md §2.7): its only
+"parallelism" is Electron IPC + concurrent HTTPS calls. Every axis here is
+designed TPU-first: collectives are lowered by XLA onto ICI within a slice
+(and DCN across slices via ``jax.distributed``), not hand-written NCCL.
+
+Canonical axes:
+- ``dp``   — data parallel (trajectory batches; gradient all-reduce)
+- ``fsdp`` — parameter/optimizer sharding axis (ZeRO-style; also acts as a
+             second data axis for activations)
+- ``tp``   — tensor parallel (Megatron column/row sharding over ICI)
+- ``sp``   — sequence/context parallel (ring attention, Ulysses all-to-all)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a 4-axis mesh. Defaults to all devices on the fsdp axis.
+
+    Axis order is (dp, fsdp, tp, sp), outermost-first — ICI neighbor locality
+    goes to the innermost axes (tp, sp), which host the most
+    latency-sensitive collectives (all-reduce inside matmuls, ring permutes).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        config = MeshConfig(fsdp=len(devices))
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.num_devices} devices, "
+            f"got {len(devices)}")
+    arr = np.asarray(devices).reshape(config.axis_sizes())
+    return Mesh(arr, AXES)
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch-major input sharding: batch over (dp, fsdp), sequence over sp."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,83 @@
+"""Parameter and activation sharding rules (Megatron/FSDP layout).
+
+One place defines how every param in the transformer pytree maps onto the
+(dp, fsdp, tp, sp) mesh:
+
+- column-parallel projections (wq/wk/wv, w_gate/w_up): output dim on ``tp``,
+  input dim on ``fsdp``
+- row-parallel projections (wo, w_down): input dim on ``tp``, output dim on
+  ``fsdp`` (XLA inserts the tp all-reduce after the matmul)
+- embedding: vocab on ``tp``, hidden on ``fsdp``; lm_head hidden on ``fsdp``,
+  vocab on ``tp``
+- norms: replicated
+- the leading layer axis of scanned params is unsharded (reserved for
+  pipeline stages later)
+
+This is ZeRO-3-style: fsdp-sharded params are all-gathered per layer by XLA
+during the scan, and gradients reduce-scattered back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# PartitionSpecs per param-tree path (leading axis of layer-stacked params
+# is the scan/pipeline axis).
+PARAM_SPECS: Dict[str, P] = {
+    "embed": P("tp", "fsdp"),
+    "final_norm": P(None),
+    "lm_head": P("fsdp", "tp"),
+    "layers/attn_norm": P(None, None),
+    "layers/wq": P(None, "fsdp", "tp"),
+    "layers/wk": P(None, "fsdp", "tp"),
+    "layers/wv": P(None, "fsdp", "tp"),
+    "layers/bq": P(None, "tp"),
+    "layers/bk": P(None, "tp"),
+    "layers/bv": P(None, "tp"),
+    "layers/wo": P(None, "tp", "fsdp"),
+    "layers/mlp_norm": P(None, None),
+    "layers/w_gate": P(None, "fsdp", "tp"),
+    "layers/w_up": P(None, "fsdp", "tp"),
+    "layers/w_down": P(None, "tp", "fsdp"),
+}
+
+# Activation specs.
+ACT_SPEC = P(("dp", "fsdp"), "sp", None)          # (B, S, D)
+LOGITS_SPEC = P(("dp", "fsdp"), "sp", "tp")       # (B, S, V)
+# KV cache (L, B, S, Hkv, D): batch on data axes, heads on tp.
+KV_CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
+
+
+def spec_for_path(path: str) -> P:
+    if path in PARAM_SPECS:
+        return PARAM_SPECS[path]
+    raise KeyError(f"no sharding rule for param path {path!r}")
+
+
+def param_specs(params: Any) -> Any:
+    """Pytree of PartitionSpecs matching a transformer param tree."""
+    def walk(tree: Any, prefix: str) -> Any:
+        if isinstance(tree, dict):
+            return {k: walk(v, f"{prefix}/{k}" if prefix else k)
+                    for k, v in tree.items()}
+        return spec_for_path(prefix)
+
+    return walk(params, "")
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Place a param pytree onto the mesh per PARAM_SPECS."""
+    specs = param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def param_shardings(params: Any, mesh: Mesh) -> Any:
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+    specs = param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))

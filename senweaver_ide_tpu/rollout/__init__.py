@@ -1,0 +1,2 @@
+from .sampler import (SampleParams, decode_step, generate, generate_scan,
+                      prefill)

@@ -1,0 +1,130 @@
+"""TPU sampler: prefill + KV-cache autoregressive decode.
+
+Replaces the reference's remote-API streaming path
+(``electron-main/llmMessage/sendLLMMessage.impl.ts``) for local policy
+rollouts. Two decode drivers share the same jitted step:
+
+- :func:`generate` — host loop calling the jitted step; supports per-sequence
+  early stop and streaming callbacks (the agent loop uses this).
+- :func:`generate_scan` — fully device-resident ``lax.scan`` decode for
+  benchmarking and batch rollouts (no host roundtrip per token).
+
+The KV cache is static-shape and sharded per
+``parallel.sharding.KV_CACHE_SPEC``; continuous batching slots in by treating
+the batch axis as a slot pool (see rollout/engine.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.transformer import KVCache, Params, forward, init_kv_cache
+from ..ops.sampling import sample_token
+
+
+class SampleParams(NamedTuple):
+    temperature: float = 0.8
+    top_k: int = 0
+    top_p: float = 0.95
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def prefill(params: Params, config: ModelConfig, tokens: jax.Array,
+            cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """Run the prompt through the model; returns (last-token logits, cache)."""
+    logits, cache = forward(params, config, tokens, cache=cache)
+    return logits[:, -1, :], cache
+
+
+@functools.partial(jax.jit, static_argnames=("config", "sample"))
+def decode_step(params: Params, config: ModelConfig, token: jax.Array,
+                cache: KVCache, key: jax.Array,
+                sample: SampleParams) -> Tuple[jax.Array, jax.Array, KVCache]:
+    """One decode step. token: (B, 1). Returns (next_token (B,), logits, cache)."""
+    logits, cache = forward(params, config, token, cache=cache)
+    logits = logits[:, -1, :]
+    next_tok = sample_token(logits, key, temperature=sample.temperature,
+                            top_k=sample.top_k, top_p=sample.top_p)
+    return next_tok, logits, cache
+
+
+def generate(
+    params: Params,
+    config: ModelConfig,
+    prompt: jax.Array,              # (B, S) int32
+    *,
+    max_new_tokens: int = 128,
+    eos_id: Optional[int] = None,
+    sample: SampleParams = SampleParams(),
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+    on_token: Optional[Callable[[int, jax.Array], None]] = None,
+) -> jax.Array:
+    """Host-driven generation with early stop. Returns (B, ≤max_new_tokens)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    b, s = prompt.shape
+    max_len = max_len or min(config.max_seq_len, s + max_new_tokens)
+    cache = init_kv_cache(config, b, max_len)
+    logits, cache = prefill(params, config, prompt, cache)
+
+    tok = sample_token(logits, key, temperature=sample.temperature,
+                       top_k=sample.top_k, top_p=sample.top_p)
+    out = [tok]
+    done = (tok == eos_id) if eos_id is not None else jnp.zeros((b,), bool)
+    for i in range(1, max_new_tokens):
+        if bool(jnp.all(done)):
+            break
+        key, step_key = jax.random.split(key)
+        tok, _, cache = decode_step(params, config, tok[:, None], cache,
+                                    step_key, sample)
+        if eos_id is not None:
+            tok = jnp.where(done, eos_id, tok)
+            done = done | (tok == eos_id)
+        out.append(tok)
+        if on_token is not None:
+            on_token(i, tok)
+    return jnp.stack(out, axis=1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "max_new_tokens", "sample",
+                                    "eos_id"))
+def generate_scan(
+    params: Params,
+    config: ModelConfig,
+    prompt: jax.Array,
+    cache: KVCache,
+    key: jax.Array,
+    *,
+    max_new_tokens: int = 128,
+    sample: SampleParams = SampleParams(),
+    eos_id: int = -1,
+) -> Tuple[jax.Array, KVCache]:
+    """Fully-jitted decode: prefill + scan over max_new_tokens steps.
+
+    Device-resident; the benchmark path. eos handling keeps shapes static by
+    overwriting post-eos tokens with eos_id.
+    """
+    logits, cache = forward(params, config, prompt, cache=cache)
+    tok0 = sample_token(logits[:, -1, :], key,
+                        temperature=sample.temperature,
+                        top_k=sample.top_k, top_p=sample.top_p)
+    b = prompt.shape[0]
+    done0 = tok0 == eos_id
+
+    def body(carry, step_key):
+        tok, cache, done = carry
+        next_tok, _, cache = decode_step(params, config, tok[:, None], cache,
+                                         step_key, sample)
+        next_tok = jnp.where(done, eos_id, next_tok)
+        done = done | (next_tok == eos_id)
+        return (next_tok, cache, done), next_tok
+
+    keys = jax.random.split(key, max_new_tokens - 1)
+    (_, cache, _), toks = jax.lax.scan(body, (tok0, cache, done0), keys)
+    return jnp.concatenate([tok0[:, None], toks.T], axis=1), cache

@@ -1,0 +1,99 @@
+"""GRPO: group-relative policy optimization for the local policy LLM.
+
+The reference's "optimization step" is a black-box prompt edit shipped to a
+backend (``apoService.ts`` textual gradient / beam search). The TPU build
+upgrades it to weight updates (BASELINE north star): finalReward from the jit
+reward head → group-relative advantages over response groups per prompt (no
+critic) → PPO-style clipped token-level objective, gradients all-reduced over
+ICI by XLA (mesh dp/fsdp axes).
+
+Design notes from the GRPO literature (PAPERS.md, "Policy Gradient
+Foundations of GRPO"): group mean-centering is the unbiased part; dividing by
+the group std reweights sparse-reward groups and can collapse ranks when a
+group's rewards tie — so std normalization is optional
+(``normalize_std=False`` keeps plain centered advantages), and a minimum-std
+floor guards the division.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class GRPOConfig(NamedTuple):
+    clip_eps: float = 0.2
+    kl_coef: float = 0.04        # KL penalty vs the reference (frozen) policy
+    entropy_coef: float = 0.0
+    normalize_std: bool = True
+    min_group_std: float = 1e-4
+
+
+def group_relative_advantages(
+    rewards: jax.Array,          # (B,) finalReward per trajectory
+    group_ids: jax.Array,        # (B,) int32 — trajectories with the same id
+                                 # were sampled from the same prompt
+    num_groups: int,
+    *,
+    normalize_std: bool = True,
+    min_std: float = 1e-4,
+) -> jax.Array:
+    """Center (and optionally scale) rewards within each prompt group."""
+    ones = jnp.ones_like(rewards)
+    counts = jax.ops.segment_sum(ones, group_ids, num_segments=num_groups)
+    counts = jnp.maximum(counts, 1.0)
+    sums = jax.ops.segment_sum(rewards, group_ids, num_segments=num_groups)
+    means = sums / counts
+    centered = rewards - means[group_ids]
+    if not normalize_std:
+        return centered
+    sq = jax.ops.segment_sum(centered * centered, group_ids,
+                             num_segments=num_groups)
+    std = jnp.sqrt(sq / counts)
+    return centered / jnp.maximum(std[group_ids], min_std)
+
+
+def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """(B, S, V) fp32 logits + (B, S) targets → (B, S) log p(target)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return tgt - logz
+
+
+def grpo_objective(
+    logp: jax.Array,             # (B, S) current-policy completion logprobs
+    old_logp: jax.Array,         # (B, S) behavior-policy logprobs (sampled)
+    advantages: jax.Array,       # (B,)
+    mask: jax.Array,             # (B, S) True on completion tokens
+    config: GRPOConfig = GRPOConfig(),
+    ref_logp: Optional[jax.Array] = None,  # (B, S) frozen reference policy
+) -> tuple:
+    """Clipped surrogate + KL penalty. Returns (loss, metrics dict)."""
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    adv = advantages[:, None]
+
+    ratio = jnp.exp(logp - old_logp)
+    unclipped = ratio * adv
+    clipped = jnp.clip(ratio, 1.0 - config.clip_eps,
+                       1.0 + config.clip_eps) * adv
+    pg_loss = -jnp.sum(jnp.minimum(unclipped, clipped) * mask) / denom
+
+    kl = jnp.zeros(())
+    if ref_logp is not None and config.kl_coef > 0.0:
+        # k3 estimator (Schulman): unbiased, positive.
+        log_ratio = ref_logp - logp
+        kl_per_tok = jnp.exp(log_ratio) - log_ratio - 1.0
+        kl = jnp.sum(kl_per_tok * mask) / denom
+
+    loss = pg_loss + config.kl_coef * kl
+    metrics = {
+        "pg_loss": pg_loss,
+        "kl": kl,
+        "ratio_mean": jnp.sum(ratio * mask) / denom,
+        "clip_frac": jnp.sum((jnp.abs(ratio - 1.0) > config.clip_eps) * mask)
+        / denom,
+    }
+    return loss, metrics
