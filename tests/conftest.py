@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
+# The environment may register a TPU platform plugin from a PYTHONPATH
+# sitecustomize hook, which imports jax before this conftest runs; in that
+# case the env vars above are captured too late and must be re-applied
+# through the live config object.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
