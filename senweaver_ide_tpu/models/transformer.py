@@ -33,7 +33,9 @@ Params = Dict[str, Any]
 class KVCache(NamedTuple):
     k: jax.Array  # (L, B, Smax, Hkv, Dh)
     v: jax.Array  # (L, B, Smax, Hkv, Dh)
-    length: jax.Array  # () int32 — tokens currently in cache
+    # () int32 — tokens currently in cache; or (B,) int32 for per-slot
+    # lengths (continuous batching, rollout/engine.py).
+    length: jax.Array
 
 
 def init_kv_cache(config: ModelConfig, batch: int, max_len: int,
@@ -115,10 +117,20 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
 
     if cache_kv is not None:
         k_cache, v_cache, length = cache_kv
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+        if length.ndim == 0:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+        else:
+            # Per-slot write offsets (continuous batching): scatter each
+            # slot's s new positions at its own length.
+            slot = jnp.arange(b)[:, None]                      # (B, 1)
+            pos = length[:, None] + jnp.arange(s)[None, :]     # (B, s)
+            k_cache = k_cache.at[slot, pos].set(k.astype(k_cache.dtype),
+                                                mode="drop")
+            v_cache = v_cache.at[slot, pos].set(v.astype(v_cache.dtype),
+                                                mode="drop")
         out = attention(q, k_cache, v_cache, q_offset=length, kv_mask=kv_mask,
                         causal=True)
         kv_out = (k_cache, v_cache)
@@ -164,7 +176,9 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
     x = params["embed"][tokens]  # gather; sharded vocab → XLA collective
 
     if positions is None:
-        base = cache.length if cache is not None else 0
+        base = cache.length if cache is not None else jnp.zeros((), jnp.int32)
+        if base.ndim == 1:
+            base = base[:, None]                       # per-slot lengths
         positions = base + jnp.arange(s, dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, (b, s))
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
@@ -180,7 +194,9 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
         max_len = cache.k.shape[2]
         # kv validity: only slots < length + s are real.
         kv_pos = jnp.arange(max_len)[None, :]
-        valid = kv_pos < (cache.length + s)
+        length = cache.length
+        bound = (length[:, None] if length.ndim == 1 else length) + s
+        valid = jnp.broadcast_to(kv_pos < bound, (b, max_len))
         if attn_mask is not None:
             valid = valid & attn_mask
 

@@ -26,10 +26,17 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
 
 
 def causal_mask(q_len: int, kv_len: int, q_offset) -> jnp.ndarray:
-    """(q_len, kv_len) boolean mask; True = attend. ``q_offset`` is the
-    absolute position of the first query (for decode steps), traced or static."""
-    q_pos = q_offset + jnp.arange(q_len)[:, None]
-    k_pos = jnp.arange(kv_len)[None, :]
+    """Boolean mask, True = attend. ``q_offset`` is the absolute position of
+    the first query — a scalar (traced or static) giving a (q_len, kv_len)
+    mask, or a (B,) vector of per-slot offsets (continuous batching) giving
+    (B, q_len, kv_len)."""
+    q_offset = jnp.asarray(q_offset)
+    if q_offset.ndim == 1:
+        q_pos = q_offset[:, None, None] + jnp.arange(q_len)[None, :, None]
+        k_pos = jnp.arange(kv_len)[None, None, :]
+    else:
+        q_pos = q_offset + jnp.arange(q_len)[:, None]
+        k_pos = jnp.arange(kv_len)[None, :]
     return k_pos <= q_pos
 
 
@@ -57,7 +64,9 @@ def attention(
                         precision=jax.lax.Precision.HIGHEST) * scale
 
     if causal:
-        mask = causal_mask(sq, k.shape[1], q_offset)[None, None, :, :]
+        mask = causal_mask(sq, k.shape[1], q_offset)
+        # (q, kv) → (1, 1, q, kv); (B, q, kv) → (B, 1, q, kv)
+        mask = mask[None, None] if mask.ndim == 2 else mask[:, None]
         scores = jnp.where(mask, scores, NEG_INF)
     if kv_mask is not None:
         scores = jnp.where(kv_mask[:, None, None, :], scores, NEG_INF)

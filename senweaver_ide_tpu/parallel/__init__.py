@@ -1,4 +1,7 @@
 from .mesh import (AXES, MeshConfig, data_sharding, make_mesh, replicated,
                    single_device_mesh)
+from .ring_attention import (chunk_attention_lse, make_ring_attention,
+                             make_ulysses_attention, merge_partials,
+                             ring_attention, ulysses_attention)
 from .sharding import (ACT_SPEC, KV_CACHE_SPEC, LOGITS_SPEC, PARAM_SPECS,
                        param_shardings, param_specs, shard_params)

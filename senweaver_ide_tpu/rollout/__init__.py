@@ -1,2 +1,3 @@
+from .engine import RolloutEngine
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill)

@@ -1,0 +1,233 @@
+"""Continuous-batching rollout engine — slot-pool decoding on one KV cache.
+
+The reference fans rollouts out as concurrent HTTPS requests to provider
+APIs (``agentScheduler.ts`` chunked ``Promise.allSettled``, max 3-8 parallel
+— SURVEY.md §2.7). The TPU equivalent keeps ONE resident batch on device:
+the batch axis is a pool of ``num_slots`` decode slots sharing a single
+(L, num_slots, max_len, Hkv, Dh) KV cache with per-slot lengths
+(``KVCache.length`` as a (B,) vector — models/transformer.py scatter path).
+
+- ``submit()`` queues a request; free slots are prefilled one at a time
+  (prompt padded to a power-of-two bucket to bound recompilation).
+- ``step()`` decodes ONE token for every active slot in a single jitted
+  call — new requests join the batch the moment a slot frees up, so chip
+  utilization does not drain between rollouts (the "sampler/trainer overlap"
+  half of SURVEY.md §7's systems risk).
+- Finished slots (eos / budget) are recycled immediately.
+
+The agent loop (rollout/agent_loop.py) drives this engine: each agent turn
+submits a prompt and consumes streamed tokens, so many agent conversations
+interleave on one chip like the reference's 8 parallel subagents interleave
+on one event loop (``subagentToolService.ts:33-36``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.transformer import KVCache, Params, forward
+from ..ops.sampling import sample_token
+from .sampler import SampleParams
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
+                  true_len: jax.Array, cache: KVCache,
+                  slot: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Prefill one slot. tokens: (1, S_bucket) right-padded; returns
+    (last-real-token logits (V,), updated pool cache)."""
+    L, _, max_len, hkv, dh = cache.k.shape
+    sub_k = jax.lax.dynamic_slice(
+        cache.k, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
+    sub_v = jax.lax.dynamic_slice(
+        cache.v, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
+    sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32))
+
+    # Mask padding so it can't be attended during prefill; padded positions
+    # are overwritten by subsequent decode steps before they become visible.
+    kv_pos = jnp.arange(max_len)[None, :]
+    attn_mask = kv_pos < true_len
+    logits, sub = forward(params, config, tokens, cache=sub,
+                          attn_mask=attn_mask)
+
+    new_k = jax.lax.dynamic_update_slice(cache.k, sub.k, (0, slot, 0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(cache.v, sub.v, (0, slot, 0, 0, 0))
+    new_len = cache.length.at[slot].set(true_len)
+    last = logits[0, true_len - 1, :]
+    return last, KVCache(k=new_k, v=new_v, length=new_len)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "sample"),
+                   donate_argnames=("cache",))
+def _pool_decode_step(params: Params, config: ModelConfig, cur_tok: jax.Array,
+                      active: jax.Array, cache: KVCache, key: jax.Array,
+                      sample: SampleParams):
+    """One decode step over the whole pool. cur_tok/active: (num_slots,).
+    Inactive slots compute garbage that is discarded; their lengths hold."""
+    logits, new_cache = forward(params, config, cur_tok[:, None], cache=cache)
+    logits = logits[:, -1, :]
+    next_tok = sample_token(logits, key, temperature=sample.temperature,
+                            top_k=sample.top_k, top_p=sample.top_p)
+    next_tok = jnp.where(active, next_tok, cur_tok)
+    length = jnp.where(active, new_cache.length, cache.length)
+    return next_tok, KVCache(k=new_cache.k, v=new_cache.v, length=length)
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    eos_id: Optional[int]
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    slot: Optional[int] = None
+
+
+class RolloutEngine:
+    """Slot-pool continuous batching over a shared KV cache."""
+
+    def __init__(self, params: Params, config: ModelConfig, *,
+                 num_slots: int = 8, max_len: int = 2048,
+                 sample: SampleParams = SampleParams(),
+                 eos_id: Optional[int] = None, seed: int = 0):
+        self.params = params
+        self.config = config
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.sample = sample
+        self.eos_id = eos_id
+        self._key = jax.random.PRNGKey(seed)
+        shape = (config.num_layers, num_slots, max_len, config.num_kv_heads,
+                 config.head_dim)
+        self.cache = KVCache(k=jnp.zeros(shape, config.dtype),
+                             v=jnp.zeros(shape, config.dtype),
+                             length=jnp.zeros((num_slots,), jnp.int32))
+        self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
+        self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        self._queue: Deque[_Request] = deque()
+        self._requests: Dict[int, _Request] = {}
+        self._next_rid = 0
+        # Tokens sampled during prefill, to be surfaced by the next step().
+        self._pending_emits: Dict[int, List[int]] = {}
+
+    # -- public API ---------------------------------------------------------
+
+    def submit(self, prompt: List[int], *, max_new_tokens: int = 128,
+               eos_id: Optional[int] = None) -> int:
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) >= self.max_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} ≥ engine max_len {self.max_len}")
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid=rid, prompt=list(prompt),
+                       max_new_tokens=max_new_tokens,
+                       eos_id=self.eos_id if eos_id is None else eos_id)
+        self._requests[rid] = req
+        self._queue.append(req)
+        self._schedule()
+        return rid
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(r is not None for r in self._slot_req)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Advance the pool by one decode step. Returns {rid: [tokens]} for
+        every token emitted since the previous step() — including tokens
+        sampled during prefill (a request can emit its first token and, if it
+        immediately hits eos, never appear in a later step)."""
+        self._schedule()
+        emitted = self._pending_emits
+        self._pending_emits = {}
+        active_list = [r is not None for r in self._slot_req]
+        if not any(active_list):
+            return emitted
+        active = jnp.asarray(active_list)
+        self._key, step_key = jax.random.split(self._key)
+        next_tok, self.cache = _pool_decode_step(
+            self.params, self.config, self.cur_tok, active, self.cache,
+            step_key, self.sample)
+        self.cur_tok = next_tok
+        toks = np.asarray(next_tok)
+        lengths = np.asarray(self.cache.length)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            emitted.setdefault(req.rid, []).append(tok)
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            out_of_budget = len(req.tokens) >= req.max_new_tokens
+            out_of_cache = int(lengths[slot]) >= self.max_len - 1
+            if hit_eos or out_of_budget or out_of_cache:
+                req.done = True
+                req.slot = None
+                self._slot_req[slot] = None
+        self._schedule()
+        return emitted
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until all submitted requests finish."""
+        while self.has_work:
+            self.step()
+        return {rid: r.tokens for rid, r in self._requests.items()}
+
+    def result(self, rid: int) -> List[int]:
+        return self._requests[rid].tokens
+
+    def is_done(self, rid: int) -> bool:
+        return self._requests[rid].done
+
+    # -- internals ----------------------------------------------------------
+
+    def _schedule(self) -> None:
+        """Prefill queued requests into free slots (continuous batching)."""
+        for slot in range(self.num_slots):
+            if not self._queue:
+                return
+            if self._slot_req[slot] is not None:
+                continue
+            req = self._queue.popleft()
+            req.slot = slot
+            self._slot_req[slot] = req
+            true_len = len(req.prompt)
+            bucket = min(_bucket(true_len), self.max_len)
+            padded = req.prompt + [0] * (bucket - true_len)
+            tokens = jnp.asarray(padded, jnp.int32)[None, :]
+            last_logits, self.cache = _prefill_slot(
+                self.params, self.config, tokens,
+                jnp.asarray(true_len, jnp.int32), self.cache,
+                jnp.asarray(slot, jnp.int32))
+            self._key, tok_key = jax.random.split(self._key)
+            tok0 = sample_token(last_logits[None, :], tok_key,
+                                temperature=self.sample.temperature,
+                                top_k=self.sample.top_k,
+                                top_p=self.sample.top_p)
+            tok0_i = int(tok0[0])
+            req.tokens.append(tok0_i)
+            self._pending_emits.setdefault(req.rid, []).append(tok0_i)
+            self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
+            if ((req.eos_id is not None and tok0_i == req.eos_id)
+                    or req.max_new_tokens <= 1):
+                req.done = True
+                req.slot = None
+                self._slot_req[slot] = None

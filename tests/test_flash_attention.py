@@ -1,0 +1,116 @@
+"""Flash attention kernel vs the XLA einsum reference path.
+
+Runs the Pallas kernel in interpret mode on the CPU harness (conftest forces
+JAX_PLATFORMS=cpu) — the TPU analogue of the reference's mocked-service unit
+tests (SURVEY.md §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.ops.attention import attention
+from senweaver_ide_tpu.ops.flash_attention import flash_attention
+
+
+def _rand_qkv(rng, b, sq, skv, hq, hkv, d, dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((b, sq, hq, d)), dtype)
+    k = jnp.asarray(rng.standard_normal((b, skv, hkv, d)), dtype)
+    v = jnp.asarray(rng.standard_normal((b, skv, hkv, d)), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("sq,skv,hq,hkv,d", [
+    (64, 64, 4, 4, 32),      # MHA, seq < one block
+    (128, 128, 4, 2, 64),    # GQA
+    (96, 96, 2, 1, 32),      # non-multiple-of-block seq (padding path)
+    (256, 256, 2, 2, 64),    # multiple KV blocks
+])
+def test_matches_xla_causal(rng, sq, skv, hq, hkv, d):
+    q, k, v = _rand_qkv(rng, 2, sq, skv, hq, hkv, d)
+    ref = attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=64, block_kv=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_non_causal(rng):
+    q, k, v = _rand_qkv(rng, 1, 64, 128, 2, 2, 32)
+    ref = attention(q, k, v, causal=False)
+    got = flash_attention(q, k, v, causal=False, block_q=32, block_kv=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kv_mask(rng):
+    q, k, v = _rand_qkv(rng, 2, 32, 64, 2, 2, 32)
+    # Keep key 0 valid so no causal row is fully masked (the XLA path emits
+    # uniform-softmax garbage on fully-masked rows; the kernel emits zeros).
+    kv_mask = jnp.asarray(rng.random((2, 64)) > 0.3).at[:, 0].set(True)
+    ref = attention(q, k, v, causal=True, kv_mask=kv_mask)
+    got = flash_attention(q, k, v, causal=True, kv_mask=kv_mask,
+                          block_q=32, block_kv=32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_q_offset_decode_window(rng):
+    """Queries at the end of a longer KV (chunked prefill shape)."""
+    q, k, v = _rand_qkv(rng, 1, 32, 128, 2, 2, 32)
+    ref = attention(q, k, v, causal=True, q_offset=96)
+    got = flash_attention(q, k, v, causal=True, q_offset=96,
+                          block_q=32, block_kv=64)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_kv_offset_chunk(rng):
+    """A rotated KV chunk (ring attention): kv positions 64..127 against
+    queries at 0..63 must be fully masked; against queries at 64..127 causal."""
+    q, k, v = _rand_qkv(rng, 1, 128, 64, 2, 2, 32)
+    full_k = jnp.concatenate([jnp.zeros_like(k), k], axis=1)
+    full_v = jnp.concatenate([jnp.zeros_like(v), v], axis=1)
+    kv_mask = jnp.concatenate([jnp.zeros((1, 64), bool),
+                               jnp.ones((1, 64), bool)], axis=1)
+    ref = attention(q, full_k, full_v, causal=True, kv_mask=kv_mask)
+    # ref rows 0..63 are fully masked → softmax over NEG_INF row is uniform
+    # garbage; compare only rows 64.. where the chunk contributes.
+    got = flash_attention(q, k, v, causal=True, kv_offset=64,
+                          block_q=32, block_kv=32)
+    np.testing.assert_allclose(np.asarray(got)[:, 64:],
+                               np.asarray(ref)[:, 64:], atol=2e-5, rtol=2e-5)
+    # Fully-masked rows come out exactly zero from the kernel (guarded).
+    np.testing.assert_allclose(np.asarray(got)[:, :64], 0.0, atol=1e-6)
+
+
+def test_gradients_match_xla(rng):
+    q, k, v = _rand_qkv(rng, 1, 96, 96, 4, 2, 32)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+    def loss_fa(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True, block_q=32,
+                            block_kv=32) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fa):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_jit_and_traced_offset(rng):
+    """Offsets may be traced (ring attention passes axis_index products)."""
+    q, k, v = _rand_qkv(rng, 1, 32, 64, 2, 2, 32)
+
+    @jax.jit
+    def f(q, k, v, off):
+        return flash_attention(q, k, v, causal=True, q_offset=off,
+                               block_q=32, block_kv=32)
+
+    ref = attention(q, k, v, causal=True, q_offset=32)
+    np.testing.assert_allclose(np.asarray(f(q, k, v, 32)), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
