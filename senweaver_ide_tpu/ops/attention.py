@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # large-but-finite: -inf breaks softmax rows that are fully masked
+MASKED_THRESHOLD = NEG_INF * 0.5  # scores at/below this count as fully masked
 
 
 def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:

@@ -34,9 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import MASKED_THRESHOLD as _MASKED
 from .attention import NEG_INF
-
-_MASKED = NEG_INF * 0.5  # scores at/below this are treated as fully masked
 
 
 def _fa_kernel(offsets_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
@@ -166,45 +165,50 @@ def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
     n_rep = hq // hkv
     scale = 1.0 / (d ** 0.5)
 
-    qf = q.astype(jnp.float32)
-    kf = jnp.repeat(k.astype(jnp.float32), n_rep, axis=1)
-    vf = jnp.repeat(v.astype(jnp.float32), n_rep, axis=1)
-    gf = g.astype(jnp.float32)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # (B, Hq, Sq)
+    # Keep K/V at Hkv heads and fold the GQA group into the einsums (q heads
+    # reshaped to (Hkv, n_rep)) — repeating K/V to Hq in fp32 would multiply
+    # live KV memory by n_rep for the whole scan.
+    qf = q.astype(jnp.float32).reshape(b, hkv, n_rep, sq, d)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32).reshape(b, hkv, n_rep, sq, d)
+    delta = jnp.sum(gf * out.astype(jnp.float32)
+                    .reshape(b, hkv, n_rep, sq, d), axis=-1)
+    lse_g = lse.reshape(b, hkv, n_rep, sq)
 
     n_kv = skv // block_kv
-    kb = kf.reshape(b, hq, n_kv, block_kv, d).transpose(2, 0, 1, 3, 4)
-    vb = vf.reshape(b, hq, n_kv, block_kv, d).transpose(2, 0, 1, 3, 4)
+    kb = kf.reshape(b, hkv, n_kv, block_kv, d).transpose(2, 0, 1, 3, 4)
+    vb = vf.reshape(b, hkv, n_kv, block_kv, d).transpose(2, 0, 1, 3, 4)
     bias_b = bias.reshape(b, n_kv, block_kv).transpose(1, 0, 2)
     q_pos = offsets[0] + jnp.arange(sq, dtype=jnp.int32)
 
     def body(dq, xs):
-        ki, k_blk, v_blk, bias_blk = xs
+        ki, k_blk, v_blk, bias_blk = xs            # k/v_blk: (B,Hkv,blk,D)
 
         def compute(dq):
-            s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk,
+            s = jnp.einsum("bgrqd,bgkd->bgrqk", qf, k_blk,
                            precision=jax.lax.Precision.HIGHEST) * scale
-            s = s + bias_blk[:, None, None, :]
+            s = s + bias_blk[:, None, None, None, :]
             if causal:
                 k_pos = (offsets[1] + ki * block_kv
                          + jnp.arange(block_kv, dtype=jnp.int32))
                 mask = k_pos[None, :] <= q_pos[:, None]      # (Sq, block_kv)
-                s = jnp.where(mask[None, None, :, :], s, NEG_INF)
+                s = jnp.where(mask[None, None, None, :, :], s, NEG_INF)
             # Same fully-masked guard as the forward kernel (lse == NEG_INF).
-            p = jnp.where(s > _MASKED, jnp.exp(s - lse[:, :, :, None]), 0.0)
-            dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf,
+            p = jnp.where(s > _MASKED, jnp.exp(s - lse_g[..., None]), 0.0)
+            dv_blk = jnp.einsum("bgrqk,bgrqd->bgkd", p, gf,
                                 precision=jax.lax.Precision.HIGHEST)
-            dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk,
+            dp = jnp.einsum("bgrqd,bgkd->bgrqk", gf, v_blk,
                             precision=jax.lax.Precision.HIGHEST)
-            ds = p * (dp - delta[:, :, :, None])
-            dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk,
+            ds = p * (dp - delta[..., None])
+            dq = dq + jnp.einsum("bgrqk,bgkd->bgrqd", ds, k_blk,
                                  precision=jax.lax.Precision.HIGHEST) * scale
-            dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf,
+            dk_blk = jnp.einsum("bgrqk,bgrqd->bgkd", ds, qf,
                                 precision=jax.lax.Precision.HIGHEST) * scale
             return dq, dk_blk, dv_blk
 
         def skip(dq):
-            zero = jnp.zeros((b, hq, block_kv, d), jnp.float32)
+            zero = jnp.zeros((b, hkv, block_kv, d), jnp.float32)
             return dq, zero, zero
 
         if causal:
@@ -216,15 +220,13 @@ def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
             dq, dk_blk, dv_blk = compute(dq)
         return dq, (dk_blk, dv_blk)
 
-    dq0 = jnp.zeros((b, hq, sq, d), jnp.float32)
+    dq0 = jnp.zeros((b, hkv, n_rep, sq, d), jnp.float32)
     dq, (dk_blks, dv_blks) = jax.lax.scan(
         body, dq0, (jnp.arange(n_kv, dtype=jnp.int32), kb, vb, bias_b))
 
-    dk = dk_blks.transpose(1, 2, 0, 3, 4).reshape(b, hq, skv, d)
-    dv = dv_blks.transpose(1, 2, 0, 3, 4).reshape(b, hq, skv, d)
-    if n_rep > 1:
-        dk = dk.reshape(b, hkv, n_rep, skv, d).sum(axis=2)
-        dv = dv.reshape(b, hkv, n_rep, skv, d).sum(axis=2)
+    dq = dq.reshape(b, hq, sq, d)
+    dk = dk_blks.transpose(1, 2, 0, 3, 4).reshape(b, hkv, skv, d)
+    dv = dv_blks.transpose(1, 2, 0, 3, 4).reshape(b, hkv, skv, d)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             jnp.zeros_like(bias))
 

@@ -48,9 +48,8 @@ def shard_map(f, **kwargs):
         kwargs[_REP_KWARG] = kwargs.pop("check_rep")
     return _shard_map(f, **kwargs)
 
+from ..ops.attention import MASKED_THRESHOLD as _MASKED
 from ..ops.attention import NEG_INF, repeat_kv
-
-_MASKED = NEG_INF * 0.5
 
 
 def chunk_attention_lse(

@@ -1,0 +1,176 @@
+"""Terminal tools: ephemeral + persistent command execution.
+
+Mirrors `browser/terminalToolService.ts` (388 LoC) semantics inside the
+rollout sandbox:
+
+- run_command: spawn, stream output, resolve on exit or after
+  MAX_TERMINAL_INACTIVE_TIME_S (8 s) of output inactivity → {type:'timeout'}
+  (TerminalResolveReason, toolsServiceTypes.ts:8).
+- open/run/kill persistent terminals: a long-lived shell per ID; commands
+  return after MAX_TERMINAL_BG_COMMAND_TIME_S (5 s) with output-so-far and
+  keep running in the background (prompts.ts:29-31 caps).
+- Output capped at MAX_TERMINAL_CHARS (100k), later re-capped to
+  TERMINAL_OUTPUT_MAX_CHARS (5k) by the stringifier.
+
+Commands run with cwd inside the sandbox; the environment is scrubbed to a
+minimal allowlist for reproducibility (SURVEY.md §7 hermeticity).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import selectors
+import signal
+import subprocess
+import time
+from typing import Dict, Optional
+
+from ..context.token_config import (MAX_TERMINAL_BG_COMMAND_TIME_S,
+                                    MAX_TERMINAL_CHARS,
+                                    MAX_TERMINAL_INACTIVE_TIME_S)
+
+_ENV_ALLOWLIST = ("PATH", "HOME", "LANG", "TERM", "PYTHONPATH")
+
+
+@dataclasses.dataclass
+class CommandResult:
+    output: str
+    resolve_reason: str          # 'done' | 'timeout' | 'bgtimeout' | 'killed'
+    exit_code: Optional[int]
+    duration_s: float
+
+
+def _scrubbed_env() -> Dict[str, str]:
+    return {k: os.environ[k] for k in _ENV_ALLOWLIST if k in os.environ}
+
+
+def _read_until(proc: subprocess.Popen, *, inactive_timeout: float,
+                hard_timeout: Optional[float] = None) -> tuple[str, str]:
+    """Drain stdout until exit, inactivity timeout, or hard timeout.
+    Returns (output, reason). stdout must be in non-blocking mode: a
+    backgrounded grandchild can inherit the pipe and keep it open long after
+    the shell exits, so every read here must be unable to block."""
+    os.set_blocking(proc.stdout.fileno(), False)  # type: ignore[union-attr]
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)  # type: ignore[arg-type]
+    chunks: list[bytes] = []
+    total = 0
+    start = time.monotonic()
+    last_activity = start
+
+    def drain() -> None:
+        nonlocal total
+        while True:
+            data = proc.stdout.read(65536)  # type: ignore[union-attr]
+            if not data:
+                return
+            last = time.monotonic()
+            nonlocal last_activity
+            last_activity = last
+            if total < MAX_TERMINAL_CHARS:
+                chunks.append(data)
+                total += len(data)
+
+    while True:
+        now = time.monotonic()
+        if proc.poll() is not None:
+            drain()  # non-blocking: grabs whatever is buffered, no more
+            return (b"".join(chunks).decode(errors="replace"), "done")
+        if hard_timeout is not None and now - start >= hard_timeout:
+            return (b"".join(chunks).decode(errors="replace"), "bgtimeout")
+        if now - last_activity >= inactive_timeout:
+            return (b"".join(chunks).decode(errors="replace"), "timeout")
+        if sel.select(timeout=0.1):
+            drain()
+
+
+class TerminalManager:
+    """Ephemeral run_command + persistent terminal pool for one sandbox."""
+
+    def __init__(self, cwd: str):
+        self.cwd = cwd
+        self._persistent: Dict[str, subprocess.Popen] = {}
+        self._next_id = 1
+        self._sentinel_n = 0
+
+    def run_command(self, command: str, *, cwd: Optional[str] = None,
+                    inactive_timeout: float = MAX_TERMINAL_INACTIVE_TIME_S
+                    ) -> CommandResult:
+        start = time.monotonic()
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", command], cwd=cwd or self.cwd,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=_scrubbed_env(), start_new_session=True)
+        out, reason = _read_until(proc, inactive_timeout=inactive_timeout)
+        if reason != "done":
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            proc.wait()
+        return CommandResult(output=out[:MAX_TERMINAL_CHARS],
+                             resolve_reason=reason,
+                             exit_code=proc.returncode if reason == "done"
+                             else None,
+                             duration_s=time.monotonic() - start)
+
+    # -- persistent terminals ---------------------------------------------
+    def open_persistent(self, *, cwd: Optional[str] = None) -> str:
+        tid = f"terminal-{self._next_id}"
+        self._next_id += 1
+        proc = subprocess.Popen(
+            ["/bin/sh"], cwd=cwd or self.cwd, stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=_scrubbed_env(), start_new_session=True)
+        os.set_blocking(proc.stdout.fileno(), False)  # type: ignore
+        self._persistent[tid] = proc
+        return tid
+
+    def run_persistent(self, terminal_id: str, command: str,
+                       *, bg_timeout: float = MAX_TERMINAL_BG_COMMAND_TIME_S
+                       ) -> CommandResult:
+        proc = self._persistent.get(terminal_id)
+        if proc is None or proc.poll() is not None:
+            raise KeyError(f"no persistent terminal: {terminal_id}")
+        start = time.monotonic()
+        # Sentinel echo so fast commands resolve immediately instead of
+        # idling the full bg window (the reference resolves on completion;
+        # only still-running commands hit the 5 s return-and-continue path).
+        self._sentinel_n += 1
+        sentinel = f"__SW_DONE_{self._sentinel_n}__"
+        proc.stdin.write(  # type: ignore[union-attr]
+            (command + f"\nprintf '%s\\n' {sentinel}\n").encode())
+        proc.stdin.flush()  # type: ignore[union-attr]
+        chunks: list[bytes] = []
+        done = False
+        while time.monotonic() - start < bg_timeout:
+            data = proc.stdout.read(65536)  # type: ignore[union-attr]
+            if data:
+                chunks.append(data)
+                if sentinel.encode() in b"".join(chunks[-2:]):
+                    done = True
+                    break
+            else:
+                time.sleep(0.02)
+        out = b"".join(chunks).decode(errors="replace")
+        out = out.replace(sentinel + "\n", "").replace(sentinel, "")
+        return CommandResult(
+            output=out[:MAX_TERMINAL_CHARS],
+            resolve_reason="done" if done else "bgtimeout",
+            exit_code=None,
+            duration_s=time.monotonic() - start)
+
+    def kill_persistent(self, terminal_id: str) -> None:
+        proc = self._persistent.pop(terminal_id, None)
+        if proc is None:
+            raise KeyError(f"no persistent terminal: {terminal_id}")
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+
+    def close(self) -> None:
+        for tid in list(self._persistent):
+            self.kill_persistent(tid)

@@ -1,0 +1,227 @@
+"""Tools sandbox tests: workspace confinement, file/search/edit/terminal
+tools, SEARCH/REPLACE semantics, validation + approval + caps."""
+
+import pytest
+
+from senweaver_ide_tpu.tools import (APPROVAL_TYPE_OF_TOOL,
+                                     BUILTIN_TOOL_NAMES, TOOL_SCHEMAS,
+                                     ApprovalType, MalformedBlocksError,
+                                     SandboxViolation, SearchNotFoundError,
+                                     ToolsService, Workspace,
+                                     apply_search_replace, extract_blocks)
+
+
+@pytest.fixture()
+def ws(tmp_path):
+    w = Workspace(tmp_path / "sandbox")
+    w.write_file("src/main.py", "def main():\n    print('hello')\n")
+    w.write_file("src/util.py", "VALUE = 42\n")
+    w.write_file("README.md", "# demo\n")
+    return w
+
+
+@pytest.fixture()
+def svc(ws):
+    s = ToolsService(ws)
+    yield s
+    s.close()
+
+
+# ---- sandbox confinement ----
+
+def test_escape_rejected(ws):
+    with pytest.raises(SandboxViolation):
+        ws.resolve("../../etc/passwd")
+
+
+def test_absolute_rerooted(ws):
+    p = ws.resolve("/src/main.py")
+    assert p == ws.root / "src/main.py"
+
+
+def test_refuses_root_delete(ws):
+    with pytest.raises(SandboxViolation):
+        ws.delete("/")
+
+
+# ---- registry completeness ----
+
+def test_all_31_tools_registered():
+    assert len(BUILTIN_TOOL_NAMES) == 31
+    assert set(TOOL_SCHEMAS) == set(BUILTIN_TOOL_NAMES)
+
+
+# ---- file + search tools ----
+
+def test_read_file(svc):
+    tr = svc.call_tool("read_file", {"uri": "src/main.py"})
+    assert tr.ok and "hello" in tr.result["contents"]
+
+
+def test_read_file_line_window(svc):
+    tr = svc.call_tool("read_file",
+                       {"uri": "src/main.py", "start_line": "2",
+                        "end_line": "2"})
+    assert tr.result["contents"] == "    print('hello')\n"
+
+
+def test_ls_and_tree(svc):
+    tr = svc.call_tool("ls_dir", {"uri": ""})
+    names = [n for n, _ in tr.result["children"]]
+    assert "src/" in names and "README.md" in names
+    tree = svc.call_tool("get_dir_tree", {"uri": "/"}).result["tree"]
+    assert "main.py" in tree and "└──" in tree or "├──" in tree
+
+
+def test_search_tools(svc):
+    tr = svc.call_tool("search_pathnames_only", {"query": "util"})
+    assert tr.result["uris"] == ["/src/util.py"]
+    tr = svc.call_tool("search_for_files", {"query": "VALUE = 42"})
+    assert tr.result["uris"] == ["/src/util.py"]
+    tr = svc.call_tool("search_in_file",
+                       {"uri": "src/main.py", "query": "print"})
+    assert tr.result["lines"] == [2]
+
+
+def test_create_delete(svc):
+    svc.call_tool("create_file_or_folder", {"uri": "new/dir/"})
+    assert (svc.workspace.root / "new/dir").is_dir()
+    svc.call_tool("create_file_or_folder", {"uri": "new/file.txt"})
+    assert (svc.workspace.root / "new/file.txt").is_file()
+    tr = svc.call_tool("delete_file_or_folder",
+                       {"uri": "new", "is_recursive": "true"})
+    assert tr.ok and not (svc.workspace.root / "new").exists()
+
+
+# ---- SEARCH/REPLACE ----
+
+BLOCKS = """<<<<<<< ORIGINAL
+    print('hello')
+=======
+    print('world')
+>>>>>>> UPDATED"""
+
+
+def test_extract_blocks_rejects_raw_code():
+    with pytest.raises(MalformedBlocksError):
+        extract_blocks("just some code")
+
+
+def test_extract_blocks_unbalanced():
+    with pytest.raises(MalformedBlocksError):
+        extract_blocks("<<<<<<< ORIGINAL\nx\n>>>>>>> UPDATED")
+
+
+def test_apply_exact():
+    out = apply_search_replace("a\n    print('hello')\nb", BLOCKS)
+    assert out == "a\n    print('world')\nb"
+
+
+def test_apply_whitespace_tolerant():
+    content = "a\n  print('hello')\nb"   # different indent than ORIGINAL
+    out = apply_search_replace(content, BLOCKS)
+    assert "print('world')" in out and "print('hello')" not in out
+
+
+def test_apply_not_found():
+    with pytest.raises(SearchNotFoundError):
+        apply_search_replace("nothing here", BLOCKS)
+
+
+def test_edit_file_tool(svc):
+    tr = svc.call_tool("edit_file", {"uri": "src/main.py",
+                                     "search_replace_blocks": BLOCKS})
+    assert tr.ok
+    text, _ = svc.workspace.read_file("src/main.py")
+    assert "world" in text
+
+
+def test_edit_file_rejects_raw_code(svc):
+    tr = svc.call_tool("edit_file", {"uri": "src/main.py",
+                                     "search_replace_blocks": "raw code"})
+    assert not tr.ok and "ORIGINAL" in tr.error
+
+
+def test_rewrite_file(svc):
+    tr = svc.call_tool("rewrite_file", {"uri": "fresh.py",
+                                        "new_content": "x = 1\n"})
+    assert tr.ok and tr.result["is_new_file"]
+
+
+# ---- terminal ----
+
+def test_run_command(svc):
+    tr = svc.call_tool("run_command", {"command": "echo hi; exit 3"})
+    assert tr.ok and "hi" in tr.result["output"]
+    assert tr.result["exit_code"] == 3
+    s = svc.string_of_result(tr)
+    assert "exit code 3" in s
+
+
+def test_run_command_inactivity_timeout(svc):
+    r = svc.terminals.run_command("sleep 60", inactive_timeout=0.3)
+    assert r.resolve_reason == "timeout" and r.exit_code is None
+
+
+def test_persistent_terminal(svc):
+    tid = svc.call_tool("open_persistent_terminal",
+                        {}).result["persistent_terminal_id"]
+    tr = svc.terminals.run_persistent(tid, "export X=42 && echo val=$X",
+                                      bg_timeout=0.5)
+    assert "val=42" in tr.output
+    svc.call_tool("kill_persistent_terminal",
+                  {"persistent_terminal_id": tid})
+    assert tid not in svc.terminals._persistent
+
+
+# ---- validation / approval / gating ----
+
+def test_validation_missing_param(svc):
+    tr = svc.call_tool("read_file", {})
+    assert not tr.ok and "required param uri" in tr.error
+
+
+def test_bad_url_rejected(svc):
+    tr = svc.call_tool("fetch_url", {"url": "ftp://x"})
+    assert not tr.ok and "http" in tr.error
+
+
+def test_denied_by_policy(ws):
+    s = ToolsService(ws, auto_approve={ApprovalType.TERMINAL: False})
+    tr = s.call_tool("run_command", {"command": "echo hi"})
+    assert not tr.ok and "approval" in tr.error
+    s.close()
+
+
+def test_network_tool_unavailable(svc):
+    tr = svc.call_tool("web_search", {"query": "jax"})
+    assert not tr.ok and "no backend" in tr.error
+
+
+def test_handler_plugin(svc):
+    svc.register_handler("web_search", lambda p: {"results": ["r1"]})
+    tr = svc.call_tool("web_search", {"query": "jax"})
+    assert tr.ok and tr.result == {"results": ["r1"]}
+
+
+def test_approval_map_matches_reference():
+    assert APPROVAL_TYPE_OF_TOOL["edit_file"] is ApprovalType.EDITS
+    assert APPROVAL_TYPE_OF_TOOL["run_command"] is ApprovalType.TERMINAL
+    assert "read_file" not in APPROVAL_TYPE_OF_TOOL
+
+
+# ---- stringification caps ----
+
+def test_read_cap_15k(svc):
+    svc.workspace.write_file("big.txt", "x" * 40_000)
+    tr = svc.call_tool("read_file", {"uri": "big.txt"})
+    s = svc.string_of_result(tr)
+    assert len(s) <= 15_100 and "truncated" in s
+
+
+def test_ls_cap_20_items(svc):
+    for i in range(30):
+        svc.workspace.write_file(f"many/f{i:02}.txt", "")
+    tr = svc.call_tool("ls_dir", {"uri": "many"})
+    s = svc.string_of_result(tr)
+    assert s.count("\n") <= 21 and "more entries" in s
