@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import selectors
 import signal
 import subprocess
@@ -133,6 +134,10 @@ class TerminalManager:
         proc = self._persistent.get(terminal_id)
         if proc is None or proc.poll() is not None:
             raise KeyError(f"no persistent terminal: {terminal_id}")
+        # Discard late output from a previous bgtimeout'd command so it is
+        # not misattributed to this one.
+        while proc.stdout.read(65536):  # type: ignore[union-attr]
+            pass
         start = time.monotonic()
         # Sentinel echo so fast commands resolve immediately instead of
         # idling the full bg window (the reference resolves on completion;
@@ -154,7 +159,7 @@ class TerminalManager:
             else:
                 time.sleep(0.02)
         out = b"".join(chunks).decode(errors="replace")
-        out = out.replace(sentinel + "\n", "").replace(sentinel, "")
+        out = re.sub(r"__SW_DONE_\d+__\n?", "", out)
         return CommandResult(
             output=out[:MAX_TERMINAL_CHARS],
             resolve_reason="done" if done else "bgtimeout",

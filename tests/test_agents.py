@@ -1,0 +1,271 @@
+"""Agents tests: registry/permissions/compositions, loop semantics
+(tool cycle, retries, pruning), subagent guards, scheduler."""
+
+import itertools
+
+import pytest
+
+from senweaver_ide_tpu.agents import (AGENT_COMPOSITIONS, BUILTIN_AGENTS,
+                                      AgentLoop, AgentScheduler, ChatMessage,
+                                      ContextLengthError, LLMResponse,
+                                      LLMUsage, RateLimitError,
+                                      SubagentRunner, ToolCallRequest,
+                                      can_agent_use_tool, get_composition,
+                                      recommend_subagents, retry_delay_s,
+                                      should_use_subagents)
+from senweaver_ide_tpu.agents.subagent import (MAX_PARALLEL_SUBAGENTS,
+                                               MAX_SUBAGENT_DEPTH)
+from senweaver_ide_tpu.tools import ToolsService, Workspace
+from senweaver_ide_tpu.traces import TraceCollector
+
+
+class ScriptedClient:
+    """Replays a fixed list of responses / exceptions."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def chat(self, messages, *, temperature=None, max_tokens=None):
+        self.calls.append(list(messages))
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def resp(text, tool=None, params=None):
+    return LLMResponse(text=text,
+                       tool_call=ToolCallRequest(tool, params or {})
+                       if tool else None,
+                       usage=LLMUsage(100, 20), model="tiny")
+
+
+@pytest.fixture()
+def tools(tmp_path):
+    ws = Workspace(tmp_path / "sb")
+    ws.write_file("a.py", "x = 1\n")
+    s = ToolsService(ws)
+    yield s
+    s.close()
+
+
+# ---- registry ----
+
+def test_registry_counts():
+    modes = {a.mode for a in BUILTIN_AGENTS.values()}
+    assert modes == {"primary", "subagent", "system"}
+    assert len(BUILTIN_AGENTS) == 13
+    assert BUILTIN_AGENTS["build"].max_steps == 50
+    assert BUILTIN_AGENTS["chat"].max_steps == 20
+    assert BUILTIN_AGENTS["designer"].max_steps == 100
+
+
+def test_compositions():
+    agent = AGENT_COMPOSITIONS["agent"]
+    assert agent.primary_agent == "build" and agent.max_parallel == 3
+    assert set(agent.available_subagents) == {"explore", "plan", "code",
+                                              "review", "test"}
+    assert AGENT_COMPOSITIONS["designer"].max_parallel == 4
+    assert get_composition("nonexistent").primary_agent == "chat"
+
+
+def test_permission_filter():
+    assert can_agent_use_tool("build", "delete_file_or_folder")
+    assert not can_agent_use_tool("code", "run_command")      # denied
+    assert not can_agent_use_tool("explore", "edit_file")     # not allowed
+    assert can_agent_use_tool("explore", "search_for_files")
+
+
+def test_recommend_subagents():
+    rec = recommend_subagents(
+        "search the repo, implement the fix, and test it", "agent")
+    assert rec == ["explore", "code", "test"]
+    # capped at max_parallel (3 in agent mode)
+    rec = recommend_subagents(
+        "search plan implement review test everything", "agent")
+    assert len(rec) == 3
+    assert recommend_subagents("implement it", "normal") == []
+
+
+def test_should_use_subagents():
+    assert not should_use_subagents("fix typo", "agent")          # <50 chars
+    long_simple = "please look at this thing for me " * 3
+    assert not should_use_subagents(long_simple, "agent")         # no keyword
+    complex_task = ("refactor the authentication module across multiple "
+                    "files and add comprehensive tests")
+    assert should_use_subagents(complex_task, "agent")
+    assert not should_use_subagents(complex_task, "normal")
+
+
+# ---- retry delays ----
+
+def test_retry_delay_schedule():
+    assert retry_delay_s(1, is_tpm=False) == 3.0
+    assert retry_delay_s(2, is_tpm=False) == 4.5
+    assert retry_delay_s(1, is_tpm=True) == 6.0
+    assert retry_delay_s(10, is_tpm=True) == 60.0
+    assert retry_delay_s(20, is_tpm=False) == 30.0
+
+
+# ---- agent loop ----
+
+def test_loop_tool_cycle(tools):
+    client = ScriptedClient([
+        resp("reading", tool="read_file", params={"uri": "a.py"}),
+        resp("done: x is 1"),
+    ])
+    tc = TraceCollector()
+    out = AgentLoop(client, tools, collector=tc,
+                    thread_id="t1").run("build", "what is x?")
+    assert out.final_text == "done: x is 1"
+    assert out.llm_calls == 2 and out.tool_calls == 1
+    assert out.tool_failures == 0
+    # tool result fed back as a tool message
+    last_call = client.calls[-1]
+    assert any(m.role == "tool" and "x = 1" in m.content for m in last_call)
+
+
+def test_loop_permission_denied_feeds_error(tools):
+    client = ScriptedClient([
+        resp("trying", tool="run_command", params={"command": "ls"}),
+        resp("understood"),
+    ])
+    out = AgentLoop(client, tools).run("code", "run ls")
+    assert out.tool_failures == 1
+    last_call = client.calls[-1]
+    assert any("not permitted" in m.content for m in last_call
+               if m.role == "tool")
+
+
+def test_loop_generic_retry_then_success(tools):
+    naps = []
+    client = ScriptedClient([RuntimeError("boom"), RuntimeError("boom"),
+                             resp("ok")])
+    out = AgentLoop(client, tools, sleep=naps.append).run("chat", "hi")
+    assert out.final_text == "ok"
+    assert naps == [3.0, 4.5]
+
+
+def test_loop_rate_limit_honors_retry_after(tools):
+    naps = []
+    client = ScriptedClient([RateLimitError("429", retry_after_s=7.5),
+                             resp("ok")])
+    out = AgentLoop(client, tools, sleep=naps.append).run("chat", "hi")
+    assert out.final_text == "ok" and naps == [7.5]
+
+
+def test_loop_context_error_progressive_prune(tools):
+    stages = []
+
+    def prune(msgs, stage):
+        stages.append(stage)
+        return msgs[-2:]
+
+    client = ScriptedClient([ContextLengthError("too long"),
+                             ContextLengthError("too long"), resp("ok")])
+    out = AgentLoop(client, tools, prune=prune).run("chat", "hi")
+    assert out.final_text == "ok" and stages == [1, 2]
+
+
+def test_loop_exhausts_retries(tools):
+    client = ScriptedClient([RuntimeError(f"e{i}") for i in range(5)])
+    out = AgentLoop(client, tools, sleep=lambda s: None).run("chat", "hi")
+    assert out.aborted_reason == "llm_error"
+    assert "e4" in out.final_text
+
+
+def test_loop_max_steps(tools):
+    infinite = itertools.cycle(
+        [resp("loop", tool="ls_dir", params={"uri": ""})])
+
+    class InfiniteClient:
+        def chat(self, messages, *, temperature=None, max_tokens=None):
+            return next(infinite)
+
+    out = AgentLoop(InfiniteClient(), tools).run("review", "audit")
+    assert out.aborted_reason == "max_steps"
+    assert out.steps == (BUILTIN_AGENTS["review"].max_steps or 0) + 1
+
+
+def test_default_prune_ultimate_fallback(tools):
+    msgs = [ChatMessage("system", "S"), ChatMessage("user", "u1"),
+            ChatMessage("assistant", "a1"), ChatMessage("tool", "t1"),
+            ChatMessage("user", "u2")]
+    out = AgentLoop._default_prune(msgs, 3)
+    assert [m.role for m in out] == ["system", "user"]
+    assert out[-1].content == "u2"
+
+
+# ---- subagents ----
+
+def test_subagent_spawn_and_prompt(tools):
+    client = ScriptedClient([resp("explored: found 3 files")])
+    r = SubagentRunner(client, tools)
+    res = r.spawn("explore", "map the repo")
+    assert res.success and "explored" in res.output
+    sysmsg = client.calls[0][0]
+    assert sysmsg.role == "system" and "Subtask" in sysmsg.content
+    r.close()
+
+
+def test_subagent_depth_guard(tools):
+    r = SubagentRunner(ScriptedClient([]), tools)
+    res = r.spawn("explore", "x", depth=MAX_SUBAGENT_DEPTH)
+    assert not res.success and "depth" in res.error
+    r.close()
+
+
+def test_subagent_unknown_type(tools):
+    r = SubagentRunner(ScriptedClient([]), tools)
+    res = r.spawn("build", "x")      # primary, not a subagent
+    assert not res.success and "unknown subagent" in res.error
+    r.close()
+
+
+def test_subagent_timeout(tools):
+    import time as _t
+
+    class SlowClient:
+        def chat(self, messages, *, temperature=None, max_tokens=None):
+            _t.sleep(5)
+            return resp("late")
+
+    r = SubagentRunner(SlowClient(), tools, timeout_s=0.2)
+    res = r.spawn("explore", "x")
+    assert not res.success and "timed out" in res.error
+    r.close()
+
+
+def test_subagent_parallel_cap_constant():
+    assert MAX_PARALLEL_SUBAGENTS == 8 and MAX_SUBAGENT_DEPTH == 4
+
+
+# ---- scheduler ----
+
+def test_scheduler_end_to_end(tools):
+    client = ScriptedClient([resp(f"report {i}") for i in range(3)])
+    runner = SubagentRunner(client, tools)
+    sched = AgentScheduler(runner)
+    s = sched.start_session(
+        "implement the parser rework across multiple files, review and "
+        "test it", "agent")
+    planned = sched.plan_subagents(s)
+    assert [t.agent_type for t in planned] == ["code", "review", "test"]
+    results = sched.execute(s)
+    assert all(r.success for r in results)
+    merged = sched.merge_results(results)
+    assert "# Subagent Reports" in merged and "## code [ok]" in merged
+    runner.close()
+
+
+def test_scheduler_enhanced_prompt():
+    p = AgentScheduler.enhanced_system_prompt("agent")
+    assert "# Multi-Agent System" in p and "explore:" in p
+    assert "Up to 3 subagents" in p
+
+
+def test_scheduler_tool_filter():
+    assert AgentScheduler.tool_filter_for_mode("agent") is None   # build = *
+    f = AgentScheduler.tool_filter_for_mode("normal")
+    assert f is not None and "read_file" in f and "edit_file" not in f

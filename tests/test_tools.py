@@ -70,7 +70,7 @@ def test_ls_and_tree(svc):
     names = [n for n, _ in tr.result["children"]]
     assert "src/" in names and "README.md" in names
     tree = svc.call_tool("get_dir_tree", {"uri": "/"}).result["tree"]
-    assert "main.py" in tree and "└──" in tree or "├──" in tree
+    assert "main.py" in tree and ("└──" in tree or "├──" in tree)
 
 
 def test_search_tools(svc):
