@@ -176,7 +176,14 @@ class AgentLoop:
                 if resp.text:
                     tc.record_assistant_message(tid, steps, resp.text,
                                                 model=resp.model)
-            messages.append(ChatMessage("assistant", resp.text))
+            # History keeps the raw tool-call XML the policy emitted — the
+            # next turn (and RL traces) must condition on what was actually
+            # generated, not the stripped display text.
+            assistant_turn = resp.text
+            if resp.tool_call is not None and resp.tool_call.raw:
+                assistant_turn = (assistant_turn + "\n"
+                                  + resp.tool_call.raw).strip()
+            messages.append(ChatMessage("assistant", assistant_turn))
 
             if resp.tool_call is None:
                 final_text = resp.text

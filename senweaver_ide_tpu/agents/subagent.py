@@ -94,18 +94,23 @@ class SubagentRunner:
                     error=f"max parallel subagents "
                           f"({MAX_PARALLEL_SUBAGENTS}) reached")
             self._live += 1
+        fut = self._pool.submit(self._execute, agent_type, task, context)
+        # _live tracks actual pool occupancy: a timed-out _execute cannot be
+        # cancelled once running, so the slot is only released when the task
+        # really finishes — otherwise zombies would silently eat the pool
+        # while the guard reports free capacity.
+        fut.add_done_callback(lambda _f: self._release())
         try:
-            fut = self._pool.submit(self._execute, agent_type, task, context)
-            try:
-                return fut.result(timeout=self.timeout_s)
-            except concurrent.futures.TimeoutError:
-                fut.cancel()
-                return SubagentResult(agent_type, task, False, "",
-                                      error=f"subagent timed out after "
-                                            f"{self.timeout_s:.0f}s")
-        finally:
-            with self._lock:
-                self._live -= 1
+            return fut.result(timeout=self.timeout_s)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()   # frees the slot via callback if not yet started
+            return SubagentResult(agent_type, task, False, "",
+                                  error=f"subagent timed out after "
+                                        f"{self.timeout_s:.0f}s")
+
+    def _release(self) -> None:
+        with self._lock:
+            self._live -= 1
 
     def _execute(self, agent_type: str, task: str,
                  context: str) -> SubagentResult:

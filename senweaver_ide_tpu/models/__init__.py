@@ -4,3 +4,5 @@ from .config import (ModelConfig, PRESETS, get_config, qwen2_5_coder_0_5b,
 from .transformer import (KVCache, Params, count_params, forward,
                           init_kv_cache, init_params)
 from .tokenizer import ByteTokenizer, HFTokenizer, load_tokenizer
+from .capabilities import (ModelCapabilities, get_model_capabilities,
+                           get_reserved_output_token_space)

@@ -1,0 +1,69 @@
+"""Model capability database.
+
+The analogue of `common/modelCapabilities.ts` (2211 LoC): a static table of
+per-model capabilities — context window, reserved output space, FIM
+support, reasoning/think-tag behavior — keyed by model-name substring. The
+reference's table covers 20 remote providers; this build's table covers
+the local policy families it trains/serves (Qwen2.5-Coder, DeepSeek-Coder)
+plus the remote families rollouts may call for distillation, with the same
+lookup semantics (substring match, specific-first, default fallback).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelCapabilities:
+    """Schema mirror of modelCapabilities.ts:214-263."""
+    context_window: int
+    reserved_output_token_space: int = 4096
+    supports_fim: bool = False
+    fim_tokens: Optional[Tuple[str, str, str]] = None   # prefix/suffix/middle
+    supports_system_message: bool = True
+    reasoning_think_tags: Optional[Tuple[str, str]] = None
+    max_output_tokens: int = 4096
+
+
+_QWEN_FIM = ("<|fim_prefix|>", "<|fim_suffix|>", "<|fim_middle|>")
+_DEEPSEEK_FIM = ("<｜fim▁begin｜>", "<｜fim▁hole｜>", "<｜fim▁end｜>")
+
+# Ordered: first substring match wins (specific before generic).
+_CAPABILITIES: Tuple[Tuple[str, ModelCapabilities], ...] = (
+    ("qwen2.5-coder", ModelCapabilities(
+        context_window=32_768, supports_fim=True, fim_tokens=_QWEN_FIM)),
+    ("qwen", ModelCapabilities(context_window=131_072)),
+    ("deepseek-coder", ModelCapabilities(
+        context_window=16_384, supports_fim=True,
+        fim_tokens=_DEEPSEEK_FIM)),
+    ("deepseek-r1", ModelCapabilities(
+        context_window=65_536,
+        reasoning_think_tags=("<think>", "</think>"))),
+    ("deepseek", ModelCapabilities(context_window=65_536)),
+    ("codestral", ModelCapabilities(
+        context_window=32_768, supports_fim=True)),
+    ("claude", ModelCapabilities(context_window=200_000,
+                                 reserved_output_token_space=8192,
+                                 max_output_tokens=8192)),
+    ("gpt-4", ModelCapabilities(context_window=128_000)),
+    ("gemini", ModelCapabilities(context_window=1_000_000)),
+    ("tiny-test", ModelCapabilities(context_window=2_048,
+                                    reserved_output_token_space=256,
+                                    max_output_tokens=256)),
+)
+
+_DEFAULT = ModelCapabilities(context_window=128_000)
+
+
+def get_model_capabilities(model_name: str) -> ModelCapabilities:
+    lower = model_name.lower()
+    for key, caps in _CAPABILITIES:
+        if key in lower:
+            return caps
+    return _DEFAULT
+
+
+def get_reserved_output_token_space(model_name: str) -> int:
+    return get_model_capabilities(model_name).reserved_output_token_space

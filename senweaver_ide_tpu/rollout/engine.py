@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from collections import deque
 from typing import Deque, Dict, List, Optional
 
@@ -126,11 +127,20 @@ class RolloutEngine:
         self._next_rid = 0
         # Tokens sampled during prefill, to be surfaced by the next step().
         self._pending_emits: Dict[int, List[int]] = {}
+        # Many agent loops (subagent threads) drive one engine: all state
+        # mutation is serialized; concurrency = slots, not host threads.
+        self._lock = threading.RLock()
 
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt: List[int], *, max_new_tokens: int = 128,
                eos_id: Optional[int] = None) -> int:
+        with self._lock:
+            return self._submit(prompt, max_new_tokens=max_new_tokens,
+                                eos_id=eos_id)
+
+    def _submit(self, prompt: List[int], *, max_new_tokens: int,
+                eos_id: Optional[int]) -> int:
         if not prompt:
             raise ValueError("empty prompt")
         if len(prompt) >= self.max_len:
@@ -148,13 +158,19 @@ class RolloutEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or any(r is not None for r in self._slot_req)
+        with self._lock:
+            return bool(self._queue) or any(r is not None
+                                            for r in self._slot_req)
 
     def step(self) -> Dict[int, List[int]]:
         """Advance the pool by one decode step. Returns {rid: [tokens]} for
         every token emitted since the previous step() — including tokens
         sampled during prefill (a request can emit its first token and, if it
         immediately hits eos, never appear in a later step)."""
+        with self._lock:
+            return self._step()
+
+    def _step(self) -> Dict[int, List[int]]:
         self._schedule()
         emitted = self._pending_emits
         self._pending_emits = {}
@@ -192,10 +208,12 @@ class RolloutEngine:
         return {rid: r.tokens for rid, r in self._requests.items()}
 
     def result(self, rid: int) -> List[int]:
-        return self._requests[rid].tokens
+        with self._lock:
+            return list(self._requests[rid].tokens)
 
     def is_done(self, rid: int) -> bool:
-        return self._requests[rid].done
+        with self._lock:
+            return self._requests[rid].done
 
     # -- internals ----------------------------------------------------------
 

@@ -1,0 +1,90 @@
+"""PolicyClient over the TPU rollout engine.
+
+This is the seam where the reference's remote LLM transport
+(`sendLLMMessage.impl.ts` → provider HTTPS) becomes a local TPU policy:
+chat messages are rendered to the policy's chat template, tokenized
+host-side, decoded on the engine's continuous-batching pool, and the output
+is passed through grammar extraction (think-tags + XML tool calls,
+prompts/grammar.py) — exactly the pipeline a provider without a native tool
+API gets in the reference.
+
+``EnginePolicyClient.chat`` drives engine.step() until its own request
+finishes; other agent loops' requests interleave on the same pool, which is
+how many concurrent rollouts share one chip.
+
+Context-window errors are raised as ``ContextLengthError`` so the agent
+loop's progressive-pruning path engages (chatThreadService.ts:1437-1559).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..agents.llm import (ChatMessage, ContextLengthError, LLMResponse,
+                          LLMUsage, ToolCallRequest)
+from ..prompts.grammar import extract_reasoning_and_tool_call
+from .engine import RolloutEngine
+
+# Minimal ChatML-style template (Qwen2.5 family convention); the byte
+# tokenizer renders it verbatim, an HF tokenizer would too.
+_ROLE_OPEN = "<|im_start|>"
+_ROLE_CLOSE = "<|im_end|>"
+
+
+def render_chat_template(messages: Sequence[ChatMessage]) -> str:
+    parts: List[str] = []
+    for m in messages:
+        role = m.role if m.role != "tool" else "user"
+        content = m.content
+        if m.role == "tool":
+            content = (f"[{m.tool_name or 'tool'} result]\n{content}")
+        parts.append(f"{_ROLE_OPEN}{role}\n{content}{_ROLE_CLOSE}")
+    parts.append(f"{_ROLE_OPEN}assistant\n")
+    return "\n".join(parts)
+
+
+class EnginePolicyClient:
+    """PolicyClient backed by a RolloutEngine + tokenizer."""
+
+    def __init__(self, engine: RolloutEngine, tokenizer, *,
+                 model_name: str = "",
+                 default_max_new_tokens: int = 512,
+                 tool_names: Optional[Sequence[str]] = None):
+        self.engine = engine
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+        self.default_max_new_tokens = default_max_new_tokens
+        self.tool_names = tool_names
+
+    def chat(self, messages: List[ChatMessage], *,
+             temperature: Optional[float] = None,
+             max_tokens: Optional[int] = None) -> LLMResponse:
+        prompt_text = render_chat_template(messages)
+        prompt_ids = self.tokenizer.encode(prompt_text, add_bos=True)
+        budget = max_tokens or self.default_max_new_tokens
+        if len(prompt_ids) + budget >= self.engine.max_len:
+            raise ContextLengthError(
+                f"prompt of {len(prompt_ids)} tokens + {budget} output "
+                f"exceeds engine window {self.engine.max_len}")
+        rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
+                                 eos_id=self.tokenizer.eos_id)
+        while not self.engine.is_done(rid):
+            self.engine.step()
+        out_ids = self.engine.result(rid)
+        raw = self.tokenizer.decode(out_ids)
+        # Cut at the chat-template end marker if the model emitted one.
+        end = raw.find(_ROLE_CLOSE)
+        if end != -1:
+            raw = raw[:end]
+        text, reasoning, call = extract_reasoning_and_tool_call(
+            raw, tool_names=self.tool_names)
+        tool_call = None
+        if call is not None and call.is_done:
+            tool_call = ToolCallRequest(name=call.name,
+                                        params=dict(call.params),
+                                        raw=call.raw)
+        return LLMResponse(
+            text=text, reasoning=reasoning, tool_call=tool_call,
+            usage=LLMUsage(input_tokens=len(prompt_ids),
+                           output_tokens=len(out_ids)),
+            model=self.model_name)

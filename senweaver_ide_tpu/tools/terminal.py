@@ -135,8 +135,11 @@ class TerminalManager:
         if proc is None or proc.poll() is not None:
             raise KeyError(f"no persistent terminal: {terminal_id}")
         # Discard late output from a previous bgtimeout'd command so it is
-        # not misattributed to this one.
-        while proc.stdout.read(65536):  # type: ignore[union-attr]
+        # not misattributed to this one. Bounded: a still-running command
+        # that streams output forever must not wedge the drain.
+        drain_deadline = time.monotonic() + 0.25
+        while (proc.stdout.read(65536)  # type: ignore[union-attr]
+               and time.monotonic() < drain_deadline):
             pass
         start = time.monotonic()
         # Sentinel echo so fast commands resolve immediately instead of

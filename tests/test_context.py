@@ -1,0 +1,234 @@
+"""Context layer tests: estimator, compressor, smart/enhanced managers,
+rate limiter, cache, tracker."""
+
+import pytest
+
+from senweaver_ide_tpu.context import (OVERFLOW_THRESHOLD, PRIORITY, PRUNE,
+                                       EnhancedContextManager, LRUTTLCache,
+                                       MessageInput, PerformanceMonitor,
+                                       SmartContextManager, TokenEstimator,
+                                       TokenUsageRecord, TokenUsageTracker,
+                                       TPMRateLimiter,
+                                       compress_history_to_summary,
+                                       compress_tool_result,
+                                       model_context_limit)
+
+
+# ---- estimator ----
+
+def test_estimator_basic_and_code_bump():
+    est = TokenEstimator()
+    plain = est.estimate("word " * 70)            # 350 chars
+    code = est.estimate("def foo():\n    return 1\n" * 15)
+    assert plain == 100                            # 350 / 3.5
+    assert code > len("def foo():\n    return 1\n" * 15) / 3.5  # 1.2 bump
+    assert est.estimate("") == 0
+
+
+def test_estimator_cache_stable():
+    est = TokenEstimator()
+    t = "x" * 5000
+    assert est.estimate(t) == est.estimate(t)
+
+
+# ---- compressor ----
+
+def test_compress_tool_result_keeps_important():
+    content = "\n".join(
+        ["filler line about nothing " + str(i) for i in range(200)]
+        + ["Error: something broke at /src/app.py"])
+    out = compress_tool_result(content, max_length=2000)
+    assert len(out) <= 2000
+    assert "Error: something broke" in out
+    assert "omitted" in out
+
+
+def test_compress_history_summary_user_only():
+    msgs = [MessageInput("user", "how do I add caching?"),
+            MessageInput("assistant", "Use an LRU. " * 100),
+            MessageInput("user", "what about TTL?")]
+    s = compress_history_to_summary(msgs)
+    assert "what about TTL?" in s and "3 earlier messages" in s
+    assert "LRU. Use" not in s          # assistant content excluded
+
+
+# ---- smart manager ----
+
+def test_build_context_pins_system_and_input():
+    m = SmartContextManager()
+    msgs = [MessageInput("user", f"question {i} " * 50) for i in range(30)]
+    r = m.build_context(msgs, "SYSTEM", "CURRENT?", max_tokens=6000)
+    assert r.parts[0].type == "system"
+    assert r.parts[-1].content == "CURRENT?"
+    assert r.total_tokens <= 6000
+    assert r.compression_ratio < 1.0
+
+
+def test_build_context_generates_summary():
+    m = SmartContextManager()
+    msgs = [MessageInput("user", f"older topic {i} stuff " * 20)
+            for i in range(40)]
+    r = m.build_context(msgs, "S", "now", max_tokens=15000)
+    assert r.summary_generated
+    assert any(p.type == "summary" for p in r.parts)
+
+
+def test_priorities_table():
+    assert PRIORITY["SYSTEM_PROMPT"] == 100
+    assert PRIORITY["TOOL_RESULTS"] == 40
+    assert OVERFLOW_THRESHOLD == 0.55
+
+
+# ---- enhanced manager ----
+
+def test_needs_compaction_threshold():
+    m = EnhancedContextManager()
+    small = [MessageInput("user", "hi")]
+    info = m.check_needs_compaction(small, "qwen2.5-coder-1.5b")
+    assert not info.needs_compaction
+    big = [MessageInput("user", "x" * 40_000) for _ in range(2)]
+    info = m.check_needs_compaction(big, "tiny-test")
+    assert info.needs_compaction and info.context_limit == 2048
+
+
+def test_model_context_limits():
+    assert model_context_limit("Qwen2.5-Coder-7B") == 32_768
+    assert model_context_limit("deepseek-coder-6.7b") == 16_384
+    assert model_context_limit("mystery-model") == 128_000
+
+
+def _tool_msg(i, size):
+    return MessageInput("tool", "y" * size, tool_name="read_file",
+                        tool_id=f"t{i}")
+
+
+def test_prune_large_outputs_always():
+    m = EnhancedContextManager()
+    msgs = [MessageInput("user", "q1"),
+            _tool_msg(1, PRUNE["LARGE_OUTPUT_THRESHOLD"] + 1),
+            MessageInput("user", "q2")]
+    r = m.prune_tool_outputs(msgs)
+    assert r.pruned_count == 1 and m.is_tool_pruned("t1")
+
+
+def test_prune_respects_minimum_gate():
+    m = EnhancedContextManager()
+    # Old small tool outputs below the 15k-token minimum: no prune.
+    msgs = ([MessageInput("user", f"q{i}") for i in range(5)]
+            + [_tool_msg(1, 1000)]
+            + [MessageInput("user", f"r{i}") for i in range(5)])
+    r = m.prune_tool_outputs(msgs)
+    assert r.pruned_count == 0 and not m.is_tool_pruned("t1")
+
+
+def test_prune_protects_recent_turns_and_tools():
+    m = EnhancedContextManager()
+    msgs = []
+    # 10 old turns each with a ~90k-char tool output (≈26k tokens each).
+    for i in range(10):
+        msgs.append(MessageInput("user", f"q{i}"))
+        msgs.append(_tool_msg(i, 45_000))
+    protected = MessageInput("tool", "z" * 45_000,
+                             tool_name="search_pathnames_only",
+                             tool_id="prot")
+    msgs.append(protected)
+    msgs.append(MessageInput("user", "recent1"))
+    recent_tool = _tool_msg(99, 10_000)
+    msgs.append(recent_tool)
+    msgs.append(MessageInput("user", "recent2"))
+    r = m.prune_tool_outputs(msgs)
+    assert r.pruned_count > 0
+    assert not m.is_tool_pruned("prot")        # protected tool name
+    assert not m.is_tool_pruned("t99")         # recent turns protected
+
+
+def test_prepare_drops_pruned_tools():
+    m = EnhancedContextManager()
+    msgs = []
+    for i in range(12):
+        msgs.append(MessageInput("user", f"question {i}"))
+        msgs.append(_tool_msg(i, 60_000))
+    r = m.prepare(msgs, "SYS", "now?", "tiny-test")
+    assert r.total_tokens < 3000
+
+
+# ---- rate limiter ----
+
+def test_rate_limiter_reactive():
+    t = [0.0]
+    rl = TPMRateLimiter(clock=lambda: t[0])
+    assert rl.get_wait_time("local") == 0.0
+    rl.record_request_start("anthropic")
+    assert rl.get_wait_time("anthropic") == pytest.approx(0.1)
+    t[0] += 0.2
+    assert rl.get_wait_time("anthropic") == 0.0
+
+
+def test_rate_limiter_backoff_and_retry_after():
+    t = [0.0]
+    rl = TPMRateLimiter(clock=lambda: t[0])
+    w1 = rl.record_rate_limit_error("openai")
+    assert w1 == 2.0
+    t[0] += 2.0
+    w2 = rl.record_rate_limit_error("openai")
+    assert w2 == 3.0                               # 2 * 1.5
+    w3 = rl.record_rate_limit_error("openai", retry_after_s=12.0)
+    assert w3 == 12.0
+    assert rl.get_wait_time("openai") == pytest.approx(12.0)
+    rl.record_success("openai")
+    assert rl.get_wait_time("openai") == 0.0
+
+
+def test_rate_limit_error_detection():
+    assert TPMRateLimiter.is_rate_limit_error("429 Too Many Requests")
+    assert TPMRateLimiter.is_rate_limit_error(
+        RuntimeError("quota exceeded for model"))
+    assert not TPMRateLimiter.is_rate_limit_error(ValueError("bad input"))
+    assert TPMRateLimiter.extract_retry_after(
+        'error: {"retry_after": 7}') == 7.0
+
+
+# ---- cache ----
+
+def test_cache_lru_ttl():
+    t = [0.0]
+    c = LRUTTLCache(max_size=2, default_ttl_s=10.0, clock=lambda: t[0])
+    c.put("a", 1)
+    c.put("b", 2)
+    assert c.get("a") == 1
+    c.put("c", 3)                  # evicts b (a was refreshed)
+    assert c.get("b") is None and c.get("c") == 3
+    t[0] += 11
+    assert c.get("a") is None      # expired
+    assert c.stats.hits == 2 and c.stats.evictions == 1
+    assert c.stats.expirations == 1
+
+
+def test_cache_get_or_compute():
+    c = LRUTTLCache()
+    calls = []
+    assert c.get_or_compute("k", lambda: calls.append(1) or 42) == 42
+    assert c.get_or_compute("k", lambda: calls.append(1) or 43) == 42
+    assert len(calls) == 1
+
+
+# ---- tracker + perf ----
+
+def test_usage_tracker_savings():
+    tr = TokenUsageTracker()
+    tr.record(TokenUsageRecord("r1", 0.0, model="m",
+                               system_tokens=500, history_tokens=1000,
+                               current_input_tokens=100, output_tokens=200,
+                               original_tokens=8000))
+    s = tr.stats()
+    assert s.total_input_tokens == 1600
+    assert s.total_saved_tokens == 6400
+    assert s.meets_target                          # 80% > 60%
+
+
+def test_performance_monitor_warns():
+    warned = []
+    pm = PerformanceMonitor(on_warning=warned.append)
+    with pm.measure("stage", threshold_ms=0.0):
+        pass
+    assert len(warned) == 1 and warned[0].exceeded

@@ -98,3 +98,66 @@ def test_prompt_too_long_rejected(model):
     eng = RolloutEngine(params, config, num_slots=1, max_len=16)
     with pytest.raises(ValueError, match="max_len"):
         eng.submit(list(range(20)))
+
+
+def test_engine_policy_client_end_to_end():
+    """Full local-policy chat turn: template → tokenize → pool decode →
+    grammar extraction (tiny random model, so text is noise — the contract
+    under test is the pipeline, usage accounting, and window guard)."""
+    import pytest
+
+    from senweaver_ide_tpu.agents.llm import ChatMessage, ContextLengthError
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient, RolloutEngine
+
+    import jax
+
+    config = get_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    tok = ByteTokenizer()
+    engine = RolloutEngine(params, config, num_slots=2, max_len=512,
+                           eos_id=tok.eos_id)
+    client = EnginePolicyClient(engine, tok, model_name="tiny-test")
+    resp = client.chat([ChatMessage("system", "Sys."),
+                        ChatMessage("user", "hi")], max_tokens=8)
+    assert resp.usage.output_tokens <= 8
+    assert resp.usage.input_tokens > 0
+    assert resp.model == "tiny-test"
+    with pytest.raises(ContextLengthError):
+        client.chat([ChatMessage("user", "x" * 600)], max_tokens=8)
+
+
+def test_engine_thread_safety_parallel_clients():
+    """Two threads drive the same engine concurrently (the subagent
+    pattern); outputs must be complete and per-request token counts
+    respected."""
+    import threading
+
+    import jax
+
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient, RolloutEngine
+
+    config = get_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    tok = ByteTokenizer()
+    engine = RolloutEngine(params, config, num_slots=4, max_len=512)
+    client = EnginePolicyClient(engine, tok, model_name="tiny-test")
+    results = {}
+
+    def worker(i):
+        resp = client.chat([ChatMessage("user", f"prompt {i}")],
+                           max_tokens=6)
+        results[i] = resp
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 4
+    for r in results.values():
+        assert 1 <= r.usage.output_tokens <= 6

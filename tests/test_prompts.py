@@ -1,0 +1,191 @@
+"""Prompt engine tests: XML tool-call grammar, reasoning extraction,
+system-message assembly, APO rule budget, 4-phase fitting, capabilities."""
+
+from senweaver_ide_tpu.agents.llm import ChatMessage
+from senweaver_ide_tpu.models.capabilities import (
+    get_model_capabilities, get_reserved_output_token_space)
+from senweaver_ide_tpu.prompts import (APO_RULES_MAX_CHARS,
+                                       ReasoningExtractor,
+                                       chat_system_message,
+                                       extract_reasoning_and_tool_call,
+                                       fit_messages, parse_tool_call,
+                                       render_apo_rules, strip_tool_call)
+
+
+# ---- XML tool-call parsing ----
+
+def test_parse_simple_tool_call():
+    text = ("I'll read the file.\n<read_file>\n<uri>/src/main.py</uri>\n"
+            "</read_file>")
+    call = parse_tool_call(text)
+    assert call.name == "read_file"
+    assert call.params == {"uri": "/src/main.py"}
+    assert call.is_done and call.done_params == ["uri"]
+    assert strip_tool_call(text, call) == "I'll read the file."
+
+
+def test_parse_param_aliases():
+    call = parse_tool_call(
+        "<read_file><path>/a.py</path></read_file>")
+    assert call.params == {"uri": "/a.py"}
+    call = parse_tool_call(
+        "<edit_file><uri>/a.py</uri><blocks>B</blocks></edit_file>")
+    assert call.params["search_replace_blocks"] == "B"
+    call = parse_tool_call(
+        "<search_for_files><keyword>foo</keyword>"
+        "<use_regex>true</use_regex></search_for_files>")
+    assert call.params == {"query": "foo", "is_regex": "true"}
+
+
+def test_parse_multiline_value_preserved():
+    blocks = ("<<<<<<< ORIGINAL\n    a = 1\n=======\n    a = 2\n"
+              ">>>>>>> UPDATED")
+    text = (f"<edit_file>\n<uri>/x.py</uri>\n<search_replace_blocks>\n"
+            f"{blocks}\n</search_replace_blocks>\n</edit_file>")
+    call = parse_tool_call(text)
+    assert call.params["search_replace_blocks"] == blocks
+
+
+def test_parse_unterminated_streaming():
+    call = parse_tool_call("<run_command><command>ls -la")
+    assert call is not None and not call.is_done
+    assert call.params["command"] == "ls -la"
+    assert call.done_params == []
+
+
+def test_parse_no_tool():
+    assert parse_tool_call("just a plain answer") is None
+
+
+def test_parse_first_tool_wins():
+    text = ("<ls_dir><uri>/</uri></ls_dir> then "
+            "<read_file><uri>/a</uri></read_file>")
+    assert parse_tool_call(text).name == "ls_dir"
+
+
+# ---- reasoning extraction ----
+
+def test_reasoning_batch():
+    text, reasoning, call = extract_reasoning_and_tool_call(
+        "<think>step by step</think>The answer is 4.")
+    assert reasoning == "step by step"
+    assert text == "The answer is 4." and call is None
+
+
+def test_reasoning_streaming_partial_tags():
+    r = ReasoningExtractor()
+    stream = "Hello <think>hmm</think> world"
+    # Feed cumulative prefixes of every length (worst-case chunking).
+    for i in range(1, len(stream) + 1):
+        r.feed(stream[:i])
+    text, reasoning = r.finish(stream)
+    assert text == "Hello  world".replace("  ", " ") or text == "Hello  world"
+    assert reasoning == "hmm"
+
+
+def test_reasoning_unterminated_goes_to_reasoning():
+    text, reasoning = ReasoningExtractor().finish(
+        "<think>never closed thoughts")
+    assert text == "" and reasoning == "never closed thoughts"
+
+
+def test_reasoning_with_tool_call():
+    text, reasoning, call = extract_reasoning_and_tool_call(
+        "<think>need the file</think>Reading.\n"
+        "<read_file><uri>/m.py</uri></read_file>")
+    assert reasoning == "need the file"
+    assert call.name == "read_file" and text == "Reading."
+
+
+# ---- system message ----
+
+def test_system_message_sections():
+    msg = chat_system_message(
+        chat_mode="agent", workspace_folders=["/repo"],
+        directory_str="repo/\n└── a.py",
+        apo_rules=["Always verify edits with read_file."],
+        current_datetime="2026-07-29 12:00")
+    assert "# Available tools" in msg and "## edit_file" in msg
+    assert "# Rules" in msg
+    assert "# Workspace structure" in msg
+    assert "# Multi-Agent System" in msg
+    assert "# APO Optimized Rules" in msg
+    assert "Always verify edits" in msg
+
+
+def test_system_message_normal_mode_no_multiagent():
+    msg = chat_system_message(chat_mode="normal")
+    assert "# Multi-Agent System" not in msg
+
+
+def test_apo_rules_budget():
+    rules = [f"rule {i} " + "x" * 100 for i in range(40)]
+    out = render_apo_rules(rules)
+    assert len(out) <= APO_RULES_MAX_CHARS
+    assert out.startswith("# APO Optimized Rules")
+    assert "rule 0" in out and "rule 39" not in out
+    assert render_apo_rules([]) == ""
+
+
+# ---- fitting ----
+
+def _msgs(n_tools=5, tool_size=10_000, sys_size=100):
+    out = [ChatMessage("system", "SYS " * sys_size)]
+    for i in range(n_tools):
+        out.append(ChatMessage("user", f"question {i}"))
+        out.append(ChatMessage("assistant", f"answer {i}"))
+        out.append(ChatMessage("tool", "T" * tool_size))
+    out.append(ChatMessage("user", "FINAL QUESTION"))
+    return out
+
+def test_fit_no_trim_when_fits():
+    r = fit_messages(_msgs(1, 100), context_window=100_000)
+    assert r.phase_reached == 1
+    assert r.chars_after == r.chars_before
+
+
+def test_fit_phase2_trims_tools_first():
+    r = fit_messages(_msgs(8, 20_000), context_window=10_000)
+    assert r.phase_reached >= 2
+    # last user message untouched
+    assert r.messages[-1].content == "FINAL QUESTION"
+    budget = (10_000 - 4096) * 3.5
+    assert r.chars_after <= max(budget, 20_000)
+
+
+def test_fit_phase4_ultimate_fallback():
+    r = fit_messages(_msgs(20, 50_000, sys_size=2000), context_window=500,
+                     reserved_output_tokens=200)
+    assert r.phase_reached == 4
+    roles = [m.role for m in r.messages]
+    assert roles in (["system", "user"], ["user"])
+    assert r.messages[-1].content == "FINAL QUESTION"
+
+
+def test_fit_preserves_system_in_fallback():
+    r = fit_messages(_msgs(20, 50_000), context_window=3000,
+                     reserved_output_tokens=200)
+    if r.phase_reached == 4 and len(r.messages) == 2:
+        assert r.messages[0].role == "system"
+
+
+# ---- capabilities ----
+
+def test_capabilities_lookup():
+    qwen = get_model_capabilities("qwen2.5-coder-1.5b")
+    assert qwen.context_window == 32_768 and qwen.supports_fim
+    assert qwen.fim_tokens[0] == "<|fim_prefix|>"
+    ds = get_model_capabilities("deepseek-coder-6.7b-instruct")
+    assert ds.context_window == 16_384
+    r1 = get_model_capabilities("DeepSeek-R1-Distill")
+    assert r1.reasoning_think_tags == ("<think>", "</think>")
+    assert get_model_capabilities("unknown-llm").context_window == 128_000
+    assert get_reserved_output_token_space("claude-3.5-sonnet") == 8192
+
+
+def test_parse_repeated_same_tool_first_wins():
+    call = parse_tool_call(
+        "<read_file><uri>a.py</uri></read_file> then "
+        "<read_file><uri>b.py</uri></read_file>")
+    assert call.params == {"uri": "a.py"}
+    assert call.raw == "<read_file><uri>a.py</uri></read_file>"
