@@ -39,9 +39,11 @@ class SmartContextManager:
         est = self.estimator.estimate
         original = (est(system_prompt) + est(current_input)
                     + sum(est(m.content) for m in messages))
-        available = max(T.MIN_CONTEXT_TOKENS,
-                        max_tokens - T.RESERVED_OUTPUT_TOKENS
-                        ) * (1 - T.TOKEN_BUFFER_RATIO)
+        # Keep at least MIN_CONTEXT_TOKENS of context on big windows, but
+        # never promise more than the window itself holds (small models).
+        available = min(max(T.MIN_CONTEXT_TOKENS,
+                            max_tokens - T.RESERVED_OUTPUT_TOKENS),
+                        max_tokens) * (1 - T.TOKEN_BUFFER_RATIO)
 
         parts: List[ContextPart] = [
             ContextPart("system", system_prompt, est(system_prompt),
@@ -139,30 +141,38 @@ class SmartContextManager:
     @staticmethod
     def _optimize(parts: List[ContextPart], available: float
                   ) -> tuple[List[ContextPart], int, int]:
-        """Drop lowest-priority compressible parts until under budget."""
-        keep = sorted(parts, key=lambda p: -p.priority)
-        total = sum(p.tokens for p in keep)
+        """Evict lowest-priority parts first — and within a priority tier
+        the OLDEST first — until under budget; survivors keep their
+        original insertion (chronological) order."""
+        index = {id(p): i for i, p in enumerate(parts)}
+        victims = sorted(parts, key=lambda p: (p.priority, index[id(p)]))
+        total = sum(p.tokens for p in parts)
+        dropped: set[int] = set()
         removed = 0
-        while total > available and keep:
-            victim_idx = None
-            for i in range(len(keep) - 1, -1, -1):
-                if keep[i].compressible or keep[i].priority < 99:
-                    victim_idx = i
-                    break
-            if victim_idx is None:
+        for v in victims:
+            if total <= available:
                 break
-            total -= keep.pop(victim_idx).tokens
+            if not v.compressible and v.priority >= 99:
+                continue        # system prompt / current input pinned
+            dropped.add(id(v))
+            total -= v.tokens
             removed += 1
+        keep = [p for p in parts if id(p) not in dropped]
         return keep, int(total), removed
 
     @staticmethod
     def _sort_logical(parts: List[ContextPart]) -> None:
-        """system → summary → history in timestamp/insertion order →
-        current input last."""
-        order = {"system": 0, "summary": 1}
-        parts.sort(key=lambda p: (order.get(p.type, 2),
-                                  0 if p.priority != T.PRIORITY[
-                                      "CURRENT_INPUT"] else 1))
+        """system → summary → history (stable: keeps chronological
+        insertion order) → current input last."""
+        def bucket(p: ContextPart) -> int:
+            if p.type == "system":
+                return 0
+            if p.type == "summary":
+                return 1
+            if p.priority == T.PRIORITY["CURRENT_INPUT"]:
+                return 3
+            return 2
+        parts.sort(key=bucket)       # list.sort is stable
 
 
 @dataclasses.dataclass
@@ -189,10 +199,13 @@ class EnhancedContextManager:
         """checkNeedsCompaction (ref :713-731)."""
         est = self.estimator.estimate
         total = sum(est(m.content) for m in messages)
-        limit = self.model_context_limit(model_name)
-        # Clamp: windows smaller than the output reservation (test models)
-        # must not produce a negative budget and a vacuously-false trigger.
-        available = max(1, limit - T.RESERVED_OUTPUT_TOKENS)
+        # Per-model window AND output reservation from the capability DB —
+        # the single source of truth (a flat 4k reserve would consume a
+        # small model's whole window and force compaction on every call).
+        from ..models.capabilities import get_model_capabilities
+        caps = get_model_capabilities(model_name)
+        limit = caps.context_window
+        available = max(1, limit - caps.reserved_output_token_space)
         usage = total / available
         return TokenUsageInfo(
             total_tokens=total, context_limit=limit,

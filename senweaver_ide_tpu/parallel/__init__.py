@@ -5,3 +5,8 @@ from .ring_attention import (chunk_attention_lse, make_ring_attention,
                              ring_attention, ulysses_attention)
 from .sharding import (ACT_SPEC, KV_CACHE_SPEC, LOGITS_SPEC, PARAM_SPECS,
                        param_shardings, param_specs, shard_params)
+from .distributed import (AXIS_ORDER, DistributedConfig, initialize,
+                          make_named_mesh)
+from .expert import (MoEConfig, init_moe_params, moe_ffn, moe_ffn_sharded)
+from .pipeline import (pipeline_forward, place_pipeline_params,
+                       split_layers_for_stages, stage_param_specs)

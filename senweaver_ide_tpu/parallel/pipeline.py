@@ -1,0 +1,156 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a 'pp' axis.
+
+No reference counterpart exists (SURVEY.md §2.7: the reference has no
+compute parallelism) — this is designed TPU-first: transformer blocks are
+stage-sliced along their stacked layer axis, each stage lives on one 'pp'
+mesh rank, and activations flow stage-to-stage with ``lax.ppermute`` over
+ICI neighbors inside ``shard_map``. The schedule is the classic GPipe
+pipeline: M microbatches drain through K stages in M+K−1 ticks, with
+bubble fraction (K−1)/(M+K−1); differentiable end-to-end (ppermute's
+transpose is the reverse permute), so the same code path serves training.
+
+Embedding, final norm, and the LM head run replicated outside the
+pipelined region (they are cheap relative to the blocks; the blocks carry
+the FLOPs that matter for the MXU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from ..models.config import ModelConfig
+from ..models.transformer import Params, _layer
+from ..ops.norms import rms_norm
+from ..ops.rotary import rope_cos_sin
+
+
+def split_layers_for_stages(params: Params, n_stages: int) -> Params:
+    """Reshape stacked layer leaves (L, ...) → (n_stages, L//n_stages, ...).
+
+    The leading stage axis is what gets sharded over 'pp'."""
+    L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+    if L % n_stages != 0:
+        raise ValueError(f"num_layers {L} not divisible by {n_stages} "
+                         "pipeline stages")
+    per = L // n_stages
+    out = dict(params)
+    out["layers"] = jax.tree_util.tree_map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]),
+        params["layers"])
+    return out
+
+
+def stage_param_specs(params: Params) -> Params:
+    """PartitionSpecs: stage-split layers on 'pp', everything else
+    replicated."""
+    out = {k: (jax.tree_util.tree_map(lambda x: P("pp"), v)
+               if k == "layers" else jax.tree_util.tree_map(lambda x: P(), v))
+           for k, v in params.items()}
+    return out
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("config", "mesh", "n_microbatches"))
+def pipeline_forward(params: Params, config: ModelConfig,
+                     tokens: jax.Array, *, mesh: Mesh,
+                     n_microbatches: int = 4,
+                     attn_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Full forward with the transformer blocks pipelined over 'pp'.
+
+    ``params`` must be pre-split (split_layers_for_stages) and placed with
+    stage_param_specs shardings. tokens: (B, S); B divisible by
+    n_microbatches. Returns fp32 logits (B, S, V)."""
+    c = config
+    K = mesh.shape["pp"]
+    M = n_microbatches
+    b, s = tokens.shape
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    mb = b // M
+
+    x = params["embed"][tokens]                          # (B, S, D)
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                                 (b, s))
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    mb_x = x.reshape(M, mb, s, c.hidden_size)
+    mb_cos = cos.reshape(M, mb, *cos.shape[1:])
+    mb_sin = sin.reshape(M, mb, *sin.shape[1:])
+    mb_mask = (attn_mask.reshape(M, mb, *attn_mask.shape[1:])
+               if attn_mask is not None else None)
+
+    def stage_apply(stage_lp, h, cos_mb, sin_mb, mask_mb):
+        def body(hh, lp):
+            hh, _ = _layer(c, lp, hh, cos_mb, sin_mb, None, mask_mb)
+            return hh, None
+        h, _ = jax.lax.scan(body, h, stage_lp)
+        return h
+
+    perm = [(i, (i + 1) % K) for i in range(K)]
+
+    def pp_fn(stage_lp, mb_x, mb_cos, mb_sin, mb_mask):
+        # Inside shard_map: stage_lp leaves lost their leading 'pp' axis
+        # slice → (1, per, ...); squeeze it.
+        stage_lp = jax.tree_util.tree_map(lambda a: a[0], stage_lp)
+        stage = jax.lax.axis_index("pp")
+
+        def tick(carry, t):
+            prev_out = carry
+            recv = jax.lax.ppermute(prev_out, "pp", perm)
+            i = jnp.clip(t, 0, M - 1)
+            first_in = jax.lax.dynamic_index_in_dim(mb_x, i, 0,
+                                                    keepdims=False)
+            my_in = jnp.where(stage == 0, first_in, recv)
+            cos_mb = jax.lax.dynamic_index_in_dim(mb_cos, i, 0, False)
+            sin_mb = jax.lax.dynamic_index_in_dim(mb_sin, i, 0, False)
+            mask_mb = (jax.lax.dynamic_index_in_dim(mb_mask, i, 0, False)
+                       if mb_mask is not None else None)
+            out = stage_apply(stage_lp, my_in, cos_mb, sin_mb, mask_mb)
+            return out, out
+
+        init = jnp.zeros((mb, s, c.hidden_size), mb_x.dtype)
+        _, ys = jax.lax.scan(tick, init,
+                             jnp.arange(M + K - 1, dtype=jnp.int32))
+        # Stage K-1 produced microbatch m at tick m + K - 1.
+        outs = ys[K - 1:]                                # (M, mb, s, D)
+        outs = jnp.where(stage == K - 1, outs, 0.0)
+        return jax.lax.psum(outs, "pp")                  # broadcast result
+
+    in_specs = (stage_param_specs(params)["layers"], P(), P(), P(),
+                P() if mb_mask is not None else None)
+    args = (params["layers"], mb_x, mb_cos, mb_sin, mb_mask)
+    if mb_mask is None:
+        in_specs = in_specs[:4]
+        args = args[:4]
+
+        def pp_fn_nomask(lp, a, b_, c_):
+            return pp_fn(lp, a, b_, c_, None)
+        fn = pp_fn_nomask
+    else:
+        fn = pp_fn
+    outs = shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                     check_rep=False)(*args)
+    x = outs.reshape(b, s, c.hidden_size)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
+    else:
+        logits = jnp.einsum("bsd,dv->bsv", x, head)
+    return logits.astype(jnp.float32)
+
+
+def place_pipeline_params(params: Params, mesh: Mesh) -> Params:
+    """Device-put pre-split params with stage shardings."""
+    from jax.sharding import NamedSharding
+    specs = stage_param_specs(params)
+    return jax.tree_util.tree_map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+        params, specs)

@@ -207,9 +207,12 @@ def extract_reasoning_and_tool_call(
         think_tags: Tuple[str, str] = THINK_TAGS
 ) -> Tuple[str, str, Optional[RawToolCall]]:
     """Batch path used by the rollout engine: returns (visible_text,
-    reasoning, tool_call or None)."""
+    reasoning, tool_call or None). Only COMPLETE tool calls are stripped
+    from the text — a partial call (generation budget hit mid-XML) stays
+    in the visible text so history and RL traces keep exactly what the
+    policy generated."""
     text, reasoning = ReasoningExtractor(think_tags).finish(full_text)
     call = parse_tool_call(text, tool_names=tool_names)
-    if call is not None:
+    if call is not None and call.is_done:
         text = strip_tool_call(text, call)
     return text, reasoning, call

@@ -4,3 +4,4 @@ from .schema import (ChatMode, Feedback, Span, SpanData, SpanType, ToolNameStats
 from .collector import TraceCollector
 from .store import TraceStore, export_data
 from .features import (N_FEATURES, FEATURE_NAMES, trace_features, batch_features)
+from .uploader import TraceUploader, UPLOAD_BATCH_SIZE

@@ -1,0 +1,78 @@
+"""Incremental, deduplicated trace upload.
+
+traceCollectorService.ts:797-899 (`_uploadTraces`): batch unsent traces to
+POST /api/traces with fire-and-forget semantics, then persist uploaded IDs
+(:944-966) so restarts never re-send. In the TPU build the 'backend' is a
+pluggable transport — by default the training-side dataset ingest (the
+GRPO data pipeline consumes traces instead of a SaaS endpoint), but any
+callable(list[dict]) -> bool works (e.g. HTTP for a real fleet).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .schema import Trace
+
+UPLOAD_BATCH_SIZE = 50             # ref batches uploads
+
+
+class TraceUploader:
+    def __init__(self, transport: Callable[[List[Dict]], bool], *,
+                 uploaded_ids_path: Optional[str] = None,
+                 batch_size: int = UPLOAD_BATCH_SIZE):
+        self.transport = transport
+        self.batch_size = batch_size
+        self._path = uploaded_ids_path
+        self._uploaded: set[str] = set()
+        self._lock = threading.Lock()
+        if self._path and os.path.exists(self._path):
+            try:
+                with open(self._path) as f:
+                    self._uploaded = set(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                self._uploaded = set()
+
+    @property
+    def uploaded_count(self) -> int:
+        return len(self._uploaded)
+
+    def is_uploaded(self, trace_id: str) -> bool:
+        return trace_id in self._uploaded
+
+    def upload(self, traces: Iterable[Trace]) -> int:
+        """Upload unsent, ended traces in batches; returns how many were
+        newly uploaded. A failed batch marks nothing (retried next cycle —
+        the reference's silent-catch + next-interval behavior)."""
+        with self._lock:
+            pending = [t for t in traces
+                       if t.id not in self._uploaded
+                       and t.end_time is not None]
+            sent = 0
+            for i in range(0, len(pending), self.batch_size):
+                batch = pending[i:i + self.batch_size]
+                try:
+                    ok = self.transport([t.to_dict() for t in batch])
+                except Exception:
+                    ok = False
+                if not ok:
+                    break
+                self._uploaded.update(t.id for t in batch)
+                sent += len(batch)
+            if sent:
+                self._persist()
+            return sent
+
+    def _persist(self) -> None:
+        if not self._path:
+            return
+        tmp = self._path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(sorted(self._uploaded), f)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass

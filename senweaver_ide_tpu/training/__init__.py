@@ -1,3 +1,4 @@
 from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 from .trainer import (TrainState, make_optimizer, make_train_state, train_step)
+from .checkpoint import CheckpointManager

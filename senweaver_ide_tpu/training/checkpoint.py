@@ -1,0 +1,148 @@
+"""Train-state checkpointing with deterministic resume.
+
+The TPU analogue of the reference's persistence plane (SURVEY.md §5
+Checkpoint/resume): where the reference shards threads/traces into
+IStorageService and flushes on timers, the trainer persists
+params/optimizer/step with Orbax (async-capable, sharding-aware) plus a
+JSON metadata sidecar carrying the data-order cursor — so a resumed run
+continues from the exact batch it stopped at (deterministic data order,
+SURVEY.md §7 step 5).
+
+Falls back to a pure-numpy .npz format when Orbax is unavailable; both
+formats restore onto an arbitrary device mesh (restored arrays are
+re-sharded by the caller's shardings).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .trainer import TrainState
+
+_STEP_DIR_RE = re.compile(r"^step_(\d+)$")
+
+
+def _meta_path(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{step}", "meta.json")
+
+
+class CheckpointManager:
+    """Directory-per-step checkpoints: <root>/step_N/{state, meta.json}.
+
+    keep_last bounds disk use the way MAX_TRACES bounds the trace store
+    (traceCollectorService.ts:219)."""
+
+    def __init__(self, root: str, *, keep_last: int = 3,
+                 use_orbax: Optional[bool] = None):
+        self.root = root
+        self.keep_last = keep_last
+        os.makedirs(root, exist_ok=True)
+        if use_orbax is None:
+            try:
+                import orbax.checkpoint  # noqa: F401
+                use_orbax = True
+            except Exception:
+                use_orbax = False
+        self.use_orbax = use_orbax
+
+    # -- public ------------------------------------------------------------
+    def save(self, state: TrainState, *,
+             data_cursor: int = 0,
+             extra_meta: Optional[Dict[str, Any]] = None) -> str:
+        step = int(jax.device_get(state.step))
+        step_dir = os.path.join(self.root, f"step_{step}")
+        os.makedirs(step_dir, exist_ok=True)
+        if self.use_orbax:
+            self._save_orbax(step_dir, state)
+        else:
+            self._save_npz(step_dir, state)
+        meta = {"step": step, "data_cursor": int(data_cursor),
+                "format": "orbax" if self.use_orbax else "npz"}
+        if extra_meta:
+            meta.update(extra_meta)
+        tmp = _meta_path(self.root, step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, _meta_path(self.root, step))
+        self._gc()
+        return step_dir
+
+    def latest_step(self) -> Optional[int]:
+        steps = []
+        for name in os.listdir(self.root):
+            m = _STEP_DIR_RE.match(name)
+            # Only complete checkpoints (meta written last) count.
+            if m and os.path.exists(_meta_path(self.root, int(m.group(1)))):
+                steps.append(int(m.group(1)))
+        return max(steps) if steps else None
+
+    def restore(self, template: TrainState,
+                step: Optional[int] = None
+                ) -> Tuple[TrainState, Dict[str, Any]]:
+        """Restore into the structure of ``template`` (shapes/dtypes/tree
+        must match). Returns (state, meta)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.root}")
+        step_dir = os.path.join(self.root, f"step_{step}")
+        with open(_meta_path(self.root, step)) as f:
+            meta = json.load(f)
+        if meta.get("format") == "orbax":
+            state = self._restore_orbax(step_dir, template)
+        else:
+            state = self._restore_npz(step_dir, template)
+        return state, meta
+
+    # -- orbax backend -----------------------------------------------------
+    def _save_orbax(self, step_dir: str, state: TrainState) -> None:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.join(step_dir, "state"),
+                   jax.device_get(state._asdict()), force=True)
+
+    def _restore_orbax(self, step_dir: str,
+                       template: TrainState) -> TrainState:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.join(step_dir, "state"),
+                                 item=jax.device_get(template._asdict()))
+        return TrainState(**restored)
+
+    # -- npz fallback ------------------------------------------------------
+    @staticmethod
+    def _flatten(state: TrainState):
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        return leaves, treedef
+
+    def _save_npz(self, step_dir: str, state: TrainState) -> None:
+        leaves, _ = self._flatten(state)
+        arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+                  for i, x in enumerate(leaves)}
+        tmp = os.path.join(step_dir, "state.npz.tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+        os.replace(tmp, os.path.join(step_dir, "state.npz"))
+
+    def _restore_npz(self, step_dir: str,
+                     template: TrainState) -> TrainState:
+        leaves, treedef = self._flatten(template)
+        with np.load(os.path.join(step_dir, "state.npz")) as data:
+            restored = [data[f"leaf_{i}"] for i in range(len(leaves))]
+        return jax.tree_util.tree_unflatten(treedef, restored)
+
+    # -- gc ----------------------------------------------------------------
+    def _gc(self) -> None:
+        import shutil
+        steps = sorted(
+            int(m.group(1)) for m in
+            (_STEP_DIR_RE.match(n) for n in os.listdir(self.root)) if m)
+        for s in steps[:-self.keep_last] if self.keep_last else []:
+            shutil.rmtree(os.path.join(self.root, f"step_{s}"),
+                          ignore_errors=True)

@@ -1,0 +1,165 @@
+"""Checkpoint/resume tests: train-state save/restore (orbax + npz),
+data-cursor resume, GC; conversation checkpoints + file snapshots; trace
+upload dedup."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from senweaver_ide_tpu.agents.llm import ChatMessage
+from senweaver_ide_tpu.models import get_config
+from senweaver_ide_tpu.rollout import ConversationCheckpoints
+from senweaver_ide_tpu.tools import Workspace
+from senweaver_ide_tpu.traces import TraceCollector, TraceUploader
+from senweaver_ide_tpu.training import (CheckpointManager, make_train_state,
+                                        train_step)
+
+
+@pytest.fixture(scope="module")
+def tiny_state():
+    config = get_config("tiny-test")
+    state = make_train_state(config, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    return config, state
+
+
+def _advance(config, state, steps=1):
+    b, s = 4, 16
+    tokens = jnp.ones((b, s), jnp.int32)
+    mask = jnp.ones((b, s), jnp.bool_)
+    rewards = jnp.linspace(-1, 1, b)
+    gids = jnp.zeros((b,), jnp.int32)
+    for _ in range(steps):
+        state, _ = train_step(state, config, None, tokens, mask, rewards,
+                              gids)
+    return state
+
+
+@pytest.mark.parametrize("use_orbax", [False, True])
+def test_save_restore_roundtrip(tmp_path, tiny_state, use_orbax):
+    config, state0 = tiny_state
+    state1 = _advance(config, state0, 2)
+    mgr = CheckpointManager(str(tmp_path / "ck"), use_orbax=use_orbax)
+    mgr.save(state1, data_cursor=128)
+    restored, meta = mgr.restore(state0)
+    assert meta["data_cursor"] == 128
+    assert int(restored.step) == int(state1.step)
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(state1.params)):
+        assert jnp.allclose(jnp.asarray(a), jnp.asarray(b))
+
+
+def test_resume_continues_identically(tmp_path, tiny_state):
+    """save@N → restore → step == just stepping (deterministic resume)."""
+    config, state0 = tiny_state
+    sN = _advance(config, state0, 2)
+    mgr = CheckpointManager(str(tmp_path / "ck2"), use_orbax=False)
+    mgr.save(sN)
+    restored, _ = mgr.restore(state0)
+    a = _advance(config, sN, 1)
+    b = _advance(config, restored, 1)
+    la = jax.tree_util.tree_leaves(a.params)
+    lb = jax.tree_util.tree_leaves(b.params)
+    for x, y in zip(la, lb):
+        assert jnp.allclose(jnp.asarray(x), jnp.asarray(y))
+
+
+def test_gc_keeps_last(tmp_path, tiny_state):
+    config, state = tiny_state
+    mgr = CheckpointManager(str(tmp_path / "ck3"), keep_last=2,
+                            use_orbax=False)
+    for _ in range(4):
+        state = _advance(config, state, 1)
+        mgr.save(state)
+    steps = sorted(int(p.name.split("_")[1])
+                   for p in (tmp_path / "ck3").iterdir()
+                   if p.name.startswith("step_"))
+    assert len(steps) == 2
+    assert mgr.latest_step() == steps[-1]
+
+
+def test_restore_missing_raises(tmp_path, tiny_state):
+    _, state = tiny_state
+    mgr = CheckpointManager(str(tmp_path / "empty"), use_orbax=False)
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(state)
+
+
+# ---- conversation checkpoints ----
+
+def test_conversation_checkpoint_jump(tmp_path):
+    ws = Workspace(tmp_path / "sb")
+    ws.write_file("a.py", "v1")
+    cc = ConversationCheckpoints(ws)
+    msgs = [ChatMessage("user", "turn1")]
+    cc.add_checkpoint(0)
+
+    # Turn 1 edits a.py and creates b.py.
+    cc.snapshotter.ensure_before_state("a.py")
+    ws.write_file("a.py", "v2")
+    cc.snapshotter.ensure_before_state("b.py")
+    ws.write_file("b.py", "new")
+    msgs += [ChatMessage("assistant", "edited"), ChatMessage("user", "turn2")]
+    cc.add_checkpoint(2)
+
+    # Turn 2 edits a.py again.
+    cc.snapshotter.ensure_before_state("a.py")
+    ws.write_file("a.py", "v3")
+    msgs += [ChatMessage("assistant", "edited again")]
+
+    # Jump back before turn 2 → a.py == v2, b.py still exists.
+    out = cc.jump_to_before_message(2, msgs)
+    assert [m.content for m in out] == ["turn1", "edited"]
+    assert ws.read_text("a.py") == "v2"
+    assert ws.read_text("b.py") == "new"
+
+    # Edit again then jump to the beginning → v1, b.py gone.
+    cc.snapshotter.ensure_before_state("a.py")
+    ws.write_file("a.py", "v4")
+    out = cc.jump_to_before_message(0, out)
+    assert out == []
+    assert ws.read_text("a.py") == "v1"
+    assert not (ws.root / "b.py").exists()
+
+
+def test_checkpoint_duplicate_guard(tmp_path):
+    ws = Workspace(tmp_path / "sb2")
+    cc = ConversationCheckpoints(ws)
+    assert cc.add_checkpoint(0) is not None
+    assert cc.add_checkpoint(0) is None
+
+
+# ---- trace upload dedup ----
+
+def _make_ended_trace(collector, thread, fb="good"):
+    tid = collector.start_trace(thread)
+    collector.record_user_message(thread, 0, "q")
+    collector.record_user_feedback(thread, 0, fb)
+    collector.end_trace_for_thread(thread)
+    return tid
+
+
+def test_uploader_dedup_and_persistence(tmp_path):
+    tc = TraceCollector()
+    for i in range(3):
+        _make_ended_trace(tc, f"t{i}")
+    sent_batches = []
+    ids_path = str(tmp_path / "uploaded.json")
+    up = TraceUploader(lambda batch: sent_batches.append(batch) or True,
+                       uploaded_ids_path=ids_path)
+    traces = list(tc._traces.values())
+    assert up.upload(traces) == 3
+    assert up.upload(traces) == 0          # dedup
+    # Restart: IDs persisted.
+    up2 = TraceUploader(lambda b: True, uploaded_ids_path=ids_path)
+    assert up2.upload(traces) == 0
+
+
+def test_uploader_failed_batch_not_marked():
+    tc = TraceCollector()
+    _make_ended_trace(tc, "t0")
+    up = TraceUploader(lambda b: False)
+    traces = list(tc._traces.values())
+    assert up.upload(traces) == 0
+    up.transport = lambda b: True
+    assert up.upload(traces) == 1

@@ -232,3 +232,31 @@ def test_performance_monitor_warns():
     with pm.measure("stage", threshold_ms=0.0):
         pass
     assert len(warned) == 1 and warned[0].exceeded
+
+
+def test_build_context_chronological_order_on_overflow():
+    m = SmartContextManager()
+    msgs = [MessageInput("user", f"Q{i} " + "pad " * 300) for i in range(12)]
+    r = m.build_context(msgs, "S", "NOW", max_tokens=6000)
+    history = [p for p in r.parts if p.type == "user"
+               and p.content != "NOW"]
+    nums = [int(p.content.split()[0][1:]) for p in history]
+    assert nums == sorted(nums)                 # chronological
+    assert r.parts[-1].content == "NOW"
+    assert r.parts[0].type == "system"
+
+
+def test_compaction_uses_capability_reserve():
+    m = EnhancedContextManager()
+    info = m.check_needs_compaction([MessageInput("user", "hi")],
+                                    "tiny-test")
+    # tiny-test: window 2048, reserve 256 -> 1792 available, tiny usage.
+    assert info.available_tokens == 1792
+    assert not info.needs_compaction
+
+
+def test_build_context_respects_small_window():
+    m = SmartContextManager()
+    msgs = [MessageInput("user", "word " * 500) for _ in range(6)]
+    r = m.build_context(msgs, "S", "now", max_tokens=1792)
+    assert r.total_tokens <= 1792

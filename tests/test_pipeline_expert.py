@@ -1,0 +1,95 @@
+"""Pipeline + expert parallelism tests on the CPU-simulated 8-device mesh
+(conftest forces JAX_PLATFORMS=cpu with xla_force_host_platform_device_count).
+Parity contract: sharded paths match the dense single-device reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import forward, get_config, init_params
+from senweaver_ide_tpu.parallel import (MoEConfig, init_moe_params,
+                                        make_named_mesh, moe_ffn,
+                                        moe_ffn_sharded, pipeline_forward,
+                                        place_pipeline_params,
+                                        split_layers_for_stages)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    config = get_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def test_pipeline_matches_dense(tiny):
+    config, params = tiny
+    mesh = make_named_mesh({"pp": 2}, devices=jax.devices()[:2])
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                config.vocab_size)
+    ref_logits, _ = forward(params, config, tokens)
+    pp_params = place_pipeline_params(
+        split_layers_for_stages(params, 2), mesh)
+    out = pipeline_forward(pp_params, config, tokens, mesh=mesh,
+                           n_microbatches=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_grad_flows(tiny):
+    config, params = tiny
+    mesh = make_named_mesh({"pp": 2}, devices=jax.devices()[:2])
+    tokens = jnp.ones((4, 8), jnp.int32)
+    pp_params = place_pipeline_params(
+        split_layers_for_stages(params, 2), mesh)
+
+    def loss(p):
+        return pipeline_forward(p, config, tokens, mesh=mesh,
+                                n_microbatches=2).mean()
+
+    g = jax.grad(loss)(pp_params)
+    gnorm = sum(float(jnp.sum(jnp.abs(x)))
+                for x in jax.tree_util.tree_leaves(g["layers"]))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
+def test_pipeline_rejects_bad_stage_split(tiny):
+    config, params = tiny
+    with pytest.raises(ValueError):
+        split_layers_for_stages(params, 3)   # tiny-test layers % 3 != 0
+
+
+def test_moe_dense_shapes_and_aux():
+    cfg = MoEConfig(hidden_size=16, intermediate_size=32, num_experts=4,
+                    top_k=2)
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 16))
+    out, aux = moe_ffn(params, cfg, x)
+    assert out.shape == x.shape
+    # Balanced-ish routing on random input: aux near 1 (perfect balance=1).
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_moe_sharded_matches_dense():
+    cfg = MoEConfig(hidden_size=16, intermediate_size=32, num_experts=4,
+                    top_k=2, capacity_factor=4.0)   # high cap: no drops
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_named_mesh({"ep": 2}, devices=jax.devices()[:2])
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16))
+    dense_out, _ = moe_ffn(params, cfg, x)
+    shard_out, _ = moe_ffn_sharded(params, cfg, x, mesh=mesh)
+    # Different token→capacity orderings between the two paths only matter
+    # under overflow; with ample capacity results must match.
+    np.testing.assert_allclose(np.asarray(shard_out),
+                               np.asarray(dense_out), rtol=2e-4, atol=2e-4)
+
+
+def test_moe_capacity_drops_tokens():
+    cfg = MoEConfig(hidden_size=8, intermediate_size=16, num_experts=2,
+                    top_k=1, capacity_factor=0.26)  # tiny capacity
+    params = init_moe_params(cfg, jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 16, 8))
+    out, _ = moe_ffn(params, cfg, x)
+    # Some tokens must be dropped (zero output rows).
+    flat = np.asarray(out).reshape(-1, 8)
+    assert (np.abs(flat).sum(-1) == 0).any()

@@ -189,3 +189,10 @@ def test_parse_repeated_same_tool_first_wins():
         "<read_file><uri>b.py</uri></read_file>")
     assert call.params == {"uri": "a.py"}
     assert call.raw == "<read_file><uri>a.py</uri></read_file>"
+
+
+def test_partial_tool_call_stays_in_text():
+    text, _, call = extract_reasoning_and_tool_call(
+        "Reading.\n<read_file><uri>/a.py")
+    assert call is not None and not call.is_done
+    assert "<read_file>" in text       # partial XML preserved for history
