@@ -1,0 +1,146 @@
+// senweaver-ctl — native job-control CLI for the trainer runtime.
+//
+// Role: the reference ships a 17.5k-LoC Rust `code-cli` (cli/src/) doing
+// tunnels/auth/json_rpc/msgpack_rpc against its server. Rust is not in
+// this image (SURVEY.md §2.6), so this is the C++ equivalent scoped to
+// the trainer: JSON-RPC 2.0 over a unix domain socket to the Python
+// control server (senweaver_ide_tpu/runtime/control.py).
+//
+// Usage:
+//   senweaver-ctl [--socket PATH] ping
+//   senweaver-ctl [--socket PATH] status
+//   senweaver-ctl [--socket PATH] submit '<params-json>'
+//   senweaver-ctl [--socket PATH] stop <job_id>
+//   senweaver-ctl [--socket PATH] call <method> ['<params-json>']
+//
+// Prints the raw JSON-RPC response to stdout; exit 0 on a "result"
+// response, 2 on an "error" response, 1 on transport failure.
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+const char* kDefaultSocket = "/tmp/senweaver-ctl.sock";
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 8);
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+bool looks_like_json(const std::string& s) {
+  for (char c : s) {
+    if (c == ' ' || c == '\t') continue;
+    return c == '{' || c == '[' || c == '"' || (c >= '0' && c <= '9') ||
+           c == 't' || c == 'f' || c == 'n' || c == '-';
+  }
+  return false;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* socket_path = kDefaultSocket;
+  int argi = 1;
+  if (argi + 1 < argc && std::strcmp(argv[argi], "--socket") == 0) {
+    socket_path = argv[argi + 1];
+    argi += 2;
+  }
+  if (argi >= argc) {
+    std::fprintf(stderr,
+                 "usage: senweaver-ctl [--socket PATH] "
+                 "<ping|status|submit|stop|call> [args]\n");
+    return 1;
+  }
+
+  std::string cmd = argv[argi++];
+  std::string method, params = "null";
+  if (cmd == "ping" || cmd == "status") {
+    method = cmd;
+  } else if (cmd == "submit") {
+    method = "submit";
+    if (argi < argc) params = argv[argi++];
+  } else if (cmd == "stop") {
+    method = "stop";
+    if (argi >= argc) {
+      std::fprintf(stderr, "stop requires a job id\n");
+      return 1;
+    }
+    params = std::string("{\"job_id\": \"") + json_escape(argv[argi++]) +
+             "\"}";
+  } else if (cmd == "call") {
+    if (argi >= argc) {
+      std::fprintf(stderr, "call requires a method name\n");
+      return 1;
+    }
+    method = argv[argi++];
+    if (argi < argc) params = argv[argi++];
+  } else {
+    std::fprintf(stderr, "unknown command: %s\n", cmd.c_str());
+    return 1;
+  }
+  if (!looks_like_json(params)) {
+    params = "\"" + json_escape(params) + "\"";
+  }
+
+  std::string request = std::string("{\"jsonrpc\": \"2.0\", \"id\": 1, ") +
+                        "\"method\": \"" + json_escape(method) +
+                        "\", \"params\": " + params + "}\n";
+
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    std::fprintf(stderr, "connect %s: %s\n", socket_path,
+                 std::strerror(errno));
+    ::close(fd);
+    return 1;
+  }
+  size_t off = 0;
+  while (off < request.size()) {
+    ssize_t w = ::write(fd, request.data() + off, request.size() - off);
+    if (w <= 0) {
+      std::perror("write");
+      ::close(fd);
+      return 1;
+    }
+    off += (size_t)w;
+  }
+  ::shutdown(fd, SHUT_WR);
+
+  std::string response;
+  char buf[4096];
+  ssize_t r;
+  while ((r = ::read(fd, buf, sizeof buf)) > 0) response.append(buf, (size_t)r);
+  ::close(fd);
+  std::printf("%s\n", response.c_str());
+  return response.find("\"error\"") != std::string::npos ? 2 : 0;
+}

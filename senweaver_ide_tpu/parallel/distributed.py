@@ -27,12 +27,27 @@ class DistributedConfig:
     process_id: Optional[int] = None
 
 
+_initialized = False
+
+
 def initialize(cfg: DistributedConfig = DistributedConfig()) -> None:
     """Idempotent jax.distributed.initialize — env-driven defaults (TPU
     pods populate them), explicit overrides for DCN-connected CPU/GPU
-    test rigs. Single-process runs are a no-op."""
-    if jax.process_count() > 1:
-        return  # already initialized
+    test rigs. Single-process runs are a no-op.
+
+    The guard must NOT touch jax.devices()/process_count(): those force
+    XLA backend initialization, after which distributed init is illegal —
+    so check the distributed client state directly."""
+    global _initialized
+    if _initialized:
+        return
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            _initialized = True
+            return
+    except ImportError:
+        pass
     addr = cfg.coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     nproc = cfg.num_processes if cfg.num_processes is not None else (
@@ -44,6 +59,7 @@ def initialize(cfg: DistributedConfig = DistributedConfig()) -> None:
         coordinator_address=addr, num_processes=nproc,
         process_id=cfg.process_id if cfg.process_id is not None
         else int(os.environ.get("JAX_PROCESS_ID", "0")))
+    _initialized = True
 
 
 # Axis order: slowest (DCN-friendly) → fastest (ICI-neighbor-friendly).

@@ -103,7 +103,10 @@ def pipeline_forward(params: Params, config: ModelConfig,
         def tick(carry, t):
             prev_out = carry
             recv = jax.lax.ppermute(prev_out, "pp", perm)
-            i = jnp.clip(t, 0, M - 1)
+            # Stage k at tick t is processing microbatch t−k, so every
+            # per-microbatch input (mask, rope) must be gathered at that
+            # index — not at the tick counter.
+            i = jnp.clip(t - stage, 0, M - 1)
             first_in = jax.lax.dynamic_index_in_dim(mb_x, i, 0,
                                                     keepdims=False)
             my_in = jnp.where(stage == 0, first_in, recv)

@@ -1,0 +1,149 @@
+"""JSON-RPC job-control server for the trainer runtime.
+
+The server side of senweaver-ctl (native/senweaver_ctl.cpp): a unix-socket
+JSON-RPC 2.0 endpoint through which jobs are submitted, inspected, and
+stopped — the trainer-scoped role of the reference's Rust code-cli RPC
+(cli/src/json_rpc.rs, SURVEY.md §2.6 / §7 step 8).
+
+Builtin methods: ping, status, submit, stop; arbitrary methods register
+via ``register``. Handlers run on the server thread — keep them short
+(submit should enqueue, not train)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULT_SOCKET = "/tmp/senweaver-ctl.sock"
+
+
+@dataclasses.dataclass
+class Job:
+    job_id: str
+    params: Any
+    status: str = "queued"         # queued | running | done | stopped
+    submitted_at: float = dataclasses.field(default_factory=time.time)
+    result: Any = None
+
+
+class ControlServer:
+    def __init__(self, socket_path: str = DEFAULT_SOCKET, *,
+                 on_submit: Optional[Callable[[Job], None]] = None):
+        self.socket_path = socket_path
+        self.on_submit = on_submit
+        self.jobs: Dict[str, Job] = {}
+        self._handlers: Dict[str, Callable[[Any], Any]] = {
+            "ping": lambda p: "pong",
+            "status": self._status,
+            "submit": self._submit,
+            "stop": self._stop,
+        }
+        self._next_job = 1
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._lock = threading.Lock()
+
+    # -- builtin handlers --------------------------------------------------
+    def _status(self, _params: Any) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [{"job_id": j.job_id, "status": j.status,
+                     "submitted_at": j.submitted_at}
+                    for j in self.jobs.values()]
+
+    def _submit(self, params: Any) -> Dict[str, str]:
+        with self._lock:
+            job = Job(job_id=f"job-{self._next_job}", params=params)
+            self._next_job += 1
+            self.jobs[job.job_id] = job
+        if self.on_submit:
+            self.on_submit(job)
+        return {"job_id": job.job_id, "status": job.status}
+
+    def _stop(self, params: Any) -> Dict[str, str]:
+        job_id = params.get("job_id") if isinstance(params, dict) else \
+            str(params)
+        with self._lock:
+            job = self.jobs.get(job_id)
+            if job is None:
+                raise KeyError(f"unknown job: {job_id}")
+            job.status = "stopped"
+        return {"job_id": job_id, "status": "stopped"}
+
+    def register(self, method: str, fn: Callable[[Any], Any]) -> None:
+        self._handlers[method] = fn
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(self.socket_path)
+        self._sock.listen(8)
+        self._sock.settimeout(0.2)
+        self._running = True
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+        if self._sock:
+            self._sock.close()
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+
+    def _serve(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()  # type: ignore[union-attr]
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            with conn:
+                try:
+                    data = b""
+                    conn.settimeout(2.0)
+                    while True:
+                        chunk = conn.recv(65536)
+                        if not chunk:
+                            break
+                        data += chunk
+                        if b"\n" in data:
+                            break
+                    resp = self._dispatch(data.decode(errors="replace"))
+                    conn.sendall(resp.encode())
+                except OSError:
+                    pass
+
+    def _dispatch(self, raw: str) -> str:
+        rid: Any = None
+        try:
+            req = json.loads(raw)
+            rid = req.get("id")
+            method = req.get("method", "")
+            handler = self._handlers.get(method)
+            if handler is None:
+                return json.dumps({
+                    "jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32601,
+                              "message": f"method not found: {method}"}})
+            result = handler(req.get("params"))
+            return json.dumps({"jsonrpc": "2.0", "id": rid,
+                               "result": result})
+        except json.JSONDecodeError as e:
+            return json.dumps({"jsonrpc": "2.0", "id": None,
+                               "error": {"code": -32700,
+                                         "message": f"parse error: {e}"}})
+        except Exception as e:
+            return json.dumps({"jsonrpc": "2.0", "id": rid,
+                               "error": {"code": -32000,
+                                         "message": f"{type(e).__name__}: "
+                                                    f"{e}"}})

@@ -56,7 +56,8 @@ class TraceCollector:
                  reward_fn: Optional[Callable[[Trace], None]] = None,
                  max_traces: int = MAX_TRACES,
                  max_spans_per_trace: int = MAX_SPANS_PER_TRACE,
-                 flush_interval_s: float = FLUSH_INTERVAL_S):
+                 flush_interval_s: float = FLUSH_INTERVAL_S,
+                 span_sink: Optional[Callable[[bytes], Any]] = None):
         self._traces: Dict[str, Trace] = {}
         self._active: Dict[str, str] = {}  # thread_id -> trace_id
         self._feedbacks: Dict[str, Optional[str]] = {}  # "thread:idx" -> feedback
@@ -66,6 +67,10 @@ class TraceCollector:
         self._max_traces = max_traces
         self._max_spans = max_spans_per_trace
         self._flush_interval_s = flush_interval_s
+        # Optional low-latency span sink (e.g. runtime.TraceRing.append):
+        # every accepted span is serialized and handed over, fire-and-forget
+        # like the reference's queueMicrotask writes.
+        self._span_sink = span_sink
         self._last_flush = time.time()
         self._dirty = False
         if store is not None:
@@ -287,6 +292,13 @@ class TraceCollector:
         if len(tr.spans) >= self._max_spans:  # ref :275-277 overflow guard
             return
         tr.spans.append(span)
+        if self._span_sink is not None:
+            try:
+                import json as _json
+                self._span_sink(
+                    _json.dumps(span.to_dict()).encode("utf-8"))
+            except Exception:
+                pass  # fire-and-forget (ref silent catch :430-439)
         self._dirty = True
         self._maybe_flush()
 

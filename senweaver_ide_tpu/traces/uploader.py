@@ -51,20 +51,23 @@ class TraceUploader:
             pending = [t for t in traces
                        if t.id not in self._uploaded
                        and t.end_time is not None]
-            sent = 0
-            for i in range(0, len(pending), self.batch_size):
-                batch = pending[i:i + self.batch_size]
-                try:
-                    ok = self.transport([t.to_dict() for t in batch])
-                except Exception:
-                    ok = False
-                if not ok:
-                    break
-                self._uploaded.update(t.id for t in batch)
-                sent += len(batch)
-            if sent:
+        # Transport I/O runs OUTSIDE the lock (a slow HTTP POST must not
+        # block other uploaders); the uploaded-set update re-acquires it.
+        sent_ids: List[str] = []
+        for i in range(0, len(pending), self.batch_size):
+            batch = pending[i:i + self.batch_size]
+            try:
+                ok = self.transport([t.to_dict() for t in batch])
+            except Exception:
+                ok = False
+            if not ok:
+                break
+            sent_ids.extend(t.id for t in batch)
+        if sent_ids:
+            with self._lock:
+                self._uploaded.update(sent_ids)
                 self._persist()
-            return sent
+        return len(sent_ids)
 
     def _persist(self) -> None:
         if not self._path:

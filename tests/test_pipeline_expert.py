@@ -93,3 +93,25 @@ def test_moe_capacity_drops_tokens():
     # Some tokens must be dropped (zero output rows).
     flat = np.asarray(out).reshape(-1, 8)
     assert (np.abs(flat).sum(-1) == 0).any()
+
+
+def test_pipeline_per_microbatch_mask_parity(tiny):
+    """Non-uniform attention masks across microbatches must match the
+    dense path — regression for the stage-vs-tick gather index."""
+    config, params = tiny
+    mesh = make_named_mesh({"pp": 2}, devices=jax.devices()[:2])
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 16), 0,
+                                config.vocab_size)
+    # Per-example padding masks, different in every microbatch.
+    lens = jnp.asarray([16, 12, 9, 16, 5, 16, 14, 7])
+    mask = jnp.arange(16)[None, :] < lens[:, None]
+    ref_logits, _ = forward(params, config, tokens, attn_mask=mask)
+    pp_params = place_pipeline_params(
+        split_layers_for_stages(params, 2), mesh)
+    out = pipeline_forward(pp_params, config, tokens, mesh=mesh,
+                           n_microbatches=4, attn_mask=mask)
+    ref = np.asarray(ref_logits)
+    got = np.asarray(out)
+    valid = np.asarray(mask)
+    np.testing.assert_allclose(got[valid], ref[valid], rtol=2e-4,
+                               atol=2e-4)

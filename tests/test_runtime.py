@@ -1,0 +1,157 @@
+"""Native runtime tests: mmap ring store, batched tokenizer parity,
+JSON-RPC control server + the senweaver-ctl C++ CLI end-to-end."""
+
+import json
+import subprocess
+
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+from senweaver_ide_tpu.runtime import (ControlServer, TraceRing,
+                                       byte_tokenize_batch, ctl_binary_path,
+                                       native_available)
+
+needs_native = pytest.mark.skipif(not native_available(),
+                                  reason="native library not built")
+
+
+# ---- trace ring ----
+
+@needs_native
+def test_ring_append_read_roundtrip(tmp_path):
+    ring = TraceRing(str(tmp_path / "spans.ring"), slot_size=256,
+                     n_slots=8)
+    idx = ring.append(b'{"span": 1}')
+    assert idx == 0
+    assert ring.read(0) == b'{"span": 1}'
+    assert ring.read(5) is None
+    ring.close()
+
+
+@needs_native
+def test_ring_wraparound_evicts_oldest(tmp_path):
+    ring = TraceRing(str(tmp_path / "w.ring"), slot_size=64, n_slots=4)
+    for i in range(6):
+        ring.append(f"rec{i}".encode())
+    first, head = ring.window()
+    assert head == 6 and first == 2
+    assert ring.read(0) is None and ring.read(1) is None   # evicted
+    assert ring.read(2) == b"rec2" and ring.read(5) == b"rec5"
+    ring.close()
+
+
+@needs_native
+def test_ring_oversize_rejected_and_counted(tmp_path):
+    ring = TraceRing(str(tmp_path / "o.ring"), slot_size=32, n_slots=4)
+    with pytest.raises(ValueError):
+        ring.append(b"x" * 100)
+    assert ring.dropped == 1
+    ring.close()
+
+
+@needs_native
+def test_ring_crash_durability(tmp_path):
+    """Reopen after close (simulating restart): records survive."""
+    path = str(tmp_path / "d.ring")
+    ring = TraceRing(path, slot_size=128, n_slots=16)
+    ring.append(b"persisted")
+    ring.close()
+    ring2 = TraceRing(path, slot_size=128, n_slots=16)
+    assert ring2.head == 1
+    assert ring2.read(0) == b"persisted"
+    ring2.close()
+
+
+# ---- batched tokenizer ----
+
+def test_byte_tokenize_batch_matches_python():
+    texts = ["hello", "", "unicode: café 你好", "x" * 50]
+    tok = ByteTokenizer()
+    out, lens = byte_tokenize_batch(texts, max_len=32, bos_id=tok.bos_id,
+                                    pad_id=tok.pad_id)
+    assert out.shape == (4, 32)
+    for i, t in enumerate(texts):
+        ref = [tok.bos_id] + tok.encode(t)
+        ref = ref[:32]
+        assert lens[i] == len(ref)
+        np.testing.assert_array_equal(out[i, :len(ref)], ref)
+        assert (out[i, len(ref):] == tok.pad_id).all()
+
+
+# ---- control server + CLI ----
+
+@pytest.fixture()
+def server(tmp_path):
+    s = ControlServer(str(tmp_path / "ctl.sock"))
+    s.start()
+    yield s
+    s.stop()
+
+
+def _ctl(server, *args):
+    binary = ctl_binary_path()
+    assert binary, "senweaver-ctl not built"
+    proc = subprocess.run(
+        [binary, "--socket", server.socket_path, *args],
+        capture_output=True, text=True, timeout=10)
+    return proc.returncode, json.loads(proc.stdout) if proc.stdout.strip() \
+        else {}
+
+
+@needs_native
+def test_ctl_ping(server):
+    code, resp = _ctl(server, "ping")
+    assert code == 0 and resp["result"] == "pong"
+
+
+@needs_native
+def test_ctl_submit_status_stop(server):
+    code, resp = _ctl(server, "submit",
+                      '{"model": "qwen2.5-coder-1.5b", "steps": 10}')
+    assert code == 0
+    job_id = resp["result"]["job_id"]
+    assert server.jobs[job_id].params["model"] == "qwen2.5-coder-1.5b"
+
+    code, resp = _ctl(server, "status")
+    assert code == 0 and resp["result"][0]["job_id"] == job_id
+
+    code, resp = _ctl(server, "stop", job_id)
+    assert code == 0 and resp["result"]["status"] == "stopped"
+    assert server.jobs[job_id].status == "stopped"
+
+
+@needs_native
+def test_ctl_unknown_method_error(server):
+    code, resp = _ctl(server, "call", "no_such_method")
+    assert code == 2 and resp["error"]["code"] == -32601
+
+
+@needs_native
+def test_ctl_custom_method(server):
+    server.register("echo", lambda p: {"you_sent": p})
+    code, resp = _ctl(server, "call", "echo", '{"a": 1}')
+    assert code == 0 and resp["result"]["you_sent"] == {"a": 1}
+
+
+def test_submit_callback(server):
+    got = []
+    server.on_submit = got.append
+    server._submit({"x": 1})
+    assert got and got[0].params == {"x": 1}
+
+
+@needs_native
+def test_collector_with_ring_sink(tmp_path):
+    """TraceCollector spans land in the native ring as JSON."""
+    from senweaver_ide_tpu.traces import TraceCollector
+    ring = TraceRing(str(tmp_path / "sink.ring"), slot_size=2048,
+                     n_slots=64)
+    tc = TraceCollector(span_sink=ring.append)
+    tc.start_trace("t1")
+    tc.record_user_message("t1", 0, "hello ring")
+    tc.record_tool_call("t1", 1, tool_name="read_file", tool_success=True)
+    assert ring.head == 2
+    rec = json.loads(ring.read(0).decode())
+    assert rec["data"]["content_preview"] == "hello ring"
+    ring.close()
