@@ -142,5 +142,12 @@ int main(int argc, char** argv) {
   while ((r = ::read(fd, buf, sizeof buf)) > 0) response.append(buf, (size_t)r);
   ::close(fd);
   std::printf("%s\n", response.c_str());
-  return response.find("\"error\"") != std::string::npos ? 2 : 0;
+  // A JSON-RPC response carries exactly one of "result"/"error" at the
+  // top level; whichever KEY appears first decides. (A payload merely
+  // containing the text "error" must not flip the exit code.)
+  size_t err_pos = response.find("\"error\":");
+  size_t res_pos = response.find("\"result\":");
+  if (err_pos == std::string::npos) return 0;
+  if (res_pos == std::string::npos) return 2;
+  return err_pos < res_pos ? 2 : 0;
 }

@@ -46,8 +46,8 @@ def initialize(cfg: DistributedConfig = DistributedConfig()) -> None:
         if getattr(_dist.global_state, "client", None) is not None:
             _initialized = True
             return
-    except ImportError:
-        pass
+    except (ImportError, AttributeError):
+        pass   # private API moved: fall through and let init itself decide
     addr = cfg.coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS")
     nproc = cfg.num_processes if cfg.num_processes is not None else (

@@ -4,3 +4,4 @@ from .engine import RolloutEngine
 from .policy_client import EnginePolicyClient, render_chat_template
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill)
+from .session import RolloutSession, TurnResult

@@ -1,0 +1,181 @@
+"""RolloutSession: one fully-wired agent session in a sandbox.
+
+The composition root the reference assembles via VS Code DI
+(senweaver.contribution.ts registering ~30 services): workspace sandbox,
+ToolsService with the agent tools plugged in (spawn_subagent → guarded
+SubagentRunner, edit_agent → fast-apply slow path, skill → SkillService),
+trace collection with the jit reward head, conversation checkpoints with
+before-edit snapshots, and the agent loop over a policy client.
+
+This is the unit the RL data pipeline runs: ``session.run_turn()``
+executes one user turn end-to-end and the resulting trace (with
+final_reward) feeds GRPO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+from ..agents.llm import ChatMessage, PolicyClient
+from ..agents.loop import AgentLoop, AgentLoopResult
+from ..agents.registry import get_composition
+from ..agents.subagent import SubagentRunner
+from ..editor.fast_apply import apply_described_edit, instantly_apply_blocks
+from ..prompts.system import chat_system_message
+from ..rollout.checkpoints import ConversationCheckpoints
+from ..services.skills import SkillService
+from ..tools.sandbox import Workspace
+from ..tools.service import ToolsService
+from ..traces.collector import TraceCollector
+from ..traces.schema import Trace
+
+
+@dataclasses.dataclass
+class TurnResult:
+    loop: AgentLoopResult
+    trace: Optional[Trace]
+
+
+class RolloutSession:
+    def __init__(self, client: PolicyClient, workspace_root: str, *,
+                 chat_mode: str = "agent",
+                 thread_id: str = "rollout-0",
+                 collector: Optional[TraceCollector] = None,
+                 skills: Optional[SkillService] = None,
+                 apo_rules: Optional[List[str]] = None):
+        self.client = client
+        self.chat_mode = chat_mode
+        self.thread_id = thread_id
+        self.workspace = Workspace(workspace_root)
+        self.tools = ToolsService(self.workspace)
+        self.collector = collector or TraceCollector()
+        self.skills = skills or SkillService()
+        self.checkpoints = ConversationCheckpoints(self.workspace)
+        self.subagents = SubagentRunner(client, self.tools)
+        self.apo_rules = apo_rules or []
+        self.history: List[ChatMessage] = []
+        self._message_idx = 0
+        self._wire_agent_tools()
+        self.loop = AgentLoop(client, self.tools,
+                              collector=self.collector,
+                              thread_id=thread_id)
+
+    # -- tool wiring (the DI graph) ---------------------------------------
+    def _wire_agent_tools(self) -> None:
+        self.tools.register_handler("spawn_subagent", self._spawn_handler)
+        self.tools.register_handler("edit_agent", self._edit_agent_handler)
+        self.tools.register_handler("skill", self.skills.tool_handler)
+        # Snapshot files before any edit tool touches them (the before-edit
+        # capture of chatThreadService.ts:1062-1068).
+        original_execute = self.tools._execute
+
+        def snapshotting_execute(tool: str, p: Dict[str, Any]) -> Any:
+            if tool in ("edit_file", "rewrite_file",
+                        "delete_file_or_folder", "create_file_or_folder"):
+                try:
+                    self.checkpoints.snapshotter.ensure_before_state(
+                        p["uri"])
+                except Exception:
+                    pass
+            return original_execute(tool, p)
+
+        self.tools._execute = snapshotting_execute  # type: ignore
+
+    def _spawn_handler(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        comp = get_composition(self.chat_mode)
+        if p["agent_type"] not in comp.available_subagents:
+            raise PermissionError(
+                f"subagent '{p['agent_type']}' is not available in "
+                f"{self.chat_mode} mode "
+                f"(available: {', '.join(comp.available_subagents)})")
+        res = self.subagents.spawn(p["agent_type"], p["task"],
+                                   context=p.get("context", ""))
+        if not res.success:
+            raise RuntimeError(res.error or "subagent failed")
+        return {"agent_type": res.agent_type, "report": res.output,
+                "duration_s": round(res.duration_s, 2)}
+
+    def _edit_agent_handler(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        """EditAgentService (editAgentService.ts:11-18): a dedicated
+        edit-focused model call, modes edit/create/overwrite."""
+        mode = p.get("mode", "edit")
+        uri = p["uri"]
+        self.checkpoints.snapshotter.ensure_before_state(uri)
+        if mode in ("create", "overwrite"):
+            r = apply_described_edit(
+                self.client, self.workspace, uri, p["instructions"]) \
+                if mode == "overwrite" and self._exists(uri) else None
+            if r is None:
+                # create: ask for full content directly.
+                resp = self.client.chat([ChatMessage(
+                    "user",
+                    f"Write the complete contents of `{uri}` per these "
+                    f"instructions. Output ONLY the file body.\n\n"
+                    f"{p['instructions']}")], temperature=0.0)
+                self.workspace.write_file(uri, resp.text)
+                return {"uri": uri, "mode": mode, "applied": True}
+        else:
+            r = apply_described_edit(self.client, self.workspace, uri,
+                                     p["instructions"])
+        if r is not None and not r.applied:
+            raise RuntimeError(f"edit agent failed: {r.error}")
+        return {"uri": uri, "mode": mode, "applied": True,
+                "lines_added": r.lines_added if r else None,
+                "lines_removed": r.lines_removed if r else None}
+
+    def _exists(self, uri: str) -> bool:
+        try:
+            self.workspace.read_text(uri)
+            return True
+        except FileNotFoundError:
+            return False
+
+    # -- system message ----------------------------------------------------
+    def system_message(self) -> str:
+        comp = get_composition(self.chat_mode)
+        sysmsg = chat_system_message(
+            chat_mode=self.chat_mode,
+            workspace_folders=[self.workspace.display(self.workspace.root)],
+            directory_str=self.workspace.dir_tree(),
+            apo_rules=self.apo_rules)
+        catalog = self.skills.catalog_for_prompt()
+        if catalog:
+            sysmsg += "\n\n" + catalog
+        return sysmsg
+
+    # -- turns -------------------------------------------------------------
+    def run_turn(self, user_message: str) -> TurnResult:
+        """One user turn: checkpoint → trace → agent loop → reward."""
+        self.checkpoints.add_checkpoint(self._message_idx, "user_turn")
+        trace_id = self.collector.start_trace(
+            self.thread_id, metadata={"chatMode": self.chat_mode})
+        comp = get_composition(self.chat_mode)
+        result = self.loop.run(comp.primary_agent, user_message,
+                               system_message=self.system_message(),
+                               history=self.history)
+        self.history.append(ChatMessage("user", user_message))
+        if result.final_text:
+            self.history.append(ChatMessage("assistant",
+                                            result.final_text))
+        self._message_idx = len(self.history)
+        self.checkpoints.add_checkpoint(self._message_idx, "stream_end")
+        self.collector.end_trace_for_thread(self.thread_id)
+        trace = self.collector._traces.get(trace_id)
+        return TurnResult(loop=result, trace=trace)
+
+    def record_feedback(self, feedback: str) -> None:
+        """good/bad user feedback — the highest-weight reward dim."""
+        self.collector.record_user_feedback(self.thread_id,
+                                            self._message_idx, feedback)
+
+    def jump_to_turn(self, message_idx: int) -> None:
+        """Rewind conversation + files (episode branching for GRPO group
+        sampling)."""
+        self.history = self.checkpoints.jump_to_before_message(
+            message_idx, self.history)
+        self._message_idx = len(self.history)
+
+    def close(self) -> None:
+        self.subagents.close()
+        self.tools.close()

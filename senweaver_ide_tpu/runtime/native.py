@@ -28,7 +28,8 @@ _build_attempted = False
 def build_native(force: bool = False) -> bool:
     """Run the Makefile; returns True when the shared library exists."""
     global _build_attempted
-    if os.path.exists(_LIB_PATH) and not force:
+    if (os.path.exists(_LIB_PATH) and os.path.exists(_CTL_PATH)
+            and not force):
         return True
     if _build_attempted and not force:
         return os.path.exists(_LIB_PATH)

@@ -1,0 +1,18 @@
+"""Aux services: skills, extension tool servers, metrics, runtime config.
+
+TPU-build analogues of the reference's L9 services (SURVEY.md §2.5):
+skillService.ts, mcpService.ts/mcpChannel.ts, metricsService.ts, and the
+tiered config system (product.json / settings / online config).
+"""
+
+from .config import BUILD_DEFAULTS, RuntimeConfig
+from .extensions import (ExtensionServer, ExtensionServerError,
+                         ExtensionTool, ExtensionToolRegistry)
+from .metrics import MetricsService, load_jsonl_metrics
+from .skills import SkillInfo, SkillService
+
+__all__ = [
+    "BUILD_DEFAULTS", "RuntimeConfig", "ExtensionServer",
+    "ExtensionServerError", "ExtensionTool", "ExtensionToolRegistry",
+    "MetricsService", "load_jsonl_metrics", "SkillInfo", "SkillService",
+]

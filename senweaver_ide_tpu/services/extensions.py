@@ -1,0 +1,158 @@
+"""Extension tool servers: MCP-style stdio JSON-RPC clients.
+
+Mirrors `common/mcpService.ts` (365) + `electron-main/mcpChannel.ts`
+(398): external tool servers are child processes speaking JSON-RPC over
+stdio (StdioClientTransport, mcpChannel.ts:13,:202); the client manages
+lifecycle (_createClient :239, close/recreate on failure :144-151) and
+bridges the servers' tools into the agent loop
+(chatThreadService.ts:1096-1107).
+
+Protocol (newline-delimited JSON-RPC 2.0, MCP-shaped):
+  → {method: "initialize"}                        ← {result: {name, ...}}
+  → {method: "tools/list"}                        ← {result: {tools: [...]}}
+  → {method: "tools/call", params: {name, arguments}}  ← {result: ...}
+
+Tools are namespaced ``<server>.<tool>`` when bridged, so extension tools
+can never shadow builtin names.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import threading
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ExtensionTool:
+    server: str
+    name: str
+    description: str = ""
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def full_name(self) -> str:
+        return f"{self.server}.{self.name}"
+
+
+class ExtensionServerError(RuntimeError):
+    pass
+
+
+class ExtensionServer:
+    """One stdio child process + JSON-RPC session."""
+
+    def __init__(self, name: str, command: List[str], *,
+                 timeout_s: float = 10.0):
+        self.name = name
+        self.command = command
+        self.timeout_s = timeout_s
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self.tools: List[ExtensionTool] = []
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self._proc = subprocess.Popen(
+            self.command, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, bufsize=1)
+        self._request("initialize", {"client": "senweaver_ide_tpu"})
+        result = self._request("tools/list", {})
+        self.tools = [
+            ExtensionTool(server=self.name, name=t["name"],
+                          description=t.get("description", ""),
+                          params=t.get("inputSchema",
+                                       t.get("params", {})))
+            for t in result.get("tools", [])]
+
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def restart(self) -> None:
+        """close/recreate on failure (mcpChannel.ts:144-151)."""
+        self.stop()
+        self.start()
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.terminate()
+                self._proc.wait(timeout=2)
+            except (OSError, subprocess.TimeoutExpired):
+                self._proc.kill()
+            self._proc = None
+
+    # -- rpc ---------------------------------------------------------------
+    def _request(self, method: str, params: Any) -> Any:
+        with self._lock:
+            if not self.alive:
+                raise ExtensionServerError(
+                    f"extension server {self.name} is not running")
+            rid = self._next_id
+            self._next_id += 1
+            msg = json.dumps({"jsonrpc": "2.0", "id": rid,
+                              "method": method, "params": params})
+            assert self._proc and self._proc.stdin and self._proc.stdout
+            try:
+                self._proc.stdin.write(msg + "\n")
+                self._proc.stdin.flush()
+                line = self._proc.stdout.readline()
+            except OSError as e:
+                raise ExtensionServerError(f"{self.name}: io error: {e}")
+            if not line:
+                raise ExtensionServerError(
+                    f"{self.name}: server closed the stream")
+            try:
+                resp = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ExtensionServerError(
+                    f"{self.name}: bad response: {e}")
+            if "error" in resp:
+                raise ExtensionServerError(
+                    f"{self.name}: {resp['error'].get('message')}")
+            return resp.get("result")
+
+    def call_tool(self, tool: str, arguments: Dict[str, Any]) -> Any:
+        return self._request("tools/call",
+                             {"name": tool, "arguments": arguments})
+
+
+class ExtensionToolRegistry:
+    """Manages servers and bridges their tools into a ToolsService."""
+
+    def __init__(self):
+        self.servers: Dict[str, ExtensionServer] = {}
+
+    def add_server(self, name: str, command: List[str]) -> ExtensionServer:
+        server = ExtensionServer(name, command)
+        server.start()
+        self.servers[name] = server
+        return server
+
+    def remove_server(self, name: str) -> None:
+        server = self.servers.pop(name, None)
+        if server:
+            server.stop()
+
+    def all_tools(self) -> List[ExtensionTool]:
+        return [t for s in self.servers.values() for t in s.tools]
+
+    def call(self, full_name: str, arguments: Dict[str, Any]) -> Any:
+        server_name, _, tool = full_name.partition(".")
+        server = self.servers.get(server_name)
+        if server is None:
+            raise KeyError(f"unknown extension server: {server_name}")
+        try:
+            return server.call_tool(tool, arguments)
+        except ExtensionServerError:
+            # One recreate attempt, as in the reference.
+            server.restart()
+            return server.call_tool(tool, arguments)
+
+    def close(self) -> None:
+        for name in list(self.servers):
+            self.remove_server(name)

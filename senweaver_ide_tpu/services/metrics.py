@@ -1,0 +1,76 @@
+"""MetricsService: event capture with opt-out + pluggable sink.
+
+Mirrors `common/metricsService.ts` + `electron-main/metricsMainService.ts`
+(162): ``capture(event, properties)`` flows to a sink (PostHog in the
+reference, :30-40) unless the user opted out (OPT_OUT_KEY). Here the
+default sink is a JSONL file; any callable(dict) works (e.g. a real
+telemetry client).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class MetricsService:
+    def __init__(self, sink: Optional[Callable[[Dict[str, Any]], None]]
+                 = None, *, jsonl_path: Optional[str] = None,
+                 opted_out: bool = False,
+                 common_properties: Optional[Dict[str, Any]] = None):
+        self._sink = sink
+        self._jsonl_path = jsonl_path
+        self.opted_out = opted_out
+        self.common = dict(common_properties or {})
+        self._lock = threading.Lock()
+        self.captured_count = 0
+        self._buffer: List[Dict[str, Any]] = []   # kept when no sink set
+
+    def set_opt_out(self, opted_out: bool) -> None:
+        self.opted_out = opted_out
+
+    def capture(self, event: str,
+                properties: Optional[Dict[str, Any]] = None) -> None:
+        """Fire-and-forget: never raises into the caller
+        (metricsMainService.ts catch-all)."""
+        if self.opted_out:
+            return
+        record = {"event": event, "ts": time.time(),
+                  **self.common, **(properties or {})}
+        try:
+            with self._lock:
+                self.captured_count += 1
+                if self._sink is not None:
+                    self._sink(record)
+                elif self._jsonl_path:
+                    with open(self._jsonl_path, "a") as f:
+                        f.write(json.dumps(record) + "\n")
+                else:
+                    self._buffer.append(record)
+                    if len(self._buffer) > 10_000:
+                        del self._buffer[:5_000]
+        except Exception:
+            pass
+
+    def drain(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out, self._buffer = self._buffer, []
+            return out
+
+
+def load_jsonl_metrics(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass           # torn tail line (crash mid-write)
+    return out

@@ -18,6 +18,8 @@ vmappable function — so batch re-scoring of the whole store is one vmap call.
 
 from __future__ import annotations
 
+import json
+
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -294,9 +296,8 @@ class TraceCollector:
         tr.spans.append(span)
         if self._span_sink is not None:
             try:
-                import json as _json
                 self._span_sink(
-                    _json.dumps(span.to_dict()).encode("utf-8"))
+                    json.dumps(span.to_dict()).encode("utf-8"))
             except Exception:
                 pass  # fire-and-forget (ref silent catch :430-439)
         self._dirty = True

@@ -28,6 +28,7 @@ class TraceUploader:
         self.batch_size = batch_size
         self._path = uploaded_ids_path
         self._uploaded: set[str] = set()
+        self._in_flight: set[str] = set()
         self._lock = threading.Lock()
         if self._path and os.path.exists(self._path):
             try:
@@ -50,23 +51,30 @@ class TraceUploader:
         with self._lock:
             pending = [t for t in traces
                        if t.id not in self._uploaded
+                       and t.id not in self._in_flight
                        and t.end_time is not None]
+            # Claim before releasing the lock so concurrent upload() calls
+            # cannot double-send the same traces.
+            self._in_flight.update(t.id for t in pending)
         # Transport I/O runs OUTSIDE the lock (a slow HTTP POST must not
         # block other uploaders); the uploaded-set update re-acquires it.
         sent_ids: List[str] = []
-        for i in range(0, len(pending), self.batch_size):
-            batch = pending[i:i + self.batch_size]
-            try:
-                ok = self.transport([t.to_dict() for t in batch])
-            except Exception:
-                ok = False
-            if not ok:
-                break
-            sent_ids.extend(t.id for t in batch)
-        if sent_ids:
+        try:
+            for i in range(0, len(pending), self.batch_size):
+                batch = pending[i:i + self.batch_size]
+                try:
+                    ok = self.transport([t.to_dict() for t in batch])
+                except Exception:
+                    ok = False
+                if not ok:
+                    break
+                sent_ids.extend(t.id for t in batch)
+        finally:
             with self._lock:
-                self._uploaded.update(sent_ids)
-                self._persist()
+                self._in_flight.difference_update(t.id for t in pending)
+                if sent_ids:
+                    self._uploaded.update(sent_ids)
+                    self._persist()
         return len(sent_ids)
 
     def _persist(self) -> None:

@@ -1,0 +1,128 @@
+"""Editor-AI tests: fast-apply retry loop, FIM prompts + postprocessing,
+edit prediction."""
+
+import pytest
+
+from senweaver_ide_tpu.agents.llm import LLMResponse, LLMUsage
+from senweaver_ide_tpu.editor import (AutocompleteService,
+                                      apply_described_edit,
+                                      build_fim_prompt, changed_symbols,
+                                      instantly_apply_blocks,
+                                      postprocess_completion,
+                                      predict_edit_locations,
+                                      should_complete, suggest_contents)
+from senweaver_ide_tpu.tools import Workspace
+
+
+class Client:
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def chat(self, messages, *, temperature=None, max_tokens=None):
+        self.calls.append(list(messages))
+        return LLMResponse(text=self.script.pop(0), usage=LLMUsage(10, 5))
+
+
+@pytest.fixture()
+def ws(tmp_path):
+    w = Workspace(tmp_path / "sb")
+    w.write_file("m.py", "def calc(x):\n    return x * 2\n")
+    return w
+
+
+# ---- fast apply ----
+
+def test_instant_apply(ws):
+    r = instantly_apply_blocks(ws, "m.py",
+        "<<<<<<< ORIGINAL\n    return x * 2\n=======\n    return x * 3\n"
+        ">>>>>>> UPDATED")
+    assert r.applied and ws.read_text("m.py").endswith("x * 3\n")
+
+
+def test_apply_described_retry_on_malformed(ws):
+    good = ("<<<<<<< ORIGINAL\n    return x * 2\n=======\n"
+            "    return x + 1\n>>>>>>> UPDATED")
+    client = Client(["here is some prose, no blocks", good])
+    r = apply_described_edit(client, ws, "m.py", "make calc add one")
+    assert r.applied and r.retries == 1
+    # The retry prompt carries the error back.
+    assert any("failed to apply" in m.content
+               for m in client.calls[1] if m.role == "user")
+    assert "x + 1" in ws.read_text("m.py")
+
+
+def test_apply_described_gives_up(ws):
+    client = Client(["junk"] * 4)
+    r = apply_described_edit(client, ws, "m.py", "do something",
+                             max_retries=3)
+    assert not r.applied and r.retries == 3
+    assert ws.read_text("m.py").endswith("x * 2\n")   # untouched
+
+
+# ---- autocomplete ----
+
+def test_fim_prompt_uses_model_tokens():
+    fp = build_fim_prompt("qwen2.5-coder-1.5b", "def f(", "):\n    pass")
+    assert fp.text.startswith("<|fim_prefix|>def f(")
+    assert "<|fim_suffix|>" in fp.text and fp.text.endswith("<|fim_middle|>")
+    assert fp.single_line                    # text right of cursor
+
+
+def test_fim_prompt_pseudo_for_non_fim_models():
+    fp = build_fim_prompt("some-chat-model", "x = ", "\ny = 2")
+    assert "<CURSOR>" in fp.text
+
+
+def test_should_complete_gates():
+    assert not should_complete("")
+    assert not should_complete("def f():\n    ")
+    assert should_complete("def f():\n    ret")
+
+
+def test_postprocess_trims_unbalanced_closers():
+    out = postprocess_completion("x))", "f(", ")", single_line=True)
+    assert out == "x"                        # one opener, one closer kept?
+    # f( has one open paren: first ) balances it, second is trimmed.
+    out2 = postprocess_completion("a) + b)", "f(", "", single_line=True)
+    assert out2 == "a) + b"
+
+
+def test_postprocess_single_line_stops_at_suffix_char():
+    out = postprocess_completion("x, y] = useState()", "const [a, ",
+                                 "] = useState()", single_line=True)
+    assert out == "x, y"
+
+
+def test_autocomplete_service_cache(ws):
+    client = Client(["result_a", "result_b"])
+    svc = AutocompleteService(client, "qwen2.5-coder-1.5b")
+    first = svc.complete("x = comp", "")
+    again = svc.complete("x = comp", "")
+    assert first == again == "result_a"
+    assert len(client.calls) == 1            # second was cached
+
+
+# ---- edit prediction ----
+
+def test_changed_symbols_rename():
+    syms = changed_symbols("def calc(x):", "def compute(x):")
+    assert "calc" in syms
+
+
+def test_predict_edit_locations(ws):
+    ws.write_file("use.py", "from m import calc\nprint(calc(2))\n")
+    preds = predict_edit_locations(ws, "m.py", "def calc(x):",
+                                   "def compute(x):")
+    locs = {(p.uri, p.line) for p in preds}
+    assert ("/use.py", 1) in locs and ("/use.py", 2) in locs
+
+
+def test_suggest_contents(ws):
+    ws.write_file("use.py", "print(calc(2))\n")
+    preds = predict_edit_locations(ws, "m.py", "def calc(x):",
+                                   "def compute(x):")
+    client = Client(["0: print(compute(2))\n1: SKIP"])
+    out = suggest_contents(client, preds, "def calc(x):",
+                           "def compute(x):")
+    assert out[0].suggested == "print(compute(2))"

@@ -155,3 +155,12 @@ def test_collector_with_ring_sink(tmp_path):
     rec = json.loads(ring.read(0).decode())
     assert rec["data"]["content_preview"] == "hello ring"
     ring.close()
+
+
+@needs_native
+def test_ctl_exit_code_not_fooled_by_error_text(server):
+    """A successful result whose payload contains 'error' text must still
+    exit 0."""
+    server.register("echo", lambda p: {"on_error": "retry"})
+    code, resp = _ctl(server, "call", "echo", '{"a": 1}')
+    assert code == 0 and "result" in resp

@@ -1,0 +1,161 @@
+"""Aux services tests: skills, extension tool servers (real child
+process), metrics, tiered runtime config."""
+
+import json
+import sys
+
+import pytest
+
+from senweaver_ide_tpu.services import (ExtensionServerError,
+                                        ExtensionToolRegistry,
+                                        MetricsService, RuntimeConfig,
+                                        SkillService, load_jsonl_metrics)
+from senweaver_ide_tpu.tools import ToolsService, Workspace
+
+
+# ---- skills ----
+
+def test_skills_from_config_and_dirs(tmp_path):
+    d = tmp_path / "skills"
+    d.mkdir()
+    (d / "skills.json").write_text(json.dumps({
+        "skills": {"deploy": {"description": "Deploy the app",
+                              "content": "1. build\n2. ship"}}}))
+    (d / "review").mkdir()
+    (d / "review" / "SKILL.md").write_text("# Code review checklist\n...")
+    s = SkillService(str(d))
+    names = {x.name for x in s.get_all_skills()}
+    assert names == {"deploy", "review"}
+    assert s.load_skill_content("deploy") == "1. build\n2. ship"
+    assert "checklist" in s.load_skill_content("review")
+    catalog = s.catalog_for_prompt()
+    assert "# Skills" in catalog and "deploy: Deploy the app" in catalog
+
+
+def test_skill_tool_handler(tmp_path):
+    ws = Workspace(tmp_path / "sb")
+    svc = ToolsService(ws)
+    skills = SkillService()
+    skills.register("fmt", "Formatting rules", "Always 4 spaces.")
+    svc.register_handler("skill", skills.tool_handler)
+    tr = svc.call_tool("skill", {"name": "fmt"})
+    assert tr.ok and tr.result["content"] == "Always 4 spaces."
+    tr = svc.call_tool("skill", {"name": "nope"})
+    assert not tr.ok and "unknown skill" in tr.error
+    svc.close()
+
+
+# ---- extension tool servers ----
+
+DEMO_SERVER = '''
+import sys, json
+for line in sys.stdin:
+    req = json.loads(line)
+    m, rid = req["method"], req["id"]
+    if m == "initialize":
+        r = {"name": "demo"}
+    elif m == "tools/list":
+        r = {"tools": [{"name": "add", "description": "Add two numbers",
+                        "inputSchema": {"a": "int", "b": "int"}}]}
+    elif m == "tools/call":
+        args = req["params"]["arguments"]
+        r = {"sum": args["a"] + args["b"]}
+    else:
+        print(json.dumps({"jsonrpc": "2.0", "id": rid,
+                          "error": {"message": "no such method"}}),
+              flush=True)
+        continue
+    print(json.dumps({"jsonrpc": "2.0", "id": rid, "result": r}),
+          flush=True)
+'''
+
+
+@pytest.fixture()
+def registry(tmp_path):
+    script = tmp_path / "server.py"
+    script.write_text(DEMO_SERVER)
+    reg = ExtensionToolRegistry()
+    reg.add_server("demo", [sys.executable, str(script)])
+    yield reg
+    reg.close()
+
+
+def test_extension_list_and_call(registry):
+    tools = registry.all_tools()
+    assert [t.full_name for t in tools] == ["demo.add"]
+    assert "Add two numbers" in tools[0].description
+    out = registry.call("demo.add", {"a": 2, "b": 40})
+    assert out == {"sum": 42}
+
+
+def test_extension_restart_on_failure(registry):
+    server = registry.servers["demo"]
+    server._proc.kill()
+    server._proc.wait()
+    # Registry restarts the child and retries once.
+    out = registry.call("demo.add", {"a": 1, "b": 1})
+    assert out == {"sum": 2}
+
+
+def test_extension_unknown_server(registry):
+    with pytest.raises(KeyError):
+        registry.call("ghost.add", {})
+
+
+def test_extension_error_response(registry):
+    with pytest.raises(ExtensionServerError):
+        registry.servers["demo"]._request("bogus", {})
+
+
+# ---- metrics ----
+
+def test_metrics_capture_and_optout(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    m = MetricsService(jsonl_path=path, common_properties={"v": "1.0"})
+    m.capture("Agent Loop Done", {"steps": 3})
+    m.set_opt_out(True)
+    m.capture("Should Not Appear")
+    events = load_jsonl_metrics(path)
+    assert len(events) == 1
+    assert events[0]["event"] == "Agent Loop Done"
+    assert events[0]["v"] == "1.0" and events[0]["steps"] == 3
+
+
+def test_metrics_sink_never_raises():
+    def bad_sink(_):
+        raise RuntimeError("down")
+    m = MetricsService(sink=bad_sink)
+    m.capture("x")          # must not raise
+    assert m.captured_count == 1
+
+
+# ---- runtime config ----
+
+def test_config_tier_resolution(tmp_path):
+    path = str(tmp_path / "settings.json")
+    cfg = RuntimeConfig(settings_path=path)
+    assert cfg.get("feature_models.chat") == "qwen2.5-coder-1.5b"
+    cfg.set_user("feature_models.chat", "deepseek-coder-6.7b")
+    assert cfg.get("feature_models.chat") == "deepseek-coder-6.7b"
+    cfg.apply_live_config({"feature_models": {"chat": "qwen2.5-coder-7b"}})
+    assert cfg.get("feature_models.chat") == "qwen2.5-coder-7b"
+    # Settings persisted across restart.
+    cfg2 = RuntimeConfig(settings_path=path)
+    assert cfg2.get("feature_models.chat") == "deepseek-coder-6.7b"
+
+
+def test_config_model_gating():
+    cfg = RuntimeConfig()
+    assert cfg.is_model_allowed("anything")
+    cfg.apply_live_config({"allowed_models": ["qwen2.5-coder"]})
+    assert cfg.is_model_allowed("qwen2.5-coder-1.5b")
+    assert not cfg.is_model_allowed("deepseek-coder-6.7b")
+
+
+def test_config_change_notification():
+    cfg = RuntimeConfig()
+    calls = []
+    cfg.on_change(lambda: calls.append(1))
+    cfg.set_user("chat_mode", "normal")
+    cfg.apply_live_config({})
+    assert len(calls) == 2

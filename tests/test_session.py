@@ -1,0 +1,123 @@
+"""RolloutSession integration: full wired agent session — tools, skills,
+subagents, edit agent, checkpoints, traces/reward — over a scripted
+policy."""
+
+import pytest
+
+from senweaver_ide_tpu.agents.llm import LLMResponse, LLMUsage, ToolCallRequest
+from senweaver_ide_tpu.rollout import RolloutSession
+from senweaver_ide_tpu.services import SkillService
+
+
+class Client:
+    def __init__(self, script):
+        self.script = list(script)
+
+    def chat(self, messages, *, temperature=None, max_tokens=None):
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+def resp(text, tool=None, params=None):
+    return LLMResponse(
+        text=text,
+        tool_call=ToolCallRequest(tool, params or {},
+                                  raw=f"<{tool}>...</{tool}>")
+        if tool else None,
+        usage=LLMUsage(200, 40), model="tiny")
+
+
+@pytest.fixture()
+def session(tmp_path):
+    skills = SkillService()
+    skills.register("style", "Project style guide", "Use 4-space indents.")
+    s = RolloutSession(Client([]), str(tmp_path / "ws"), skills=skills)
+    s.workspace.write_file("app.py", "def run():\n    return 1\n")
+    yield s
+    s.close()
+
+
+def test_full_turn_with_tools_and_reward(session):
+    session.client.script = [
+        resp("look", tool="read_file", params={"uri": "app.py"}),
+        resp("edit", tool="edit_file", params={
+            "uri": "app.py",
+            "search_replace_blocks":
+                "<<<<<<< ORIGINAL\n    return 1\n=======\n    return 2\n"
+                ">>>>>>> UPDATED"}),
+        resp("Done — run() now returns 2."),
+    ]
+    out = session.run_turn("make run() return 2")
+    assert out.loop.final_text.startswith("Done")
+    assert "return 2" in session.workspace.read_text("app.py")
+    assert out.trace is not None
+    assert out.trace.summary.total_tool_calls == 2
+    session.record_feedback("good")
+    assert out.trace.summary.final_reward > 0
+
+
+def test_system_message_includes_workspace_and_skills(session):
+    msg = session.system_message()
+    assert "# Workspace structure" in msg and "app.py" in msg
+    assert "# Skills" in msg and "style:" in msg
+
+
+def test_skill_tool_via_session(session):
+    session.client.script = [
+        resp("loading", tool="skill", params={"name": "style"}),
+        resp("Applied the style guide."),
+    ]
+    out = session.run_turn("what's our style?")
+    assert out.loop.tool_failures == 0
+
+
+def test_subagent_mode_gating(session):
+    # 'ui' is not in agent-mode composition → tool fails, loop continues.
+    session.client.script = [
+        resp("delegating", tool="spawn_subagent",
+             params={"agent_type": "ui", "task": "design a page"}),
+        resp("ok, I'll do it myself"),
+    ]
+    out = session.run_turn("design something")
+    assert out.loop.tool_failures == 1
+
+
+def test_subagent_spawn_via_session(session):
+    session.client.script = [
+        resp("delegating", tool="spawn_subagent",
+             params={"agent_type": "explore", "task": "map the repo"}),
+        resp("explored: one file."),       # the subagent's own call
+        resp("Based on the report: app.py is the only file."),
+    ]
+    out = session.run_turn("explore the repo")
+    assert out.loop.tool_failures == 0
+    assert out.trace.summary.total_tool_calls == 1
+
+
+def test_edit_agent_create_mode(session):
+    session.client.script = [
+        resp("creating", tool="edit_agent",
+             params={"uri": "util.py", "mode": "create",
+                     "instructions": "a helper returning 42"}),
+        resp("def helper():\n    return 42\n"),   # edit agent's call
+        resp("Created util.py."),
+    ]
+    out = session.run_turn("add util.py")
+    assert out.loop.tool_failures == 0
+    assert "return 42" in session.workspace.read_text("util.py")
+
+
+def test_checkpoint_branching(session):
+    session.client.script = [
+        resp("edit", tool="rewrite_file",
+             params={"uri": "app.py", "new_content": "VERSION = 2\n"}),
+        resp("rewrote it"),
+    ]
+    session.run_turn("rewrite app.py")
+    assert session.workspace.read_text("app.py") == "VERSION = 2\n"
+    # Branch back to the start: files and history rewound.
+    session.jump_to_turn(0)
+    assert session.history == []
+    assert "def run():" in session.workspace.read_text("app.py")
