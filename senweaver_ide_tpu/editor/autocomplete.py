@@ -50,15 +50,19 @@ def _cap_context(text: str, *, from_end: bool) -> str:
     return "\n".join(out)
 
 
-def should_complete(prefix: str) -> bool:
-    """Preprocessing gate: no completion when the cursor touches a word
-    character on its left edge's end... i.e. only complete after
-    whitespace/punctuation or at a line with content (ref :58-61)."""
+def should_complete(prefix: str, suffix: str = "") -> bool:
+    """Preprocessing gates (ref :58-61): don't generate at the very
+    beginning of an un-indented empty line (nothing to anchor on), and
+    don't generate mid-word when text continues immediately to the right
+    (completing inside an identifier splits it)."""
     if not prefix:
         return False
     last_line = prefix.rsplit("\n", 1)[-1]
-    if not last_line.strip():
-        return False          # cursor at start of an empty line
+    if last_line == "":
+        return False          # column 0 of an empty, un-indented line
+    if (last_line and (last_line[-1].isalnum() or last_line[-1] == "_")
+            and suffix[:1] and (suffix[0].isalnum() or suffix[0] == "_")):
+        return False          # cursor splits an identifier
     return True
 
 
@@ -128,7 +132,7 @@ class AutocompleteService:
 
     def complete(self, prefix: str, suffix: str, *,
                  max_tokens: int = 64) -> Optional[str]:
-        if not should_complete(prefix):
+        if not should_complete(prefix, suffix):
             return None
         key = prefix.rstrip("\n")[-500:]         # prefix-keyed cache
         cached = self._cache.get(key)

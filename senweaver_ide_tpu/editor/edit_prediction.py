@@ -48,27 +48,34 @@ def predict_edit_locations(workspace: Workspace, uri: str, before: str,
                            after: str, *,
                            max_predictions: int = MAX_PREDICTIONS
                            ) -> List[EditPrediction]:
-    """Deterministic pass: every other occurrence of a changed symbol."""
+    """Deterministic pass: every other occurrence of a changed symbol.
+    One read + one regex scan per file for ALL symbols at once — this
+    hook runs after every agent edit, so per-symbol workspace re-walks
+    would scale quadratically with sandbox size."""
     symbols = changed_symbols(before, after)
     if not symbols:
         return []
+    pattern = re.compile(
+        r"\b(" + "|".join(re.escape(s) for s in symbols) + r")\b")
     out: List[EditPrediction] = []
     edited = workspace.display(workspace.resolve(uri))
-    for symbol in symbols:
-        hits, _ = workspace.search_files(rf"\b{re.escape(symbol)}\b",
-                                         is_regex=True)
-        for path in hits:
-            lines = workspace.search_in_file(path, rf"\b{re.escape(symbol)}\b",
-                                             is_regex=True)
-            text_lines = workspace.read_text(path).split("\n")
-            for ln in lines:
-                if path == edited and symbol in after:
-                    continue          # already handled by the edit itself
-                out.append(EditPrediction(
-                    uri=path, line=ln, symbol=symbol,
-                    preview=text_lines[ln - 1].strip()[:120]))
-                if len(out) >= max_predictions:
-                    return out
+    for f in workspace._walk_files():
+        path = workspace.display(f)
+        try:
+            text = f.read_text(errors="replace")
+        except (OSError, UnicodeError):
+            continue
+        for ln, line in enumerate(text.split("\n"), start=1):
+            m = pattern.search(line)
+            if m is None:
+                continue
+            symbol = m.group(1)
+            if path == edited and symbol in after:
+                continue              # already handled by the edit itself
+            out.append(EditPrediction(uri=path, line=ln, symbol=symbol,
+                                      preview=line.strip()[:120]))
+            if len(out) >= max_predictions:
+                return out
     return out
 
 

@@ -39,9 +39,16 @@ class ApplyResult:
 
 
 def _stats(old: str, new: str) -> tuple[int, int]:
-    old_lines = old.count("\n")
-    new_lines = new.count("\n")
-    return max(0, new_lines - old_lines), max(0, old_lines - new_lines)
+    """Real per-line diff counts (CodeChangeStats semantics) — a
+    same-line-count substitution is added+removed, not a no-op."""
+    import difflib
+    added = removed = 0
+    for line in difflib.ndiff(old.splitlines(), new.splitlines()):
+        if line.startswith("+ "):
+            added += 1
+        elif line.startswith("- "):
+            removed += 1
+    return added, removed
 
 
 def instantly_apply_blocks(workspace: Workspace, uri: str,

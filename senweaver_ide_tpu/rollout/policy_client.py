@@ -49,12 +49,18 @@ class EnginePolicyClient:
     def __init__(self, engine: RolloutEngine, tokenizer, *,
                  model_name: str = "",
                  default_max_new_tokens: int = 512,
-                 tool_names: Optional[Sequence[str]] = None):
+                 tool_names: Optional[Sequence[str]] = None,
+                 record_calls: bool = False):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.default_max_new_tokens = default_max_new_tokens
         self.tool_names = tool_names
+        # When recording, every chat() appends (prompt_ids, output_ids) —
+        # the exact token streams GRPO trains on (no re-tokenization
+        # drift between rollout and training).
+        self.record_calls = record_calls
+        self.call_log: List[tuple[List[int], List[int]]] = []
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
@@ -71,6 +77,8 @@ class EnginePolicyClient:
         while not self.engine.is_done(rid):
             self.engine.step()
         out_ids = self.engine.result(rid)
+        if self.record_calls:
+            self.call_log.append((list(prompt_ids), list(out_ids)))
         raw = self.tokenizer.decode(out_ids)
         # Cut at the chat-template end marker if the model emitted one.
         end = raw.find(_ROLE_CLOSE)

@@ -21,7 +21,7 @@ from ..agents.llm import ChatMessage, PolicyClient
 from ..agents.loop import AgentLoop, AgentLoopResult
 from ..agents.registry import get_composition
 from ..agents.subagent import SubagentRunner
-from ..editor.fast_apply import apply_described_edit, instantly_apply_blocks
+from ..editor.fast_apply import apply_described_edit
 from ..prompts.system import chat_system_message
 from ..rollout.checkpoints import ConversationCheckpoints
 from ..services.skills import SkillService
@@ -43,7 +43,8 @@ class RolloutSession:
                  thread_id: str = "rollout-0",
                  collector: Optional[TraceCollector] = None,
                  skills: Optional[SkillService] = None,
-                 apo_rules: Optional[List[str]] = None):
+                 apo_rules: Optional[List[str]] = None,
+                 include_tool_definitions: bool = True):
         self.client = client
         self.chat_mode = chat_mode
         self.thread_id = thread_id
@@ -54,6 +55,9 @@ class RolloutSession:
         self.checkpoints = ConversationCheckpoints(self.workspace)
         self.subagents = SubagentRunner(client, self.tools)
         self.apo_rules = apo_rules or []
+        # Tiny-window policies (tests, byte-level tokenizers) can skip the
+        # ~6k-char tool-grammar section; real rollouts keep it.
+        self.include_tool_definitions = include_tool_definitions
         self.history: List[ChatMessage] = []
         self._message_idx = 0
         self._wire_agent_tools()
@@ -68,19 +72,14 @@ class RolloutSession:
         self.tools.register_handler("skill", self.skills.tool_handler)
         # Snapshot files before any edit tool touches them (the before-edit
         # capture of chatThreadService.ts:1062-1068).
-        original_execute = self.tools._execute
+        edit_tools = ("edit_file", "rewrite_file", "delete_file_or_folder",
+                      "create_file_or_folder")
 
-        def snapshotting_execute(tool: str, p: Dict[str, Any]) -> Any:
-            if tool in ("edit_file", "rewrite_file",
-                        "delete_file_or_folder", "create_file_or_folder"):
-                try:
-                    self.checkpoints.snapshotter.ensure_before_state(
-                        p["uri"])
-                except Exception:
-                    pass
-            return original_execute(tool, p)
+        def snapshot_hook(tool: str, p: Dict[str, Any]) -> None:
+            if tool in edit_tools:
+                self.checkpoints.snapshotter.ensure_before_state(p["uri"])
 
-        self.tools._execute = snapshotting_execute  # type: ignore
+        self.tools.add_pre_execute_hook(snapshot_hook)
 
     def _spawn_handler(self, p: Dict[str, Any]) -> Dict[str, Any]:
         comp = get_composition(self.chat_mode)
@@ -103,33 +102,23 @@ class RolloutSession:
         uri = p["uri"]
         self.checkpoints.snapshotter.ensure_before_state(uri)
         if mode in ("create", "overwrite"):
-            r = apply_described_edit(
-                self.client, self.workspace, uri, p["instructions"]) \
-                if mode == "overwrite" and self._exists(uri) else None
-            if r is None:
-                # create: ask for full content directly.
-                resp = self.client.chat([ChatMessage(
-                    "user",
-                    f"Write the complete contents of `{uri}` per these "
-                    f"instructions. Output ONLY the file body.\n\n"
-                    f"{p['instructions']}")], temperature=0.0)
-                self.workspace.write_file(uri, resp.text)
-                return {"uri": uri, "mode": mode, "applied": True}
-        else:
-            r = apply_described_edit(self.client, self.workspace, uri,
-                                     p["instructions"])
-        if r is not None and not r.applied:
+            # Full-content regeneration for both: 'overwrite' replaces the
+            # whole file, so forcing the model to transcribe exact ORIGINAL
+            # blocks would only add a failure mode.
+            resp = self.client.chat([ChatMessage(
+                "user",
+                f"Write the complete contents of `{uri}` per these "
+                f"instructions. Output ONLY the file body.\n\n"
+                f"{p['instructions']}")], temperature=0.0)
+            self.workspace.write_file(uri, resp.text)
+            return {"uri": uri, "mode": mode, "applied": True}
+        r = apply_described_edit(self.client, self.workspace, uri,
+                                 p["instructions"])
+        if not r.applied:
             raise RuntimeError(f"edit agent failed: {r.error}")
         return {"uri": uri, "mode": mode, "applied": True,
-                "lines_added": r.lines_added if r else None,
-                "lines_removed": r.lines_removed if r else None}
-
-    def _exists(self, uri: str) -> bool:
-        try:
-            self.workspace.read_text(uri)
-            return True
-        except FileNotFoundError:
-            return False
+                "lines_added": r.lines_added,
+                "lines_removed": r.lines_removed}
 
     # -- system message ----------------------------------------------------
     def system_message(self) -> str:
@@ -138,7 +127,8 @@ class RolloutSession:
             chat_mode=self.chat_mode,
             workspace_folders=[self.workspace.display(self.workspace.root)],
             directory_str=self.workspace.dir_tree(),
-            apo_rules=self.apo_rules)
+            apo_rules=self.apo_rules,
+            include_tool_definitions=self.include_tool_definitions)
         catalog = self.skills.catalog_for_prompt()
         if catalog:
             sysmsg += "\n\n" + catalog
@@ -161,7 +151,7 @@ class RolloutSession:
         self._message_idx = len(self.history)
         self.checkpoints.add_checkpoint(self._message_idx, "stream_end")
         self.collector.end_trace_for_thread(self.thread_id)
-        trace = self.collector._traces.get(trace_id)
+        trace = self.collector.get_trace(trace_id)
         return TurnResult(loop=result, trace=trace)
 
     def record_feedback(self, feedback: str) -> None:

@@ -22,6 +22,7 @@ import dataclasses
 import json
 import subprocess
 import threading
+import time as _time
 from typing import Any, Dict, List, Optional
 
 
@@ -38,7 +39,12 @@ class ExtensionTool:
 
 
 class ExtensionServerError(RuntimeError):
-    pass
+    """Application-level failure (a JSON-RPC error response)."""
+
+
+class ExtensionTransportError(ExtensionServerError):
+    """Transport failure (dead process, closed/unresponsive stream) — the
+    only class that justifies a server restart."""
 
 
 class ExtensionServer:
@@ -87,10 +93,42 @@ class ExtensionServer:
             self._proc = None
 
     # -- rpc ---------------------------------------------------------------
+    def _read_line_with_timeout(self) -> str:
+        """Deadline-bounded readline on the child's stdout — a wedged
+        server must raise, not hang the agent loop with the lock held."""
+        import os as _os
+        import selectors as _selectors
+        assert self._proc and self._proc.stdout
+        fd = self._proc.stdout.fileno()
+        _os.set_blocking(fd, False)
+        sel = _selectors.DefaultSelector()
+        sel.register(fd, _selectors.EVENT_READ)
+        deadline = _time.monotonic() + self.timeout_s
+        chunks: list[bytes] = []
+        try:
+            while True:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    raise ExtensionTransportError(
+                        f"{self.name}: no response within "
+                        f"{self.timeout_s:.0f}s")
+                if not sel.select(timeout=min(remaining, 0.2)):
+                    continue
+                data = _os.read(fd, 65536)
+                if not data:
+                    raise ExtensionTransportError(
+                        f"{self.name}: server closed the stream")
+                chunks.append(data)
+                if b"\n" in data:
+                    return b"".join(chunks).split(b"\n", 1)[0] \
+                        .decode(errors="replace")
+        finally:
+            sel.close()
+
     def _request(self, method: str, params: Any) -> Any:
         with self._lock:
             if not self.alive:
-                raise ExtensionServerError(
+                raise ExtensionTransportError(
                     f"extension server {self.name} is not running")
             rid = self._next_id
             self._next_id += 1
@@ -100,16 +138,14 @@ class ExtensionServer:
             try:
                 self._proc.stdin.write(msg + "\n")
                 self._proc.stdin.flush()
-                line = self._proc.stdout.readline()
             except OSError as e:
-                raise ExtensionServerError(f"{self.name}: io error: {e}")
-            if not line:
-                raise ExtensionServerError(
-                    f"{self.name}: server closed the stream")
+                raise ExtensionTransportError(
+                    f"{self.name}: io error: {e}")
+            line = self._read_line_with_timeout()
             try:
                 resp = json.loads(line)
             except json.JSONDecodeError as e:
-                raise ExtensionServerError(
+                raise ExtensionTransportError(
                     f"{self.name}: bad response: {e}")
             if "error" in resp:
                 raise ExtensionServerError(
@@ -148,8 +184,11 @@ class ExtensionToolRegistry:
             raise KeyError(f"unknown extension server: {server_name}")
         try:
             return server.call_tool(tool, arguments)
-        except ExtensionServerError:
-            # One recreate attempt, as in the reference.
+        except ExtensionTransportError:
+            # One recreate attempt on TRANSPORT failure only (the
+            # reference's close/recreate, mcpChannel.ts:144-151);
+            # application error responses must not kill a healthy,
+            # possibly stateful server.
             server.restart()
             return server.call_tool(tool, arguments)
 

@@ -40,18 +40,21 @@ class MetricsService:
             return
         record = {"event": event, "ts": time.time(),
                   **self.common, **(properties or {})}
+        # Bookkeeping under the lock; sink/file I/O outside it — a slow
+        # (or reentrant) sink must not serialize or deadlock capturers.
+        with self._lock:
+            self.captured_count += 1
+            if self._sink is None and not self._jsonl_path:
+                self._buffer.append(record)
+                if len(self._buffer) > 10_000:
+                    del self._buffer[:5_000]
+                return
         try:
-            with self._lock:
-                self.captured_count += 1
-                if self._sink is not None:
-                    self._sink(record)
-                elif self._jsonl_path:
-                    with open(self._jsonl_path, "a") as f:
-                        f.write(json.dumps(record) + "\n")
-                else:
-                    self._buffer.append(record)
-                    if len(self._buffer) > 10_000:
-                        del self._buffer[:5_000]
+            if self._sink is not None:
+                self._sink(record)
+            elif self._jsonl_path:
+                with open(self._jsonl_path, "a") as f:
+                    f.write(json.dumps(record) + "\n")
         except Exception:
             pass
 

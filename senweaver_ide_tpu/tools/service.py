@@ -85,6 +85,8 @@ class ToolsService:
             self.auto_approve.update(auto_approve)
         self._handlers: Dict[str, Callable[[Dict[str, Any]], Any]] = {}
         self._lint_provider: Optional[Callable[[str], List[str]]] = None
+        self._pre_execute_hooks: List[
+            Callable[[str, Dict[str, Any]], None]] = []
         self.call_log: List[ToolResult] = []
 
     # -- extension points --------------------------------------------------
@@ -98,6 +100,13 @@ class ToolsService:
 
     def set_lint_provider(self, fn: Callable[[str], List[str]]) -> None:
         self._lint_provider = fn
+
+    def add_pre_execute_hook(
+            self, fn: Callable[[str, Dict[str, Any]], None]) -> None:
+        """Called with (tool, validated_params) after validation+approval,
+        before execution — e.g. before-edit file snapshots. Hook errors
+        are swallowed (observers must not fail the tool call)."""
+        self._pre_execute_hooks.append(fn)
 
     # -- validation --------------------------------------------------------
     def validate_params(self, tool: str,
@@ -220,6 +229,11 @@ class ToolsService:
                 raise ToolDeniedError(
                     f"tool {tool} requires '{approval.value}' approval, "
                     "which this rollout policy denies")
+            for hook in self._pre_execute_hooks:
+                try:
+                    hook(tool, params)
+                except Exception:
+                    pass
             result = self._execute(tool, params)
             tr = ToolResult(tool=tool, params=params, result=result,
                             started_at=started,

@@ -230,7 +230,8 @@ class TraceCollector:
             return list(self._traces.values())
 
     def get_trace(self, trace_id: str) -> Optional[Trace]:
-        return self._traces.get(trace_id)
+        with self._lock:
+            return self._traces.get(trace_id)
 
     def get_stats(self) -> Dict[str, Any]:
         with self._lock:

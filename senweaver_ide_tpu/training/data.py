@@ -1,0 +1,102 @@
+"""Trajectory dataset: rollouts → padded GRPO training batches.
+
+The bridge between the rollout plane (sessions producing traces + token
+logs) and the jit training step: trajectories are (prompt_ids,
+completion_ids, reward, group_id); batches pad to a power-of-two bucket
+(bounded recompilation, same policy as the rollout engine) with a
+completion-token mask so the objective only scores generated tokens.
+
+Deterministic order for resume (SURVEY.md §7 step 5): the dataset shuffles
+with a seeded permutation per epoch and exposes a cursor that the
+checkpoint meta records (training/checkpoint.py data_cursor), so a
+restored run continues on the exact next batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Trajectory:
+    prompt_ids: List[int]
+    completion_ids: List[int]
+    reward: float
+    group_id: int
+
+
+def _bucket(n: int, minimum: int = 32) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def make_batch(trajectories: Sequence[Trajectory], *, pad_id: int,
+               max_len: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (tokens (B, S), completion_mask (B, S) bool, rewards (B,),
+    group_ids (B,)). S = power-of-two bucket of the longest trajectory
+    (clipped to max_len; overlong trajectories keep their completion tail
+    — the prompt head is dropped, since the objective needs completion
+    tokens in context, not the full prompt)."""
+    if not trajectories:
+        raise ValueError("empty batch")
+    lens = [len(t.prompt_ids) + len(t.completion_ids) for t in trajectories]
+    s = _bucket(max(lens))
+    if max_len is not None:
+        s = min(s, max_len)
+    b = len(trajectories)
+    tokens = np.full((b, s), pad_id, np.int32)
+    mask = np.zeros((b, s), bool)
+    rewards = np.zeros((b,), np.float32)
+    group_ids = np.zeros((b,), np.int32)
+    for i, t in enumerate(trajectories):
+        seq = list(t.prompt_ids) + list(t.completion_ids)
+        comp_start = len(t.prompt_ids)
+        if len(seq) > s:
+            drop = len(seq) - s
+            seq = seq[drop:]
+            comp_start = max(0, comp_start - drop)
+        tokens[i, :len(seq)] = seq
+        mask[i, comp_start:len(seq)] = True
+        rewards[i] = t.reward
+        group_ids[i] = t.group_id
+    return tokens, mask, rewards, group_ids
+
+
+class TrajectoryDataset:
+    """Seeded-permutation epochs + a resumable cursor."""
+
+    def __init__(self, trajectories: Sequence[Trajectory], *,
+                 batch_size: int, seed: int = 0):
+        self._items = list(trajectories)
+        self.batch_size = batch_size
+        self.seed = seed
+        self.cursor = 0              # global batch index across epochs
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return max(1, len(self._items) // self.batch_size)
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng((self.seed, epoch))
+        return rng.permutation(len(self._items))
+
+    def batch_at(self, cursor: int) -> List[Trajectory]:
+        epoch = cursor // self.batches_per_epoch
+        step = cursor % self.batches_per_epoch
+        perm = self._epoch_perm(epoch)
+        idx = perm[step * self.batch_size:(step + 1) * self.batch_size]
+        return [self._items[i] for i in idx]
+
+    def __iter__(self) -> Iterator[List[Trajectory]]:
+        while True:
+            yield self.batch_at(self.cursor)
+            self.cursor += 1

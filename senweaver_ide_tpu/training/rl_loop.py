@@ -1,0 +1,107 @@
+"""The closed GRPO loop: tasks → grouped rollouts → rewards → update.
+
+This is the system SURVEY.md §7's architecture diagram describes end to
+end: the rollout engine samples G trajectories per task (the GRPO group),
+each driven through a fully-wired RolloutSession (tools, subagents,
+traces), the 9-dim reward head scores each episode's trace, group-relative
+advantages are computed per task, and the policy takes a clipped-objective
+step — replacing the reference's backend-LLM prompt optimization with
+local weight updates (apoService.ts:992-1215's optimizer moves in-tree).
+
+Credit assignment: every LLM call inside an episode becomes one
+trajectory carrying the episode's finalReward (the per-call token streams
+come from EnginePolicyClient.record_calls — no re-tokenization drift);
+group ids are per task so advantages compare alternative episodes of the
+SAME task.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..rollout.session import RolloutSession
+from .data import Trajectory, make_batch
+from .grpo import GRPOConfig
+from .trainer import TrainState, train_step
+
+
+@dataclasses.dataclass
+class EpisodeRecord:
+    task_idx: int
+    reward: float
+    n_calls: int
+    steps: int
+
+
+@dataclasses.dataclass
+class RoundResult:
+    state: TrainState
+    metrics: Dict[str, float]
+    episodes: List[EpisodeRecord]
+    trajectories: List[Trajectory]
+
+
+def collect_group_trajectories(
+        make_session: Callable[[], RolloutSession],
+        tasks: Sequence[str], *, group_size: int,
+        reward_override: Optional[Callable[[int, int, RolloutSession],
+                                           float]] = None
+) -> tuple[List[Trajectory], List[EpisodeRecord]]:
+    """Run group_size episodes per task; one Trajectory per LLM call.
+
+    make_session must return a FRESH session whose client is an
+    EnginePolicyClient(record_calls=True) (or compatible) — episodes must
+    not share mutable workspace state. reward_override(task_idx, g,
+    session) can replace the trace reward (evaluator-in-the-loop)."""
+    trajectories: List[Trajectory] = []
+    episodes: List[EpisodeRecord] = []
+    for task_idx, task in enumerate(tasks):
+        for g in range(group_size):
+            session = make_session()
+            client = session.client
+            log_start = len(getattr(client, "call_log", []))
+            out = session.run_turn(task)
+            if reward_override is not None:
+                reward = reward_override(task_idx, g, session)
+            else:
+                reward = (out.trace.summary.final_reward
+                          if out.trace is not None else 0.0)
+            calls = list(getattr(client, "call_log", []))[log_start:]
+            for prompt_ids, out_ids in calls:
+                trajectories.append(Trajectory(
+                    prompt_ids=prompt_ids, completion_ids=out_ids,
+                    reward=float(reward), group_id=task_idx))
+            episodes.append(EpisodeRecord(task_idx=task_idx,
+                                          reward=float(reward),
+                                          n_calls=len(calls),
+                                          steps=out.loop.steps))
+            session.close()
+    return trajectories, episodes
+
+
+def grpo_round(state: TrainState, model_config, mesh,
+               make_session: Callable[[], RolloutSession],
+               tasks: Sequence[str], *, group_size: int = 4,
+               pad_id: int = 0, max_len: Optional[int] = None,
+               grpo_config: GRPOConfig = GRPOConfig(),
+               reward_override=None) -> RoundResult:
+    """One on-policy round: collect → batch → single GRPO step."""
+    trajectories, episodes = collect_group_trajectories(
+        make_session, tasks, group_size=group_size,
+        reward_override=reward_override)
+    if not trajectories:
+        return RoundResult(state=state, metrics={}, episodes=episodes,
+                           trajectories=[])
+    tokens, mask, rewards, group_ids = make_batch(
+        trajectories, pad_id=pad_id, max_len=max_len)
+    state, metrics = train_step(
+        state, model_config, mesh, jnp.asarray(tokens), jnp.asarray(mask),
+        jnp.asarray(rewards), jnp.asarray(group_ids),
+        grpo_config=grpo_config)
+    return RoundResult(
+        state=state,
+        metrics={k: float(v) for k, v in metrics.items()},
+        episodes=episodes, trajectories=trajectories)

@@ -76,8 +76,11 @@ def test_fim_prompt_pseudo_for_non_fim_models():
 
 def test_should_complete_gates():
     assert not should_complete("")
-    assert not should_complete("def f():\n    ")
+    assert not should_complete("def f():\n")          # empty unindented line
+    assert should_complete("def f():\n    ")           # indented fresh line
     assert should_complete("def f():\n    ret")
+    assert not should_complete("x = ret", "urn 1")     # cursor mid-word
+    assert should_complete("x = f(", ")")              # mid-expression ok
 
 
 def test_postprocess_trims_unbalanced_closers():
