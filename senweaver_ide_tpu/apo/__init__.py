@@ -12,3 +12,5 @@ from .beam import beam_search, corpus_score_fn, propose_candidates
 from .service import APOService, APO_RULES_MAX_CHARS, format_apo_rules_section
 from .synthetic import (generate_good_traces, generate_pattern_traces,
                         make_six_pattern_corpus)
+from .local import (corpus_score_from_collector, make_local_apo,
+                    policy_generate_fn)

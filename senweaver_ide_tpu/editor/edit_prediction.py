@@ -49,9 +49,9 @@ def predict_edit_locations(workspace: Workspace, uri: str, before: str,
                            max_predictions: int = MAX_PREDICTIONS
                            ) -> List[EditPrediction]:
     """Deterministic pass: every other occurrence of a changed symbol.
-    One read + one regex scan per file for ALL symbols at once — this
-    hook runs after every agent edit, so per-symbol workspace re-walks
-    would scale quadratically with sandbox size."""
+    One workspace grep for ALL symbols at once (Workspace.search_lines) —
+    this hook runs after every agent edit, so per-symbol re-walks would
+    scale quadratically with sandbox size."""
     symbols = changed_symbols(before, after)
     if not symbols:
         return []
@@ -59,19 +59,14 @@ def predict_edit_locations(workspace: Workspace, uri: str, before: str,
         r"\b(" + "|".join(re.escape(s) for s in symbols) + r")\b")
     out: List[EditPrediction] = []
     edited = workspace.display(workspace.resolve(uri))
-    for f in workspace._walk_files():
-        path = workspace.display(f)
-        try:
-            text = f.read_text(errors="replace")
-        except (OSError, UnicodeError):
-            continue
-        for ln, line in enumerate(text.split("\n"), start=1):
-            m = pattern.search(line)
-            if m is None:
-                continue
-            symbol = m.group(1)
+    for path, ln, line in workspace.search_lines(pattern.pattern):
+        # Every DISTINCT changed symbol on the line gets its own
+        # prediction; a symbol already handled by the edit itself is
+        # skipped without suppressing the line's other symbols.
+        for symbol in dict.fromkeys(m.group(1)
+                                    for m in pattern.finditer(line)):
             if path == edited and symbol in after:
-                continue              # already handled by the edit itself
+                continue
             out.append(EditPrediction(uri=path, line=ln, symbol=symbol,
                                       preview=line.strip()[:120]))
             if len(out) >= max_predictions:

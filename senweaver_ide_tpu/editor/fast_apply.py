@@ -40,14 +40,18 @@ class ApplyResult:
 
 def _stats(old: str, new: str) -> tuple[int, int]:
     """Real per-line diff counts (CodeChangeStats semantics) — a
-    same-line-count substitution is added+removed, not a no-op."""
+    same-line-count substitution is added+removed, not a no-op.
+    SequenceMatcher opcodes, not ndiff: this runs on the agent-loop hot
+    path and ndiff's intraline analysis is quadratic on big files."""
     import difflib
+    sm = difflib.SequenceMatcher(None, old.splitlines(), new.splitlines(),
+                                 autojunk=False)
     added = removed = 0
-    for line in difflib.ndiff(old.splitlines(), new.splitlines()):
-        if line.startswith("+ "):
-            added += 1
-        elif line.startswith("- "):
-            removed += 1
+    for op, i1, i2, j1, j2 in sm.get_opcodes():
+        if op in ("replace", "delete"):
+            removed += i2 - i1
+        if op in ("replace", "insert"):
+            added += j2 - j1
     return added, removed
 
 

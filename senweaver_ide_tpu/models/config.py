@@ -38,6 +38,12 @@ class ModelConfig:
     # default (bf16 MXU passes — the fast path for real models). The fp32
     # test config pins "highest" so cache-vs-full decode parity is exact.
     matmul_precision: Optional[str] = None
+    # Mixture-of-experts FFN: 0 = dense. When > 0, every layer's MLP is a
+    # top-k routed expert bank (parallel/expert.py semantics) and the
+    # expert axis shards over 'ep'.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    expert_capacity_factor: float = 1.25
 
     @property
     def q_dim(self) -> int:
@@ -88,6 +94,16 @@ def deepseek_coder_6_7b() -> ModelConfig:
         head_dim=128, max_seq_len=16_384, rope_theta=100_000.0)
 
 
+def tiny_moe_test() -> ModelConfig:
+    """MoE policy variant for unit tests / EP dry runs."""
+    return ModelConfig(
+        name="tiny-moe-test", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, qkv_bias=True,
+        dtype=jnp.float32, matmul_precision="highest",
+        num_experts=4, num_experts_per_tok=2)
+
+
 def tiny_test() -> ModelConfig:
     """Small config for unit tests and CPU-mesh dry runs."""
     return ModelConfig(
@@ -104,6 +120,7 @@ PRESETS = {
     "deepseek-coder-1.3b": deepseek_coder_1_3b,
     "deepseek-coder-6.7b": deepseek_coder_6_7b,
     "tiny-test": tiny_test,
+    "tiny-moe-test": tiny_moe_test,
 }
 
 

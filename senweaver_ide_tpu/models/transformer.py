@@ -65,10 +65,17 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         "wv": dense(ks[2], (L, D, c.kv_dim), D),
         "wo": dense(ks[3], (L, c.q_dim, D), c.q_dim),
         "mlp_norm": jnp.ones((L, D), c.dtype),
-        "w_gate": dense(ks[4], (L, D, F), D),
-        "w_up": dense(ks[5], (L, D, F), D),
-        "w_down": dense(ks[6], (L, F, D), F),
     }
+    if c.num_experts > 0:
+        E = c.num_experts
+        layers["router"] = dense(ks[7], (L, D, E), D)
+        layers["w_gate"] = dense(ks[4], (L, E, D, F), D)
+        layers["w_up"] = dense(ks[5], (L, E, D, F), D)
+        layers["w_down"] = dense(ks[6], (L, E, F, D), F)
+    else:
+        layers["w_gate"] = dense(ks[4], (L, D, F), D)
+        layers["w_up"] = dense(ks[5], (L, D, F), D)
+        layers["w_down"] = dense(ks[6], (L, F, D), F)
     if c.qkv_bias:
         layers["bq"] = jnp.zeros((L, c.q_dim), c.dtype)
         layers["bk"] = jnp.zeros((L, c.kv_dim), c.dtype)
@@ -103,13 +110,14 @@ def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
 def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
            cos: jax.Array, sin: jax.Array,
            cache_kv: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
-           kv_mask) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+           kv_mask):
     """One transformer block. x: (B, S, D).
 
     Without cache_kv: full self-attention over the block's own k/v.
     With cache_kv=(k_cache, v_cache, length): writes new k/v at ``length``,
-    attends over the whole cache. Returns (x', (k_cache', v_cache')) — in the
-    no-cache case the returned pair is the block's own (k, v).
+    attends over the whole cache. Returns (x', (k_cache', v_cache'), aux)
+    — in the no-cache case the returned pair is the block's own (k, v);
+    aux is the MoE load-balancing loss (0 for dense layers).
     """
     b, s, _ = x.shape
     h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
@@ -141,10 +149,23 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
     x = x + jnp.einsum("bse,ed->bsd", out.reshape(b, s, c.q_dim), lp["wo"])
 
     h = rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
+    if c.num_experts > 0:
+        from ..parallel.expert import MoEConfig, moe_ffn
+        moe_cfg = MoEConfig(hidden_size=c.hidden_size,
+                            intermediate_size=c.intermediate_size,
+                            num_experts=c.num_experts,
+                            top_k=c.num_experts_per_tok,
+                            capacity_factor=c.expert_capacity_factor,
+                            dtype=c.dtype)
+        moe_params = {"router": lp["router"], "w_gate": lp["w_gate"],
+                      "w_up": lp["w_up"], "w_down": lp["w_down"]}
+        ffn_out, aux = moe_ffn(moe_params, moe_cfg, h)
+        return x + ffn_out, kv_out, aux
     gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    return x + jnp.einsum("bsf,fd->bsd", act, lp["w_down"]), kv_out
+    return (x + jnp.einsum("bsf,fd->bsd", act, lp["w_down"]), kv_out,
+            jnp.zeros((), jnp.float32))
 
 
 def forward(
@@ -155,20 +176,28 @@ def forward(
     cache: Optional[KVCache] = None,
     positions: Optional[jax.Array] = None,   # (B, S) absolute positions
     attn_mask: Optional[jax.Array] = None,   # (B, S_kv) True = valid
-) -> Tuple[jax.Array, Optional[KVCache]]:
+    with_aux: bool = False,
+):
     """Run the model. Without cache: full causal self-attention over ``tokens``.
     With cache: ``tokens`` are appended at ``cache.length`` and attend to
     everything up to that point (prefill and decode use the same path).
 
-    Returns (logits (B, S, V) fp32, updated cache or None).
+    Returns (logits (B, S, V) fp32, updated cache or None); with
+    ``with_aux=True`` also the summed MoE load-balancing loss (the router
+    must see it in the objective or it is free to collapse).
     """
     c = config
     if c.matmul_precision is not None:
         with jax.default_matmul_precision(c.matmul_precision):
-            return _forward_impl(params, c, tokens, cache=cache,
-                                 positions=positions, attn_mask=attn_mask)
-    return _forward_impl(params, c, tokens, cache=cache, positions=positions,
-                         attn_mask=attn_mask)
+            out = _forward_impl(params, c, tokens, cache=cache,
+                                positions=positions, attn_mask=attn_mask)
+    else:
+        out = _forward_impl(params, c, tokens, cache=cache,
+                            positions=positions, attn_mask=attn_mask)
+    logits, new_cache, aux = out
+    if with_aux:
+        return logits, new_cache, aux
+    return logits, new_cache
 
 
 def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
@@ -184,11 +213,13 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
 
     if cache is None:
-        def body(x, lp):
-            x, _ = _layer(c, lp, x, cos, sin, None, attn_mask)
-            return x, None
+        def body(carry, lp):
+            x, aux = carry
+            x, _, layer_aux = _layer(c, lp, x, cos, sin, None, attn_mask)
+            return (x, aux + layer_aux), None
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        (x, aux_total), _ = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"])
         new_cache = None
     else:
         max_len = cache.k.shape[2]
@@ -200,14 +231,16 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
         if attn_mask is not None:
             valid = valid & attn_mask
 
-        def body(x, inputs):
+        def body(carry, inputs):
+            x, aux = carry
             lp, k_cache, v_cache = inputs
-            x, (k_cache, v_cache) = _layer(
+            x, (k_cache, v_cache), layer_aux = _layer(
                 c, lp, x, cos, sin, (k_cache, v_cache, cache.length), valid)
-            return x, (k_cache, v_cache)
+            return (x, aux + layer_aux), (k_cache, v_cache)
 
-        x, (k_upd, v_upd) = jax.lax.scan(
-            body, x, (params["layers"], cache.k, cache.v))
+        (x, aux_total), (k_upd, v_upd) = jax.lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)),
+            (params["layers"], cache.k, cache.v))
         new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
@@ -216,7 +249,7 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, head)
-    return logits.astype(jnp.float32), new_cache
+    return logits.astype(jnp.float32), new_cache, aux_total
 
 
 def count_params(params: Params) -> int:

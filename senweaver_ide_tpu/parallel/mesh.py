@@ -64,8 +64,10 @@ def single_device_mesh() -> Mesh:
 
 
 def data_sharding(mesh: Mesh) -> NamedSharding:
-    """Batch-major input sharding: batch over (dp, fsdp), sequence over sp."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    """Batch-major input sharding: batch over (dp, fsdp), sequence over sp
+    (restricted to the axes the mesh actually has)."""
+    from .sharding import restrict_spec
+    return NamedSharding(mesh, restrict_spec(P(("dp", "fsdp"), "sp"), mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -87,7 +87,7 @@ def pipeline_forward(params: Params, config: ModelConfig,
 
     def stage_apply(stage_lp, h, cos_mb, sin_mb, mask_mb):
         def body(hh, lp):
-            hh, _ = _layer(c, lp, hh, cos_mb, sin_mb, None, mask_mb)
+            hh, _, _aux = _layer(c, lp, hh, cos_mb, sin_mb, None, mask_mb)
             return hh, None
         h, _ = jax.lax.scan(body, h, stage_lp)
         return h

@@ -42,6 +42,15 @@ PARAM_SPECS: Dict[str, P] = {
     "layers/w_gate": P(None, "fsdp", "tp"),
     "layers/w_up": P(None, "fsdp", "tp"),
     "layers/w_down": P(None, "tp", "fsdp"),
+    "layers/router": P(None, "fsdp", None),
+}
+
+# MoE variants: expert banks carry an extra (E,) axis after the layer
+# axis, sharded over 'ep' (models/config.py num_experts > 0).
+MOE_PARAM_SPECS: Dict[str, P] = {
+    "layers/w_gate": P(None, "ep", "fsdp", "tp"),
+    "layers/w_up": P(None, "ep", "fsdp", "tp"),
+    "layers/w_down": P(None, "ep", "tp", "fsdp"),
 }
 
 # Activation specs.
@@ -51,7 +60,25 @@ LOGITS_SPEC = P(("dp", "fsdp"), "sp", "tp")       # (B, S, V)
 KV_CACHE_SPEC = P(None, ("dp", "fsdp"), None, "tp", None)
 
 
-def spec_for_path(path: str) -> P:
+def restrict_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axes the mesh does not have (a tp-only serving mesh must not
+    reject the canonical specs that also name dp/fsdp/sp)."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in names)
+            return kept if len(kept) > 1 else (kept[0] if kept else None)
+        return entry if entry in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def spec_for_path(path: str, ndim: int = -1) -> P:
+    if path in MOE_PARAM_SPECS and ndim == 4:
+        return MOE_PARAM_SPECS[path]
     if path in PARAM_SPECS:
         return PARAM_SPECS[path]
     raise KeyError(f"no sharding rule for param path {path!r}")
@@ -63,21 +90,23 @@ def param_specs(params: Any) -> Any:
         if isinstance(tree, dict):
             return {k: walk(v, f"{prefix}/{k}" if prefix else k)
                     for k, v in tree.items()}
-        return spec_for_path(prefix)
+        return spec_for_path(prefix, getattr(tree, "ndim", -1))
 
     return walk(params, "")
 
 
 def shard_params(params: Any, mesh: Mesh) -> Any:
-    """Place a param pytree onto the mesh per PARAM_SPECS."""
+    """Place a param pytree onto the mesh per PARAM_SPECS (restricted to
+    the mesh's axes)."""
     specs = param_specs(params)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+        lambda x, s: jax.device_put(
+            x, NamedSharding(mesh, restrict_spec(s, mesh))), params, specs)
 
 
 def param_shardings(params: Any, mesh: Mesh) -> Any:
     """NamedSharding pytree (for jit in_shardings/out_shardings)."""
     specs = param_specs(params)
     return jax.tree_util.tree_map(
-        lambda s: NamedSharding(mesh, s), specs,
+        lambda s: NamedSharding(mesh, restrict_spec(s, mesh)), specs,
         is_leaf=lambda x: isinstance(x, P))

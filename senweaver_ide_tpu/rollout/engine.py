@@ -107,18 +107,33 @@ class RolloutEngine:
     def __init__(self, params: Params, config: ModelConfig, *,
                  num_slots: int = 8, max_len: int = 2048,
                  sample: SampleParams = SampleParams(),
-                 eos_id: Optional[int] = None, seed: int = 0):
-        self.params = params
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 mesh=None):
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
         self.sample = sample
         self.eos_id = eos_id
+        # Optional tensor-parallel serving: params take the Megatron
+        # layout and the KV cache shards its head axis over 'tp'
+        # (SURVEY.md §2.7 'continuous-batching sampler with TP-sharded
+        # KV cache'); jit then compiles collectives from the shardings.
+        self.mesh = mesh
+        self.params = self._place_params(params)
         self._key = jax.random.PRNGKey(seed)
         shape = (config.num_layers, num_slots, max_len, config.num_kv_heads,
                  config.head_dim)
-        self.cache = KVCache(k=jnp.zeros(shape, config.dtype),
-                             v=jnp.zeros(shape, config.dtype),
+        k0 = jnp.zeros(shape, config.dtype)
+        v0 = jnp.zeros(shape, config.dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from ..parallel.sharding import KV_CACHE_SPEC, restrict_spec
+            cache_sharding = NamedSharding(mesh,
+                                           restrict_spec(KV_CACHE_SPEC,
+                                                         mesh))
+            k0 = jax.device_put(k0, cache_sharding)
+            v0 = jax.device_put(v0, cache_sharding)
+        self.cache = KVCache(k=k0, v=v0,
                              length=jnp.zeros((num_slots,), jnp.int32))
         self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
@@ -130,6 +145,20 @@ class RolloutEngine:
         # Many agent loops (subagent threads) drive one engine: all state
         # mutation is serialized; concurrency = slots, not host threads.
         self._lock = threading.RLock()
+
+    def _place_params(self, params: Params) -> Params:
+        if self.mesh is None:
+            return params
+        from ..parallel.sharding import shard_params
+        return shard_params(params, self.mesh)
+
+    def update_params(self, params: Params) -> None:
+        """On-policy weight sync: the trainer hands over fresh params
+        between rounds (sampler/trainer overlap, SURVEY.md §7). KV cache
+        and in-flight requests are untouched — callers should sync at
+        round boundaries when slots are idle."""
+        with self._lock:
+            self.params = self._place_params(params)
 
     # -- public API ---------------------------------------------------------
 

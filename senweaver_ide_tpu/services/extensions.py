@@ -58,6 +58,7 @@ class ExtensionServer:
         self._proc: Optional[subprocess.Popen] = None
         self._lock = threading.Lock()
         self._next_id = 1
+        self._rx_buf = b""          # bytes read past the current line
         self.tools: List[ExtensionTool] = []
 
     # -- lifecycle ---------------------------------------------------------
@@ -81,6 +82,7 @@ class ExtensionServer:
     def restart(self) -> None:
         """close/recreate on failure (mcpChannel.ts:144-151)."""
         self.stop()
+        self._rx_buf = b""
         self.start()
 
     def stop(self) -> None:
@@ -93,18 +95,21 @@ class ExtensionServer:
             self._proc = None
 
     # -- rpc ---------------------------------------------------------------
-    def _read_line_with_timeout(self) -> str:
+    def _read_line_with_timeout(self, deadline: float) -> str:
         """Deadline-bounded readline on the child's stdout — a wedged
-        server must raise, not hang the agent loop with the lock held."""
+        server must raise, not hang the agent loop with the lock held.
+        Bytes past the newline stay in ``_rx_buf`` for the next line (a
+        server may flush several lines at once)."""
         import os as _os
         import selectors as _selectors
         assert self._proc and self._proc.stdout
+        if b"\n" in self._rx_buf:
+            line, self._rx_buf = self._rx_buf.split(b"\n", 1)
+            return line.decode(errors="replace")
         fd = self._proc.stdout.fileno()
         _os.set_blocking(fd, False)
         sel = _selectors.DefaultSelector()
         sel.register(fd, _selectors.EVENT_READ)
-        deadline = _time.monotonic() + self.timeout_s
-        chunks: list[bytes] = []
         try:
             while True:
                 remaining = deadline - _time.monotonic()
@@ -118,10 +123,10 @@ class ExtensionServer:
                 if not data:
                     raise ExtensionTransportError(
                         f"{self.name}: server closed the stream")
-                chunks.append(data)
-                if b"\n" in data:
-                    return b"".join(chunks).split(b"\n", 1)[0] \
-                        .decode(errors="replace")
+                self._rx_buf += data
+                if b"\n" in self._rx_buf:
+                    line, self._rx_buf = self._rx_buf.split(b"\n", 1)
+                    return line.decode(errors="replace")
         finally:
             sel.close()
 
@@ -141,16 +146,19 @@ class ExtensionServer:
             except OSError as e:
                 raise ExtensionTransportError(
                     f"{self.name}: io error: {e}")
-            line = self._read_line_with_timeout()
-            try:
-                resp = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise ExtensionTransportError(
-                    f"{self.name}: bad response: {e}")
-            if "error" in resp:
-                raise ExtensionServerError(
-                    f"{self.name}: {resp['error'].get('message')}")
-            return resp.get("result")
+            deadline = _time.monotonic() + self.timeout_s
+            while True:
+                line = self._read_line_with_timeout(deadline)
+                try:
+                    resp = json.loads(line)
+                except json.JSONDecodeError:
+                    continue     # stray log line on stdout: skip it
+                if resp.get("id") != rid:
+                    continue     # late response from a timed-out call
+                if "error" in resp:
+                    raise ExtensionServerError(
+                        f"{self.name}: {resp['error'].get('message')}")
+                return resp.get("result")
 
     def call_tool(self, tool: str, arguments: Dict[str, Any]) -> Any:
         return self._request("tools/call",

@@ -238,6 +238,25 @@ class Workspace:
         start = (page_number - 1) * page_size
         return hits[start:start + page_size], len(hits) > start + page_size
 
+    def search_lines(self, pattern: str, *,
+                     base: Optional[str] = None
+                     ) -> Iterator[Tuple[str, int, str]]:
+        """One-pass workspace grep: yields (display_path, 1-based line,
+        line text) for every line matching the regex — each file read
+        once, for callers that need all matches across the tree (edit
+        prediction) without N separate walks."""
+        pat = re.compile(pattern)
+        root = self.resolve(base) if base else None
+        for f in self._walk_files(root):
+            try:
+                text = f.read_text(errors="replace")
+            except (OSError, UnicodeError):
+                continue
+            display = self.display(f)
+            for i, line in enumerate(text.split("\n"), start=1):
+                if pat.search(line):
+                    yield display, i, line
+
     def search_in_file(self, path: str, query: str, *,
                        is_regex: bool = False) -> List[int]:
         """1-based start line numbers where the query matches

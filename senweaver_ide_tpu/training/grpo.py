@@ -29,6 +29,7 @@ class GRPOConfig(NamedTuple):
     entropy_coef: float = 0.0
     normalize_std: bool = True
     min_group_std: float = 1e-4
+    moe_aux_coef: float = 0.01   # MoE load-balance weight (num_experts > 0)
 
 
 def group_relative_advantages(

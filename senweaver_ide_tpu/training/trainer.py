@@ -20,7 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.config import ModelConfig
 from ..models.transformer import Params, forward, init_params
 from ..parallel.mesh import make_mesh
-from ..parallel.sharding import param_shardings, param_specs, shard_params
+from ..parallel.sharding import (param_shardings, param_specs,
+                                 restrict_spec, shard_params)
 from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 
@@ -78,7 +79,7 @@ def _opt_state_shardings(opt, params, mesh):
 
     def leaf_sharding(leaf):
         spec = shape_to_spec.get((leaf.shape, leaf.dtype), P())
-        return NamedSharding(mesh, spec)
+        return NamedSharding(mesh, restrict_spec(spec, mesh))
 
     return jax.tree_util.tree_map(leaf_sharding, shapes)
 
@@ -103,11 +104,15 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     tgt_mask = completion_mask[:, 1:]
 
     def loss_fn(params):
-        logits, _ = forward(params, config, inputs)
+        logits, _, moe_aux = forward(params, config, inputs, with_aux=True)
         logp = token_logprobs(logits, targets)
         olp = old_logp if old_logp is not None else jax.lax.stop_gradient(logp)
         loss, metrics = grpo_objective(logp, olp, adv, tgt_mask, grpo_config,
                                        ref_logp=ref_logp)
+        if config.num_experts > 0:
+            loss = loss + grpo_config.moe_aux_coef * moe_aux
+            metrics = dict(metrics)
+            metrics["moe_aux"] = moe_aux
         return loss, metrics
 
     (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(

@@ -1,0 +1,62 @@
+"""APO closed loop against the LOCAL policy (no backend): synthetic
+6-pattern corpus → analyze → textual gradient → beam search → segment
+apply → rules injected under the 2000-char budget."""
+
+from senweaver_ide_tpu.agents.llm import LLMResponse, LLMUsage
+from senweaver_ide_tpu.apo import make_local_apo
+from senweaver_ide_tpu.prompts import render_apo_rules
+
+
+class Client:
+    """Scripted 'optimizer policy': critique then rule-list edits."""
+
+    def __init__(self):
+        self.n = 0
+
+    def chat(self, messages, *, temperature=None, max_tokens=None):
+        self.n += 1
+        prompt = messages[-1].content
+        if "critique" in prompt.lower() or "weaknesses" in prompt.lower():
+            text = (f"Critique {self.n}: tool calls fail repeatedly; "
+                    "verification is missing.")
+        else:
+            text = (f"- Verify every edit with read_file (v{self.n})\n"
+                    "- Keep tool calls under the step budget\n"
+                    "- Re-read files before SEARCH/REPLACE edits")
+        return LLMResponse(text=text, usage=LLMUsage(50, 30), model="opt")
+
+
+def test_apo_local_full_cycle():
+    from senweaver_ide_tpu.apo.synthetic import (generate_good_traces,
+                                                 generate_pattern_traces)
+    from senweaver_ide_tpu.traces import TraceCollector
+    collector = TraceCollector(max_traces=10_000)
+    for p in range(1, 7):
+        generate_pattern_traces(p, 4, collector, mode="agent")
+    generate_good_traces(8, collector, mode="agent")
+    apo = make_local_apo(collector, Client())
+    # Gates: corpus has enough traces/feedbacks.
+    assert apo.should_auto_analyze()
+    report = apo.analyze()
+    assert report.total_conversations >= 30
+    assert len(report.patterns) >= 4
+
+    tg = apo.request_textual_gradient()
+    assert tg is not None and "Critique" in tg.critique
+    assert apo.segments.suggestions          # edit became a suggestion
+
+    state = apo.run_beam_search("- Always answer helpfully")
+    assert state.history_best_prompt is not None
+    assert state.current_round == apo.config.beam_rounds
+
+    rules = apo.get_optimized_rules()
+    assert rules
+    section = render_apo_rules(rules)
+    assert section.startswith("# APO Optimized Rules")
+    assert len(section) <= 2000
+
+
+def test_apo_local_gradient_needs_feedback_traces():
+    from senweaver_ide_tpu.traces import TraceCollector
+    apo = make_local_apo(TraceCollector(), Client())
+    assert apo.request_textual_gradient() is None

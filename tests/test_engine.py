@@ -161,3 +161,31 @@ def test_engine_thread_safety_parallel_clients():
     assert len(results) == 4
     for r in results.values():
         assert 1 <= r.usage.output_tokens <= 6
+
+
+def test_engine_tp_sharded_and_weight_sync():
+    """TP-sharded serving on a 2-device mesh + on-policy weight sync."""
+    import jax
+    import numpy as np
+
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.parallel import make_named_mesh
+    from senweaver_ide_tpu.rollout import RolloutEngine
+
+    config = get_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    mesh = make_named_mesh({"tp": 2}, devices=jax.devices()[:2])
+    ref = RolloutEngine(params, config, num_slots=2, max_len=256, seed=3)
+    eng = RolloutEngine(params, config, num_slots=2, max_len=256, seed=3,
+                        mesh=mesh)
+    prompt = list(range(1, 20))
+    r1 = ref.submit(prompt, max_new_tokens=6)
+    r2 = eng.submit(prompt, max_new_tokens=6)
+    out_ref = ref.run()[r1]
+    out_tp = eng.run()[r2]
+    assert out_tp == out_ref            # same seed → identical sampling
+
+    new_params = init_params(config, jax.random.PRNGKey(9))
+    eng.update_params(new_params)
+    r3 = eng.submit(prompt, max_new_tokens=6)
+    assert len(eng.run()[r3]) == 6
