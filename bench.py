@@ -68,17 +68,23 @@ def main() -> None:
     max_len = PROMPT_LEN + DECODE_TOKENS
     sample = SampleParams(temperature=0.8, top_k=0, top_p=0.0)
 
+    import numpy as np
+
     def run(key):
         cache = init_kv_cache(config, BATCH, max_len)
         toks, _ = generate_scan(params, config, prompt, cache, key,
                                 max_new_tokens=DECODE_TOKENS, sample=sample)
-        return jax.block_until_ready(toks)
+        # Materialize on HOST: under remote-device platforms (axon tunnel)
+        # block_until_ready alone does not guarantee the computation ran —
+        # the device→host copy is the only airtight completion barrier.
+        return np.asarray(toks)
 
     run(jax.random.PRNGKey(1))  # warmup: compile prefill + decode scan
 
     t0 = time.perf_counter()
     for i in range(TIMED_ITERS):
-        run(jax.random.PRNGKey(2 + i))
+        out = run(jax.random.PRNGKey(2 + i))
+    assert out.shape == (BATCH, DECODE_TOKENS)
     elapsed = time.perf_counter() - t0
 
     toks_per_sec = BATCH * DECODE_TOKENS * TIMED_ITERS / elapsed

@@ -87,21 +87,49 @@ def grpo_round(state: TrainState, model_config, mesh,
                tasks: Sequence[str], *, group_size: int = 4,
                pad_id: int = 0, max_len: Optional[int] = None,
                grpo_config: GRPOConfig = GRPOConfig(),
-               reward_override=None) -> RoundResult:
-    """One on-policy round: collect → batch → single GRPO step."""
+               reward_override=None,
+               metrics_service=None) -> RoundResult:
+    """One on-policy round: collect → batch → single GRPO step.
+
+    ``metrics_service`` (services.MetricsService) observes the trainer
+    itself (SURVEY.md §7 step 8): per-phase wall time, episode rewards,
+    and the update's loss/grad metrics — the trainer-side counterpart of
+    the agent loop's 'Agent Loop Done' capture
+    (chatThreadService.ts:1742)."""
+    import time as _time
+    t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
         make_session, tasks, group_size=group_size,
         reward_override=reward_override)
+    collect_s = _time.monotonic() - t0
     if not trajectories:
+        if metrics_service is not None:
+            metrics_service.capture("GRPO Round Empty",
+                                    {"tasks": len(tasks),
+                                     "collect_s": round(collect_s, 3)})
         return RoundResult(state=state, metrics={}, episodes=episodes,
                            trajectories=[])
     tokens, mask, rewards, group_ids = make_batch(
         trajectories, pad_id=pad_id, max_len=max_len)
+    t1 = _time.monotonic()
     state, metrics = train_step(
         state, model_config, mesh, jnp.asarray(tokens), jnp.asarray(mask),
         jnp.asarray(rewards), jnp.asarray(group_ids),
         grpo_config=grpo_config)
+    out_metrics = {k: float(v) for k, v in metrics.items()}
+    if metrics_service is not None:
+        ep_rewards = [e.reward for e in episodes]
+        metrics_service.capture("GRPO Round Done", {
+            "tasks": len(tasks), "group_size": group_size,
+            "episodes": len(episodes),
+            "trajectories": len(trajectories),
+            "batch_tokens": int(tokens.size),
+            "reward_mean": sum(ep_rewards) / len(ep_rewards),
+            "reward_min": min(ep_rewards), "reward_max": max(ep_rewards),
+            "collect_s": round(collect_s, 3),
+            "train_s": round(_time.monotonic() - t1, 3),
+            **{k: round(v, 6) for k, v in out_metrics.items()},
+        })
     return RoundResult(
-        state=state,
-        metrics={k: float(v) for k, v in metrics.items()},
+        state=state, metrics=out_metrics,
         episodes=episodes, trajectories=trajectories)

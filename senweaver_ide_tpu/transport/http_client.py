@@ -1,0 +1,115 @@
+"""OpenAI-compatible HTTP chat client (PolicyClient-shaped).
+
+The remote half of the transport layer: one client covers every
+openai-compatible provider in the registry, exactly as the reference
+consolidates 18 of its 20 providers onto `_sendOpenAICompatibleChat`
+(sendLLMMessage.impl.ts:338 + newOpenAICompatibleSDK :94-181). Built on
+urllib (no SDK deps); rate limiting is the reactive TPM limiter
+(context/rate_limiter.py) and errors map onto the agent loop's retry
+classes (RateLimitError / ContextLengthError).
+
+Hermetic environments have zero egress: calls fail fast with a clear
+TransportUnavailable unless the environment provides connectivity — the
+registry + client still define the full API surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from ..agents.llm import (ChatMessage, ContextLengthError, LLMResponse,
+                          LLMUsage, RateLimitError)
+from ..context.rate_limiter import TPMRateLimiter, tpm_rate_limiter
+from .providers import ProviderSettings, get_provider
+
+
+class TransportUnavailable(RuntimeError):
+    pass
+
+
+class OpenAICompatClient:
+    """PolicyClient over an openai-compatible /chat/completions endpoint."""
+
+    def __init__(self, provider: str, *, model: Optional[str] = None,
+                 base_url: Optional[str] = None,
+                 api_key: Optional[str] = None,
+                 timeout_s: float = 120.0,
+                 rate_limiter: Optional[TPMRateLimiter] = None):
+        settings = get_provider(provider) or ProviderSettings(
+            provider, "openai-compat")
+        self.provider = settings.name
+        self.model = model or settings.default_model
+        self.base_url = (base_url or settings.base_url).rstrip("/")
+        if not self.base_url:
+            raise ValueError(f"provider {provider} needs a base_url")
+        self.api_key = api_key or (os.environ.get(settings.api_key_env)
+                                   if settings.api_key_env else None)
+        self.timeout_s = timeout_s
+        self.limiter = rate_limiter or tpm_rate_limiter
+
+    def chat(self, messages: List[ChatMessage], *,
+             temperature: Optional[float] = None,
+             max_tokens: Optional[int] = None) -> LLMResponse:
+        wait = self.limiter.get_wait_time(self.provider)
+        if wait > 0:
+            import time
+            time.sleep(wait)
+        body = {
+            "model": self.model,
+            "messages": [{"role": m.role if m.role != "tool" else "user",
+                          "content": m.content} for m in messages],
+        }
+        if temperature is not None:
+            body["temperature"] = temperature
+        if max_tokens is not None:
+            body["max_tokens"] = max_tokens
+        req = urllib.request.Request(
+            f"{self.base_url}/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.api_key}"}
+                        if self.api_key else {})},
+            method="POST")
+        self.limiter.record_request_start(self.provider)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as resp:
+                payload = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = e.read().decode(errors="replace")[:500]
+            except Exception:
+                pass
+            if e.code == 429:
+                retry_after = None
+                ra = e.headers.get("retry-after") if e.headers else None
+                if ra:
+                    try:
+                        retry_after = float(ra)
+                    except ValueError:
+                        pass
+                self.limiter.record_rate_limit_error(self.provider,
+                                                     retry_after)
+                raise RateLimitError(f"{self.provider}: 429 {detail}",
+                                     retry_after_s=retry_after)
+            if e.code == 400 and ("context" in detail.lower()
+                                  or "token" in detail.lower()):
+                raise ContextLengthError(f"{self.provider}: {detail}")
+            raise RuntimeError(f"{self.provider}: HTTP {e.code} {detail}")
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise TransportUnavailable(
+                f"{self.provider} unreachable at {self.base_url}: {e}")
+        self.limiter.record_success(self.provider)
+        choice = (payload.get("choices") or [{}])[0]
+        usage = payload.get("usage") or {}
+        return LLMResponse(
+            text=(choice.get("message") or {}).get("content") or "",
+            usage=LLMUsage(
+                input_tokens=int(usage.get("prompt_tokens", 0)),
+                output_tokens=int(usage.get("completion_tokens", 0))),
+            model=payload.get("model", self.model))

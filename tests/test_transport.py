@@ -1,0 +1,89 @@
+"""Transport tests: provider registry + openai-compat client against a
+local in-process HTTP server (no egress needed)."""
+
+import http.server
+import json
+import threading
+
+import pytest
+
+from senweaver_ide_tpu.agents.llm import ChatMessage, RateLimitError
+from senweaver_ide_tpu.context.rate_limiter import TPMRateLimiter
+from senweaver_ide_tpu.transport import (PROVIDERS, OpenAICompatClient,
+                                         TransportUnavailable,
+                                         get_provider, resolve_model)
+
+
+def test_registry_surface():
+    assert len(PROVIDERS) >= 18
+    assert get_provider("local").endpoint_style == "local"
+    assert get_provider("deepseek").supports_fim
+    assert resolve_model("mistral") == ("mistral", "codestral-latest")
+    assert resolve_model("nope", "m")[0] == "local"
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    behavior = "ok"
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        if _Handler.behavior == "429":
+            _Handler.behavior = "ok"        # succeed on retry
+            self.send_response(429)
+            self.send_header("retry-after", "3")
+            self.end_headers()
+            self.wfile.write(b'{"error": "rate limited"}')
+            return
+        resp = {"model": body["model"],
+                "choices": [{"message": {
+                    "role": "assistant",
+                    "content": f"echo: {body['messages'][-1]['content']}"}}],
+                "usage": {"prompt_tokens": 7, "completion_tokens": 3}}
+        data = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def server():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_chat_roundtrip(server):
+    c = OpenAICompatClient("openai-compatible", model="m",
+                           base_url=server,
+                           rate_limiter=TPMRateLimiter())
+    resp = c.chat([ChatMessage("user", "hi")], max_tokens=16)
+    assert resp.text == "echo: hi"
+    assert resp.usage.input_tokens == 7 and resp.usage.output_tokens == 3
+
+
+def test_429_maps_to_rate_limit_error(server):
+    rl = TPMRateLimiter()
+    c = OpenAICompatClient("openai-compatible", model="m",
+                           base_url=server, rate_limiter=rl)
+    _Handler.behavior = "429"
+    with pytest.raises(RateLimitError) as ei:
+        c.chat([ChatMessage("user", "hi")])
+    assert ei.value.retry_after_s == 3.0
+    assert rl.get_wait_time("openai-compatible") > 0
+
+
+def test_unreachable_raises_transport_unavailable():
+    c = OpenAICompatClient("openai-compatible", model="m",
+                           base_url="http://127.0.0.1:9",   # closed port
+                           timeout_s=1.0,
+                           rate_limiter=TPMRateLimiter())
+    with pytest.raises(TransportUnavailable):
+        c.chat([ChatMessage("user", "hi")])
