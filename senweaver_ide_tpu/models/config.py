@@ -34,6 +34,19 @@ class ModelConfig:
     dtype: jnp.dtype = jnp.bfloat16
     # Sliding-window attention width (None = full causal).
     sliding_window: Optional[int] = None
+    # Attention implementation for the no-cache (training/scoring) path:
+    #   "einsum"  — XLA einsum attention (ops/attention.py), materializes
+    #               the (Sq, Skv) score matrix; fine for short sequences.
+    #   "flash"   — Pallas flash-attention kernel (ops/flash_attention.py),
+    #               O(S·block) memory; interpret-mode on non-TPU backends.
+    #   "ring"    — ring attention over the 'sp' mesh axis
+    #               (parallel/ring_attention.py); requires forward(mesh=...)
+    #               with an sp axis and S divisible by its size.
+    #   "ulysses" — Ulysses all-to-all head/sequence swap over 'sp'; head
+    #               counts must divide by the sp axis size.
+    # The KV-cache (decode) path is unaffected — it has its own fused
+    # decode kernel selection (rollout plane).
+    attn_impl: str = "einsum"
     # jax.default_matmul_precision for the forward pass. None = platform
     # default (bf16 MXU passes — the fast path for real models). The fp32
     # test config pins "highest" so cache-vs-full decode parity is exact.
@@ -63,7 +76,12 @@ def qwen2_5_coder_0_5b() -> ModelConfig:
 
 
 def qwen2_5_coder_1_5b() -> ModelConfig:
-    """The flagship bench model (BASELINE config 3)."""
+    """The flagship bench model (BASELINE config 3).
+
+    Pretrained weights: point ``models.load.load_hf_params`` at a local
+    HF-layout directory (e.g. a downloaded Qwen/Qwen2.5-Coder-1.5B snapshot
+    containing model.safetensors[.index.json]); same for every preset here.
+    """
     return ModelConfig(
         name="qwen2.5-coder-1.5b", vocab_size=151_936, hidden_size=1536,
         intermediate_size=8960, num_layers=28, num_heads=12, num_kv_heads=2,

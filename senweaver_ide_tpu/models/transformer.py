@@ -107,13 +107,44 @@ def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
     return q, k, v
 
 
+def _self_attention(c: ModelConfig, q, k, v, kv_mask, mesh):
+    """No-cache attention dispatch per ``c.attn_impl`` (training/scoring
+    path). q (B,S,Hq,Dh), k/v (B,S,Hkv,Dh) → (B,S,Hq,Dh)."""
+    if c.attn_impl == "einsum":
+        return attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True)
+    if c.attn_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(q, k, v, q_offset=0, kv_mask=kv_mask,
+                               causal=True)
+    if c.attn_impl in ("ring", "ulysses"):
+        from ..parallel.ring_attention import (make_ring_attention,
+                                               make_ulysses_attention)
+        if mesh is None or "sp" not in mesh.axis_names:
+            raise ValueError(
+                f"attn_impl={c.attn_impl!r} needs forward(mesh=...) with an "
+                f"'sp' axis; got {mesh}")
+        if c.attn_impl == "ulysses":
+            if kv_mask is not None:
+                raise NotImplementedError(
+                    "ulysses attention does not take a kv mask; pre-mask "
+                    "k/v or use attn_impl='ring'")
+            return make_ulysses_attention(mesh)(q, k, v)
+        if kv_mask is not None:
+            return make_ring_attention(mesh, with_mask=True)(q, k, v, kv_mask)
+        return make_ring_attention(mesh)(q, k, v)
+    raise ValueError(f"unknown attn_impl {c.attn_impl!r}; expected "
+                     f"einsum|flash|ring|ulysses")
+
+
 def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
            cos: jax.Array, sin: jax.Array,
            cache_kv: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
-           kv_mask):
+           kv_mask, mesh=None):
     """One transformer block. x: (B, S, D).
 
-    Without cache_kv: full self-attention over the block's own k/v.
+    Without cache_kv: full self-attention over the block's own k/v, via the
+    ``c.attn_impl`` kernel (einsum / flash / ring / ulysses — the latter two
+    shard the sequence axis over the mesh's 'sp' axis).
     With cache_kv=(k_cache, v_cache, length): writes new k/v at ``length``,
     attends over the whole cache. Returns (x', (k_cache', v_cache'), aux)
     — in the no-cache case the returned pair is the block's own (k, v);
@@ -143,7 +174,7 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                         causal=True)
         kv_out = (k_cache, v_cache)
     else:
-        out = attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True)
+        out = _self_attention(c, q, k, v, kv_mask, mesh)
         kv_out = (k, v)
 
     x = x + jnp.einsum("bse,ed->bsd", out.reshape(b, s, c.q_dim), lp["wo"])
@@ -177,10 +208,15 @@ def forward(
     positions: Optional[jax.Array] = None,   # (B, S) absolute positions
     attn_mask: Optional[jax.Array] = None,   # (B, S_kv) True = valid
     with_aux: bool = False,
+    mesh=None,                               # required for ring/ulysses attn
 ):
     """Run the model. Without cache: full causal self-attention over ``tokens``.
     With cache: ``tokens`` are appended at ``cache.length`` and attend to
     everything up to that point (prefill and decode use the same path).
+
+    ``mesh`` (jax.sharding.Mesh) is only consulted when
+    ``config.attn_impl`` is 'ring'/'ulysses' — the sequence axis then
+    shards over its 'sp' axis inside shard_map.
 
     Returns (logits (B, S, V) fp32, updated cache or None); with
     ``with_aux=True`` also the summed MoE load-balancing loss (the router
@@ -190,17 +226,20 @@ def forward(
     if c.matmul_precision is not None:
         with jax.default_matmul_precision(c.matmul_precision):
             out = _forward_impl(params, c, tokens, cache=cache,
-                                positions=positions, attn_mask=attn_mask)
+                                positions=positions, attn_mask=attn_mask,
+                                mesh=mesh)
     else:
         out = _forward_impl(params, c, tokens, cache=cache,
-                            positions=positions, attn_mask=attn_mask)
+                            positions=positions, attn_mask=attn_mask,
+                            mesh=mesh)
     logits, new_cache, aux = out
     if with_aux:
         return logits, new_cache, aux
     return logits, new_cache
 
 
-def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
+def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
+                  mesh=None):
     b, s = tokens.shape
     x = params["embed"][tokens]  # gather; sharded vocab → XLA collective
 
@@ -215,7 +254,8 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask):
     if cache is None:
         def body(carry, lp):
             x, aux = carry
-            x, _, layer_aux = _layer(c, lp, x, cos, sin, None, attn_mask)
+            x, _, layer_aux = _layer(c, lp, x, cos, sin, None, attn_mask,
+                                     mesh=mesh)
             return (x, aux + layer_aux), None
 
         (x, aux_total), _ = jax.lax.scan(

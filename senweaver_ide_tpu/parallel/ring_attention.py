@@ -182,11 +182,18 @@ def _seq_specs(mesh: Mesh, axis_name: str):
 
 
 def make_ring_attention(mesh: Mesh, *, axis_name: str = "sp",
-                        causal: bool = True):
+                        causal: bool = True, with_mask: bool = False):
     """shard_mapped ring attention over global (B, S, H, D) arrays whose
-    sequence axis is sharded on ``axis_name``."""
+    sequence axis is sharded on ``axis_name``. With ``with_mask`` the
+    callable takes a fourth (B, S) bool kv-validity argument (sharded the
+    same way) — the per-chunk mask rotates around the ring with its KV."""
     spec, out_spec = _seq_specs(mesh, axis_name)
     fn = functools.partial(ring_attention, axis_name=axis_name, causal=causal)
+    if with_mask:
+        return shard_map(lambda q, k, v, m: fn(q, k, v, kv_mask=m),
+                         mesh=mesh,
+                         in_specs=(spec, spec, spec, P(None, axis_name)),
+                         out_specs=out_spec, check_rep=False)
     return shard_map(lambda q, k, v: fn(q, k, v), mesh=mesh,
                      in_specs=(spec, spec, spec), out_specs=out_spec,
                      check_rep=False)

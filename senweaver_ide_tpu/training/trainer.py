@@ -86,7 +86,7 @@ def _opt_state_shardings(opt, params, mesh):
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "grpo_config", "num_groups",
-                                    "optimizer"))
+                                    "optimizer", "mesh"))
 def _grpo_step(state: TrainState, config: ModelConfig,
                optimizer: optax.GradientTransformation,
                tokens: jax.Array, completion_mask: jax.Array,
@@ -94,7 +94,9 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                old_logp: Optional[jax.Array],
                ref_logp: Optional[jax.Array],
                grpo_config: GRPOConfig,
-               num_groups: int) -> Tuple[TrainState, Dict[str, jax.Array]]:
+               num_groups: int,
+               mesh: Optional[Mesh] = None,
+               ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     adv = group_relative_advantages(
         rewards, group_ids, num_groups,
         normalize_std=grpo_config.normalize_std,
@@ -104,7 +106,8 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     tgt_mask = completion_mask[:, 1:]
 
     def loss_fn(params):
-        logits, _, moe_aux = forward(params, config, inputs, with_aux=True)
+        logits, _, moe_aux = forward(params, config, inputs, with_aux=True,
+                                     mesh=mesh)
         logp = token_logprobs(logits, targets)
         olp = old_logp if old_logp is not None else jax.lax.stop_gradient(logp)
         loss, metrics = grpo_objective(logp, olp, adv, tgt_mask, grpo_config,
@@ -149,6 +152,6 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
         with mesh:
             return _grpo_step(state, config, opt, tokens, completion_mask,
                               rewards, group_ids, old_logp, ref_logp,
-                              grpo_config, n_groups)
+                              grpo_config, n_groups, mesh)
     return _grpo_step(state, config, opt, tokens, completion_mask, rewards,
                       group_ids, old_logp, ref_logp, grpo_config, n_groups)
