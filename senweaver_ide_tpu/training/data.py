@@ -68,6 +68,35 @@ def make_batch(trajectories: Sequence[Trajectory], *, pad_id: int,
     return tokens, mask, rewards, group_ids
 
 
+def pad_batch_for_mesh(
+    tokens: np.ndarray, mask: np.ndarray, rewards: np.ndarray,
+    group_ids: np.ndarray, *, batch_multiple: int = 1,
+    seq_multiple: int = 1, pad_id: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Pad a make_batch output so it shards evenly on a mesh: batch axis to
+    a multiple of (dp·fsdp), and the TRAINING sequence length (S−1, after
+    the trainer's next-token shift) to a multiple of sp. Padded rows get an
+    all-False mask, zero reward, and a fresh singleton group id each — they
+    contribute nothing to the masked objective or group advantages."""
+    b, s = tokens.shape
+    target_s = ((s - 1 + seq_multiple - 1) // seq_multiple) * seq_multiple + 1
+    if target_s > s:
+        pad = target_s - s
+        tokens = np.pad(tokens, ((0, 0), (0, pad)), constant_values=pad_id)
+        mask = np.pad(mask, ((0, 0), (0, pad)))
+    target_b = ((b + batch_multiple - 1) // batch_multiple) * batch_multiple
+    if target_b > b:
+        extra = target_b - b
+        tokens = np.pad(tokens, ((0, extra), (0, 0)), constant_values=pad_id)
+        mask = np.pad(mask, ((0, extra), (0, 0)))
+        rewards = np.pad(rewards, (0, extra))
+        next_gid = int(group_ids.max()) + 1 if b else 0
+        group_ids = np.concatenate(
+            [group_ids, np.arange(next_gid, next_gid + extra,
+                                  dtype=group_ids.dtype)])
+    return tokens, mask, rewards, group_ids
+
+
 class TrajectoryDataset:
     """Seeded-permutation epochs + a resumable cursor."""
 
@@ -83,7 +112,9 @@ class TrajectoryDataset:
 
     @property
     def batches_per_epoch(self) -> int:
-        return max(1, len(self._items) // self.batch_size)
+        # Ceil division: the final short batch is kept (dropping it would
+        # silently skew GRPO groups by a permutation-dependent remainder).
+        return max(1, -(-len(self._items) // self.batch_size))
 
     def _epoch_perm(self, epoch: int) -> np.ndarray:
         rng = np.random.default_rng((self.seed, epoch))
