@@ -3,6 +3,7 @@ from .config import (ModelConfig, PRESETS, get_config, qwen2_5_coder_0_5b,
                      deepseek_coder_6_7b, tiny_test)
 from .transformer import (KVCache, Params, count_params, forward,
                           init_kv_cache, init_params)
+from .load import available_hf_keys, export_hf_params, load_hf_params
 from .tokenizer import ByteTokenizer, HFTokenizer, load_tokenizer
 from .capabilities import (ModelCapabilities, get_model_capabilities,
                            get_reserved_output_token_space)
