@@ -1,0 +1,56 @@
+"""North-star uplift eval: baseline vs post-APO finalReward.
+
+Runs the full local APO cycle (baseline rollouts → textual-gradient beam
+search with prompt-conditioned candidate scoring → re-roll under winning
+rules) on the 6-pattern task suite and prints ONE JSON line with both
+scores (BASELINE north star: ≥2× finalReward vs the un-optimized prompt).
+
+Offline by default via the deterministic RuleSensitivePolicy
+(apo/eval.py); pass a local HF checkpoint dir to drive the REAL policy:
+
+    python eval_uplift.py [--model-dir /path/to/qwen2.5-coder-1.5b]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-dir", default=None,
+                    help="local HF-layout checkpoint; default = scripted "
+                         "hermetic policy")
+    ap.add_argument("--beam-rounds", type=int, default=2)
+    args = ap.parse_args()
+
+    from senweaver_ide_tpu.apo import run_uplift_eval
+
+    client = None
+    if args.model_dir:
+        import jax
+
+        from senweaver_ide_tpu.models import (get_config, load_hf_params,
+                                              load_tokenizer)
+        from senweaver_ide_tpu.rollout import (EnginePolicyClient,
+                                               RolloutEngine)
+        config = get_config("qwen2.5-coder-1.5b")
+        params = load_hf_params(args.model_dir, config)
+        engine = RolloutEngine(params, config)
+        client = EnginePolicyClient(engine, load_tokenizer(args.model_dir))
+
+    with tempfile.TemporaryDirectory() as workdir:
+        report = run_uplift_eval(workdir, client=client,
+                                 beam_rounds=args.beam_rounds)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always leave a JSON line
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

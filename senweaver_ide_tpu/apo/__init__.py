@@ -14,3 +14,5 @@ from .synthetic import (generate_good_traces, generate_pattern_traces,
                         make_six_pattern_corpus)
 from .local import (corpus_score_from_collector, make_local_apo,
                     policy_generate_fn)
+from .eval import (GOOD_RULESET, RuleSensitivePolicy, SIX_PATTERN_TASKS,
+                   evaluate_rules, make_rollout_score_fn, run_uplift_eval)

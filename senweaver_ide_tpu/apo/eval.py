@@ -1,0 +1,246 @@
+"""Prompt-conditioned candidate scoring + the north-star uplift eval.
+
+VERDICT r1's core APO gap: beam candidates were scored by a
+prompt-INDEPENDENT corpus baseline, so the search could never rank them.
+This module supplies the real scorer the reference keeps on its backend
+(``POST /api/apo/optimize`` scores candidates against rollouts,
+``apoService.ts:1102-1215``): each candidate rule-set is rendered into the
+system prompt of fresh RolloutSessions, the eval task suite is re-rolled
+under it, and the traces are batch-scored by the jit reward head
+(mean finalReward = the candidate's score).
+
+Two policy backends drive the same harness:
+- the REAL policy via ``rollout.EnginePolicyClient`` (weights loaded with
+  ``models/load.py``) — the north-star configuration;
+- :class:`RuleSensitivePolicy`, a deterministic scripted stand-in for
+  hermetic tests and the offline ``eval_uplift.py`` script (this
+  environment has no pretrained weights on disk and zero egress). It
+  misbehaves exactly like the 6 problem patterns unless the injected APO
+  rules demand careful tool use — giving the eval a ground-truth "better
+  prompt exists" structure without any network or checkpoint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+from ..agents.llm import ChatMessage, LLMResponse, LLMUsage, ToolCallRequest
+from ..rewards.head import reward_head_batch
+from ..traces.features import batch_features
+from ..traces.schema import Trace
+
+# An evaluation task per problem pattern (apoService.ts:643-770): the
+# prompts nudge a real policy toward the failure the pattern describes;
+# the scripted policy reproduces it deterministically.
+SIX_PATTERN_TASKS: List[str] = [
+    "Fix the crash in app.py (pattern: errors)",                     # P1
+    "Run the build and report failures (pattern: tool failures)",    # P2
+    "Summarize every file in the workspace (pattern: token blowup)", # P3
+    "Refactor app.py; retry until it works (pattern: retries)",      # P4
+    "Here is my fourth follow-up: still broken (pattern: churn)",    # P5
+    "Search the web for the API docs (pattern: slow tools)",         # P6
+]
+
+# The behavior contract between rules and the scripted policy: a rule-set
+# "wins" iff it demands verified, minimal tool use. A real policy has the
+# same structure statistically; the markers make it exact for tests.
+CAREFUL_MARKERS = ("verify", "read the file before", "minimal tool",
+                   "minimum number of tool calls")
+
+GOOD_RULESET = [
+    "Verify inputs and read the target file before any other tool call.",
+    "Use the minimum number of tool calls needed; never retry blindly.",
+]
+
+
+def evaluate_rules(
+    rules: Sequence[str],
+    make_session: Callable[[Sequence[str]], "RolloutSession"],
+    tasks: Sequence[str] = tuple(SIX_PATTERN_TASKS),
+    *,
+    feedback_fn: Optional[Callable[[int, object], Optional[str]]] = None,
+) -> float:
+    """Mean finalReward of ``tasks`` re-rolled under ``rules``.
+
+    ``make_session(rules)`` must return a FRESH session (own workspace +
+    collector) whose system prompt injects the rules (RolloutSession
+    ``apo_rules=``). ``feedback_fn(task_idx, turn_result)`` may return
+    'good'/'bad' to add the top-weight feedback dim (evaluator-in-the-loop).
+    Scoring is one vmapped reward-head pass over all collected traces.
+    """
+    traces: List[Trace] = []
+    for i, task in enumerate(tasks):
+        session = make_session(list(rules))
+        try:
+            out = session.run_turn(task)
+            if feedback_fn is not None:
+                fb = feedback_fn(i, out)
+                if fb:
+                    session.record_feedback(fb)
+            trace = (session.collector.get_trace(out.trace.id)
+                     if out.trace is not None else None)
+            if trace is not None:
+                traces.append(trace)
+        finally:
+            session.close()
+    if not traces:
+        return 0.0
+    import jax.numpy as jnp
+
+    feats = jnp.asarray(batch_features(traces))
+    return float(jnp.mean(reward_head_batch(feats).final_reward))
+
+
+def make_rollout_score_fn(
+    make_session: Callable[[Sequence[str]], "RolloutSession"],
+    tasks: Sequence[str] = tuple(SIX_PATTERN_TASKS),
+    *,
+    feedback_fn=None,
+) -> Callable[[Sequence[str]], float]:
+    """The default prompt-conditioned ScoreFn for ``make_local_apo``."""
+    def score(rules: Sequence[str]) -> float:
+        return evaluate_rules(rules, make_session, tasks,
+                              feedback_fn=feedback_fn)
+    return score
+
+
+@dataclasses.dataclass
+class RuleSensitivePolicy:
+    """Deterministic scripted PolicyClient for the hermetic APO eval.
+
+    Agent-loop calls (a system message is present): reads the
+    '# APO Optimized Rules' section; with a careful rule-set it performs
+    one successful read of ``good_file`` then answers; without, it burns
+    ``sloppy_calls`` failing tool calls and heavy token usage first —
+    the 6-pattern failure shape.
+
+    Optimizer calls (no system message): recognizes the textual-gradient
+    and apply-edit prompt shapes (apo/gradient.py) and returns a critique /
+    the improved rule-set — the scripted counterpart of the reference's
+    backend optimizer LLM.
+    """
+    good_file: str = "app.py"
+    sloppy_calls: int = 3
+    improved_rules: Sequence[str] = tuple(GOOD_RULESET)
+
+    def chat(self, messages: List[ChatMessage], *, temperature=None,
+             max_tokens=None) -> LLMResponse:
+        sysmsg = messages[0] if messages and messages[0].role == "system" \
+            else None
+        if sysmsg is None:
+            return self._optimizer_call(messages[-1].content if messages
+                                        else "")
+        rules_text = self._apo_rules_text(sysmsg.content).lower()
+        careful = any(m in rules_text for m in CAREFUL_MARKERS)
+        tool_msgs = sum(1 for m in messages if m.role == "tool")
+        if careful:
+            if tool_msgs == 0:
+                return LLMResponse(
+                    text="Checking the file first.",
+                    tool_call=ToolCallRequest("read_file",
+                                              {"uri": self.good_file}),
+                    usage=LLMUsage(300, 40), model="scripted")
+            return LLMResponse(text="Done: verified and fixed.",
+                               usage=LLMUsage(300, 40), model="scripted")
+        if tool_msgs < self.sloppy_calls:
+            return LLMResponse(
+                text="Trying something.",
+                tool_call=ToolCallRequest(
+                    "read_file", {"uri": f"missing_{tool_msgs}.py"}),
+                usage=LLMUsage(1500, 400), model="scripted")
+        return LLMResponse(text="It might be fixed now, not sure.",
+                           usage=LLMUsage(1500, 400), model="scripted")
+
+    # -- optimizer-side scripted responses --------------------------------
+    def _optimizer_call(self, prompt: str) -> LLMResponse:
+        if "## Critique" in prompt:      # apply-edit prompt
+            text = "\n".join(f"- {r}" for r in self.improved_rules)
+        else:                            # textual-gradient critique prompt
+            text = ("- Tool calls fail because inputs are never verified; "
+                    "require reading the target file before acting.\n"
+                    "- Cap tool-call count; retries without new information "
+                    "waste tokens.")
+        return LLMResponse(text=text, usage=LLMUsage(800, 120),
+                           model="scripted")
+
+    @staticmethod
+    def _apo_rules_text(system_message: str) -> str:
+        marker = "# APO Optimized Rules"
+        idx = system_message.find(marker)
+        if idx < 0:
+            return ""
+        section = system_message[idx + len(marker):]
+        nxt = section.find("\n# ")
+        return section[:nxt] if nxt >= 0 else section
+
+
+def run_uplift_eval(workdir: str, *, client=None,
+                    tasks: Sequence[str] = tuple(SIX_PATTERN_TASKS),
+                    beam_rounds: int = 2) -> dict:
+    """Baseline-vs-optimized finalReward on the pattern task suite (the
+    north-star ≥2× comparison, BASELINE configs 2-3), fully offline.
+
+    Flow (= the reference cycle, SURVEY.md §3.3, with the backend in-tree):
+    roll the tasks with NO rules (baseline; traces + 'bad' feedback feed
+    the gradient corpus) → run local beam search with the
+    prompt-conditioned scorer → re-roll under the winning rules → report.
+    """
+    import os
+
+    from ..rollout.session import RolloutSession
+    from ..traces.collector import TraceCollector
+    from .local import make_local_apo
+    from .types import APOConfig
+
+    client = client or RuleSensitivePolicy()
+    ws_counter = [0]
+
+    def make_session(rules, collector=None):
+        ws_counter[0] += 1
+        root = os.path.join(workdir, f"ws{ws_counter[0]}")
+        s = RolloutSession(client, root, apo_rules=list(rules),
+                          collector=collector,
+                          include_tool_definitions=False)
+        s.workspace.write_file("app.py", "def run():\n    return 1\n")
+        return s
+
+    # Baseline pass also populates the APO corpus (with the reference's
+    # feedback gate satisfied: gradient needs feedback'd traces).
+    corpus = TraceCollector()
+    baseline_traces: List[Trace] = []
+    for task in tasks:
+        s = make_session([], collector=corpus)
+        try:
+            out = s.run_turn(task)
+            s.record_feedback("bad")
+            if out.trace is not None:
+                baseline_traces.append(corpus.get_trace(out.trace.id))
+        finally:
+            s.close()
+    import jax.numpy as jnp
+
+    feats = jnp.asarray(batch_features([t for t in baseline_traces if t]))
+    baseline = float(jnp.mean(reward_head_batch(feats).final_reward))
+
+    apo = make_local_apo(
+        corpus, client,
+        config=APOConfig(beam_rounds=beam_rounds),
+        score_fn=make_rollout_score_fn(make_session, tasks))
+    state = apo.run_beam_search(seed_prompt="")
+    optimized_rules = apo.get_optimized_rules()
+    optimized = evaluate_rules(optimized_rules, make_session, tasks)
+
+    delta = optimized - baseline
+    return {
+        "baseline_final_reward": round(baseline, 4),
+        "optimized_final_reward": round(optimized, 4),
+        "uplift_delta": round(delta, 4),
+        # Ratio vs the positive-shifted scale [-1, 1] → [0, 2]: finalReward
+        # can be ≤ 0, which would make a raw ratio meaningless.
+        "uplift_ratio_shifted": round((optimized + 1.0)
+                                      / max(baseline + 1.0, 1e-6), 4),
+        "optimized_rules": list(optimized_rules),
+        "beam_rounds": state.current_round,
+        "tasks": len(tasks),
+    }

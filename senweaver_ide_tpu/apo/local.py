@@ -61,9 +61,19 @@ def make_local_apo(collector: TraceCollector, client: PolicyClient, *,
                    config: Optional[APOConfig] = None,
                    score_fn: Optional[Callable[[Sequence[str]], float]]
                    = None,
+                   make_session: Optional[Callable] = None,
+                   eval_tasks: Optional[Sequence[str]] = None,
                    max_tokens: int = 512) -> APOService:
-    """Fully-local APOService: policy-backed generation + corpus-backed
-    scoring."""
+    """Fully-local APOService: policy-backed generation + candidate scoring.
+
+    Scoring priority: explicit ``score_fn`` > prompt-conditioned rollout
+    scorer (when ``make_session`` is given — re-rolls ``eval_tasks`` under
+    each candidate; apo/eval.py) > the prompt-independent corpus baseline
+    (which cannot rank candidates; beam degenerates to the seed)."""
+    if score_fn is None and make_session is not None:
+        from .eval import SIX_PATTERN_TASKS, make_rollout_score_fn
+        score_fn = make_rollout_score_fn(
+            make_session, tuple(eval_tasks or SIX_PATTERN_TASKS))
     return APOService(
         collector,
         generate_fn=policy_generate_fn(client, max_tokens=max_tokens),

@@ -1,0 +1,80 @@
+"""Prompt-conditioned beam scoring (apo/eval.py): a known-better rule-set
+must actually WIN the beam search — the capability VERDICT r1 found missing
+(the corpus scorer tied all candidates and the seed always won)."""
+
+import pytest
+
+from senweaver_ide_tpu.apo import (GOOD_RULESET, RuleSensitivePolicy,
+                                   SIX_PATTERN_TASKS, evaluate_rules,
+                                   make_local_apo, make_rollout_score_fn,
+                                   run_uplift_eval)
+from senweaver_ide_tpu.apo.types import APOConfig
+from senweaver_ide_tpu.rollout import RolloutSession
+from senweaver_ide_tpu.traces.collector import TraceCollector
+
+
+@pytest.fixture()
+def harness(tmp_path):
+    client = RuleSensitivePolicy()
+    counter = [0]
+
+    def make_session(rules, collector=None):
+        counter[0] += 1
+        s = RolloutSession(client, str(tmp_path / f"ws{counter[0]}"),
+                          apo_rules=list(rules), collector=collector,
+                          include_tool_definitions=False)
+        s.workspace.write_file("app.py", "def run():\n    return 1\n")
+        return s
+
+    return client, make_session
+
+
+def test_good_rules_score_higher(harness):
+    _, make_session = harness
+    tasks = SIX_PATTERN_TASKS[:3]
+    base = evaluate_rules([], make_session, tasks)
+    good = evaluate_rules(GOOD_RULESET, make_session, tasks)
+    assert good > base + 0.3
+
+
+def test_scorer_is_prompt_conditioned(harness):
+    """Different rule-sets produce different scores (the r1 scorer could
+    not distinguish any two candidates)."""
+    _, make_session = harness
+    score = make_rollout_score_fn(make_session, SIX_PATTERN_TASKS[:2])
+    assert score(GOOD_RULESET) != score(["Be helpful."])
+
+
+def test_beam_search_finds_better_ruleset(harness, tmp_path):
+    client, make_session = harness
+    corpus = TraceCollector()
+    # Baseline rollouts populate the gradient corpus (with feedback, which
+    # the beam's rollout conversion requires).
+    for task in SIX_PATTERN_TASKS[:4]:
+        s = make_session([], collector=corpus)
+        s.run_turn(task)
+        s.record_feedback("bad")
+        s.close()
+    apo = make_local_apo(corpus, client,
+                         config=APOConfig(beam_rounds=1),
+                         make_session=make_session,
+                         eval_tasks=SIX_PATTERN_TASKS[:3])
+    state = apo.run_beam_search(seed_prompt="")
+    best = state.history_best_prompt
+    assert best is not None
+    assert "verify" in best.content.lower()
+    rules_text = " ".join(apo.get_optimized_rules()).lower()
+    assert "verify" in rules_text
+    assert state.history_best_score > 0.3
+
+
+def test_run_uplift_eval_reports_uplift(tmp_path):
+    report = run_uplift_eval(str(tmp_path), beam_rounds=1)
+    assert report["optimized_final_reward"] > report["baseline_final_reward"]
+    assert report["uplift_delta"] > 0.3
+    assert report["optimized_rules"]
+    assert report["tasks"] == 6
+
+
+def test_six_pattern_tasks_cover_all_patterns():
+    assert len(SIX_PATTERN_TASKS) == 6
