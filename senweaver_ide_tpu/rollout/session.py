@@ -27,6 +27,7 @@ from ..rollout.checkpoints import ConversationCheckpoints
 from ..services.skills import SkillService
 from ..tools.sandbox import Workspace
 from ..tools.service import ToolsService
+from ..tools.sidecars import SidecarServices
 from ..traces.collector import TraceCollector
 from ..traces.schema import Trace
 
@@ -70,6 +71,12 @@ class RolloutSession:
         self.tools.register_handler("spawn_subagent", self._spawn_handler)
         self.tools.register_handler("edit_agent", self._edit_agent_handler)
         self.tools.register_handler("skill", self.skills.tool_handler)
+        # In-process sidecar backends (fetch_url/api_request/read_document/
+        # web_search — tools/sidecars.py). web_search degrades to an OK
+        # empty result offline, so hermetic rollouts no longer book
+        # spurious tool failures into reward dims 3/4.
+        self.sidecars = SidecarServices(self.workspace)
+        self.sidecars.install(self.tools)
         # Snapshot files before any edit tool touches them (the before-edit
         # capture of chatThreadService.ts:1062-1068).
         edit_tools = ("edit_file", "rewrite_file", "delete_file_or_folder",

@@ -1,0 +1,182 @@
+"""Sidecar tool backends (tools/sidecars.py) against a LOCAL http.server —
+hermetic equivalents of the reference's start*.cjs servers."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from senweaver_ide_tpu.tools.sandbox import Workspace
+from senweaver_ide_tpu.tools.service import ToolsService
+from senweaver_ide_tpu.tools.sidecars import (SidecarConfig, SidecarServices,
+                                              html_to_text)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):
+        pass
+
+    def _send(self, body: bytes, ctype: str, status: int = 200):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/page":
+            self._send(b"<html><head><title>Test Page</title>"
+                       b"<script>var x=1;</script></head>"
+                       b"<body><p>Hello</p><p>World &amp; more</p>"
+                       b"</body></html>", "text/html")
+        elif self.path == "/data":
+            self._send(b'{"ok": true}', "application/json")
+        elif self.path == "/missing":
+            self._send(b"nope", "text/plain", 404)
+        else:
+            self._send(b"plain text body", "text/plain")
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(n)
+        echo = json.dumps({"echo": body.decode(),
+                           "auth": self.headers.get("X-Auth", "")})
+        self._send(echo.encode(), "application/json")
+
+
+@pytest.fixture(scope="module")
+def server():
+    httpd = HTTPServer(("127.0.0.1", 0), _Handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_port}"
+    httpd.shutdown()
+
+
+@pytest.fixture()
+def sidecars(tmp_path):
+    return SidecarServices(Workspace(tmp_path / "ws"))
+
+
+def test_fetch_url_extracts_readable_text(server, sidecars):
+    out = sidecars.fetch_url({"url": f"{server}/page"})
+    assert out["title"] == "Test Page"
+    assert "Hello" in out["content"] and "World & more" in out["content"]
+    assert "var x" not in out["content"]          # script stripped
+    assert "html" in out["content_type"]
+
+
+def test_fetch_url_pagination(server, sidecars):
+    full = sidecars.fetch_url({"url": f"{server}/plain"})
+    part = sidecars.fetch_url({"url": f"{server}/plain", "max_length": 5,
+                               "start_index": 6})
+    assert full["content"] == "plain text body"
+    assert part["content"] == "text "
+    assert part["truncated"]
+
+
+def test_api_request_post_with_headers(server, sidecars):
+    out = sidecars.api_request({
+        "url": f"{server}/data", "method": "POST",
+        "headers": json.dumps({"X-Auth": "tok123"}),
+        "body": "payload"})
+    assert out["status"] == 200
+    data = json.loads(out["body"])
+    assert data == {"echo": "payload", "auth": "tok123"}
+
+
+def test_api_request_http_error_is_enveloped(server, sidecars):
+    out = sidecars.api_request({"url": f"{server}/missing"})
+    assert out["status"] == 404
+    assert out["body"] == "nope"
+
+
+def test_read_document_text_csv_json_docx_xlsx(tmp_path, sidecars):
+    ws = sidecars.workspace
+    ws.write_file("notes.md", "# Title\nbody")
+    ws.write_file("table.csv", "a,b\n1,2\n")
+    ws.write_file("obj.json", '{"k": [1, 2]}')
+    assert "# Title" in sidecars.read_document({"uri": "notes.md"})["content"]
+    assert "a\tb\n1\t2" in sidecars.read_document({"uri": "table.csv"})["content"]
+    assert '"k"' in sidecars.read_document({"uri": "obj.json"})["content"]
+
+    import zipfile
+    with zipfile.ZipFile(ws.root / "doc.docx", "w") as z:
+        z.writestr("word/document.xml",
+                   "<w:document><w:p><w:t>Para one</w:t></w:p>"
+                   "<w:p><w:r><w:t>Para </w:t><w:t>two</w:t></w:r></w:p>"
+                   "</w:document>")
+    out = sidecars.read_document({"uri": "doc.docx"})
+    assert out["content"] == "Para one\nPara two"
+
+    with zipfile.ZipFile(ws.root / "book.xlsx", "w") as z:
+        z.writestr("xl/sharedStrings.xml",
+                   "<sst><si><t>name</t></si><si><t>alice</t></si></sst>")
+        z.writestr("xl/worksheets/sheet1.xml",
+                   '<worksheet><row><c t="s"><v>0</v></c><c><v>7</v></c>'
+                   '</row><row><c t="s"><v>1</v></c><c><v>9</v></c></row>'
+                   "</worksheet>")
+    out = sidecars.read_document({"uri": "book.xlsx"})
+    assert "name\t7" in out["content"] and "alice\t9" in out["content"]
+
+
+def test_read_document_pdf_rejected(tmp_path, sidecars):
+    sidecars.workspace.write_file("f.pdf", "%PDF-fake")
+    with pytest.raises(ValueError, match="extraction"):
+        sidecars.read_document({"uri": "f.pdf"})
+
+
+def test_web_search_offline_is_graceful(sidecars):
+    out = sidecars.web_search({"query": "anything", "max_results": 5})
+    assert out["results"] == []
+    assert "note" in out
+
+
+def test_web_search_pluggable_engine(tmp_path):
+    def fake_engine(query, limit):
+        return [{"title": f"hit for {query}", "url": "http://x", "snippet": "s"}]
+
+    svc = SidecarServices(Workspace(tmp_path / "ws"),
+                          SidecarConfig(search_engines=(fake_engine,)))
+    out = svc.web_search({"query": "jax", "max_results": 3})
+    assert out["results"][0]["title"] == "hit for jax"
+    assert out["engine"] == "fake_engine"
+
+
+def test_engine_failure_falls_through(tmp_path):
+    def broken(query, limit):
+        raise OSError("offline")
+
+    def backup(query, limit):
+        return [{"title": "from backup", "url": "u", "snippet": ""}]
+
+    svc = SidecarServices(Workspace(tmp_path / "ws"),
+                          SidecarConfig(search_engines=(broken, backup)))
+    out = svc.web_search({"query": "q"})
+    assert out["results"][0]["title"] == "from backup"
+
+
+def test_url_filter_blocks(tmp_path):
+    svc = SidecarServices(Workspace(tmp_path / "ws"),
+                          SidecarConfig(url_filter=lambda u: "allowed" in u))
+    with pytest.raises(PermissionError):
+        svc.fetch_url({"url": "http://blocked.example/x"})
+
+
+def test_tools_service_integration(server, tmp_path):
+    """Through the full validate→approve→execute→stringify pipeline."""
+    svc = ToolsService(Workspace(tmp_path / "ws"))
+    SidecarServices(svc.workspace).install(svc)
+    res = svc.call_tool("fetch_url", {"url": f"{server}/page"})
+    assert res.ok
+    assert "Hello" in svc.string_of_result(res)
+    res2 = svc.call_tool("web_search", {"query": "x"})
+    assert res2.ok                               # no spurious failure
+    res3 = svc.call_tool("read_document", {"uri": "nope.md"})
+    assert not res3.ok                           # real missing-file error
+    svc.close()
+
+
+def test_html_to_text_structure():
+    text = html_to_text("<div>a<br>b</div><ul><li>c</li><li>d</li></ul>")
+    assert "a\nb" in text and "c\nd" in text
