@@ -25,25 +25,53 @@ from ..agents.llm import ChatMessage
 from ..tools.sandbox import Workspace
 
 
+@dataclasses.dataclass
+class DirectorySnapshot:
+    """Pre-edit state of a directory: every contained file's content
+    (display-path keyed) plus all subdirectory paths (so empty dirs
+    survive a rewind too). Distinguishes 'was a directory' from 'did not
+    exist' so a rewind across a folder delete restores the folder's
+    contents instead of silently dropping them."""
+    files: Dict[str, str]
+    dirs: List[str] = dataclasses.field(default_factory=list)
+
+
+# Snapshot value: file content (str), DirectorySnapshot, or None
+# ('did not exist').
+SnapshotValue = Optional[object]
+
+
 class FileSnapshotter:
     """Before-edit content capture, keyed by (checkpoint epoch, path)."""
 
     def __init__(self, workspace: Workspace):
         self.workspace = workspace
-        self._current: Dict[str, Optional[str]] = {}
+        self._current: Dict[str, SnapshotValue] = {}
 
     def ensure_before_state(self, path: str) -> None:
-        """Record the file's pre-edit state once per checkpoint window
-        (None = file did not exist)."""
-        key = self.workspace.display(self.workspace.resolve(path))
+        """Record the path's pre-edit state once per checkpoint window
+        (str = file content, DirectorySnapshot = dir contents, None = did
+        not exist)."""
+        p = self.workspace.resolve(path)
+        key = self.workspace.display(p)
         if key in self._current:
             return
-        try:
-            self._current[key] = self.workspace.read_text(path)
-        except FileNotFoundError:
+        if p.is_dir():
+            files: Dict[str, str] = {}
+            dirs: List[str] = []
+            for f in sorted(p.rglob("*")):
+                if f.is_file():
+                    files[self.workspace.display(f)] = f.read_text(
+                        errors="replace")
+                elif f.is_dir():
+                    dirs.append(self.workspace.display(f))
+            self._current[key] = DirectorySnapshot(files=files, dirs=dirs)
+        elif p.is_file():
+            self._current[key] = p.read_text(errors="replace")
+        else:
             self._current[key] = None
 
-    def drain(self) -> Dict[str, Optional[str]]:
+    def drain(self) -> Dict[str, SnapshotValue]:
         """Hand the window's snapshots to a checkpoint and reset."""
         out = self._current
         self._current = {}
@@ -56,7 +84,7 @@ class CheckpointEntry:
     checkpoint_id: int
     before_message_idx: int
     kind: str                       # 'user_turn' | 'stream_end'
-    files_before: Dict[str, Optional[str]]
+    files_before: Dict[str, SnapshotValue]
     created_at: float = dataclasses.field(default_factory=time.time)
 
 
@@ -104,12 +132,32 @@ class ConversationCheckpoints:
         self.entries = keep
         return messages[:message_idx]
 
-    def _restore_files(self, files: Dict[str, Optional[str]]) -> None:
-        for path, content in files.items():
+    def _restore_files(self, files: Dict[str, SnapshotValue]) -> None:
+        # Each snapshot records the state at its CAPTURE time, not the
+        # window start, and a directory snapshot can overlap file
+        # snapshots under it. Undo in reverse capture order so
+        # earlier-captured (closer-to-window-start) states land last and
+        # win — e.g. edit b.txt then delete its folder: the folder
+        # restore rewrites the mid-window b.txt, then the older file
+        # snapshot puts the original back.
+        for path, content in reversed(list(files.items())):
             if content is None:
                 try:
                     self.workspace.delete(path, is_recursive=True)
                 except FileNotFoundError:
                     pass
+            elif isinstance(content, DirectorySnapshot):
+                # Recreate the directory exactly: drop whatever stands at
+                # the path now, then rebuild subdirs (empty ones too) and
+                # rewrite every snapshotted file.
+                try:
+                    self.workspace.delete(path, is_recursive=True)
+                except FileNotFoundError:
+                    pass
+                self.workspace.create(path + "/")
+                for d in content.dirs:
+                    self.workspace.create(d + "/")
+                for fpath, fcontent in content.files.items():
+                    self.workspace.write_file(fpath, fcontent)
             else:
                 self.workspace.write_file(path, content)

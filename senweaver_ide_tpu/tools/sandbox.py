@@ -67,8 +67,11 @@ class Workspace:
                 candidate = self.root / p.lstrip("/")
         else:
             candidate = self.root / candidate
-        resolved = candidate.resolve() if candidate.exists() \
-            else candidate.parent.resolve() / candidate.name
+        # Full non-strict resolution: follows symlinks INCLUDING a dangling
+        # final component (exists() is False for those, so a parent-only
+        # resolve would let `ln -s /etc/target x` + write_file(x) create a
+        # file outside the root).
+        resolved = candidate.resolve(strict=False)
         if resolved != self.root and self.root not in resolved.parents:
             raise SandboxViolation(f"path escapes sandbox: {path}")
         return resolved

@@ -75,12 +75,18 @@ class ToolsService:
     """One instance per rollout sandbox."""
 
     def __init__(self, workspace: Workspace, *,
-                 auto_approve: Optional[Dict[ApprovalType, bool]] = None):
+                 auto_approve: Optional[Dict[ApprovalType, bool]] = None,
+                 terminal_isolation: str = "auto"):
         self.workspace = workspace
-        self.terminals = TerminalManager(str(workspace.root))
-        # Rollout policy default: approve everything (the RL sandbox has no
-        # human in the loop); flip flags to exercise denial paths in eval.
+        self.terminals = TerminalManager(str(workspace.root),
+                                         isolation=terminal_isolation)
+        # Rollout policy defaults: file/edit tools auto-approve (they are
+        # sandbox-confined), but terminal-class tools auto-approve ONLY
+        # when the shell is namespace-isolated (no network) — an
+        # unconfined model-generated shell breaks both hermeticity and
+        # safety. Callers may override explicitly via ``auto_approve``.
         self.auto_approve = {t: True for t in ApprovalType}
+        self.auto_approve[ApprovalType.TERMINAL] = self.terminals.isolated
         if auto_approve:
             self.auto_approve.update(auto_approve)
         self._handlers: Dict[str, Callable[[Dict[str, Any]], Any]] = {}

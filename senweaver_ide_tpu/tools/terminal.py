@@ -33,6 +33,25 @@ from ..context.token_config import (MAX_TERMINAL_BG_COMMAND_TIME_S,
 
 _ENV_ALLOWLIST = ("PATH", "HOME", "LANG", "TERM", "PYTHONPATH")
 
+# Model-generated shell must not reach the network: rollout rewards depend
+# on reproducibility, and an autonomous policy with host network access is
+# a safety hazard at scale. Linux user+net namespaces (unshare -r -n) give
+# no-network confinement without privileges; probed once per process.
+_ISOLATION_PREFIX = ("unshare", "-r", "-n")
+_isolation_supported: Optional[bool] = None
+
+
+def isolation_available() -> bool:
+    global _isolation_supported
+    if _isolation_supported is None:
+        try:
+            _isolation_supported = subprocess.run(
+                [*_ISOLATION_PREFIX, "true"], capture_output=True,
+                timeout=10).returncode == 0
+        except Exception:
+            _isolation_supported = False
+    return _isolation_supported
+
 
 @dataclasses.dataclass
 class CommandResult:
@@ -89,18 +108,36 @@ def _read_until(proc: subprocess.Popen, *, inactive_timeout: float,
 class TerminalManager:
     """Ephemeral run_command + persistent terminal pool for one sandbox."""
 
-    def __init__(self, cwd: str):
+    def __init__(self, cwd: str, *, isolation: str = "auto"):
+        """``isolation``: 'auto' = user+net namespaces when the kernel
+        allows (else unisolated), 'netns' = require them (raise if
+        unavailable), 'none' = plain subprocesses. ``self.isolated``
+        reports the outcome — ToolsService denies terminal-class approval
+        by default when it is False."""
         self.cwd = cwd
+        if isolation == "none":
+            self.isolated = False
+        elif isolation in ("auto", "netns"):
+            self.isolated = isolation_available()
+            if isolation == "netns" and not self.isolated:
+                raise RuntimeError(
+                    "terminal isolation required but user+net namespaces "
+                    "are unavailable (unshare -r -n failed)")
+        else:
+            raise ValueError(f"unknown isolation mode {isolation!r}")
         self._persistent: Dict[str, subprocess.Popen] = {}
         self._next_id = 1
         self._sentinel_n = 0
+
+    def _argv(self, argv: list) -> list:
+        return [*_ISOLATION_PREFIX, *argv] if self.isolated else argv
 
     def run_command(self, command: str, *, cwd: Optional[str] = None,
                     inactive_timeout: float = MAX_TERMINAL_INACTIVE_TIME_S
                     ) -> CommandResult:
         start = time.monotonic()
         proc = subprocess.Popen(
-            ["/bin/sh", "-c", command], cwd=cwd or self.cwd,
+            self._argv(["/bin/sh", "-c", command]), cwd=cwd or self.cwd,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             env=_scrubbed_env(), start_new_session=True)
         out, reason = _read_until(proc, inactive_timeout=inactive_timeout)
@@ -121,7 +158,8 @@ class TerminalManager:
         tid = f"terminal-{self._next_id}"
         self._next_id += 1
         proc = subprocess.Popen(
-            ["/bin/sh"], cwd=cwd or self.cwd, stdin=subprocess.PIPE,
+            self._argv(["/bin/sh"]), cwd=cwd or self.cwd,
+            stdin=subprocess.PIPE,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             env=_scrubbed_env(), start_new_session=True)
         os.set_blocking(proc.stdout.fileno(), False)  # type: ignore
@@ -150,19 +188,30 @@ class TerminalManager:
         proc.stdin.write(  # type: ignore[union-attr]
             (command + f"\nprintf '%s\\n' {sentinel}\n").encode())
         proc.stdin.flush()  # type: ignore[union-attr]
-        chunks: list[bytes] = []
+        buf = b""
         done = False
         while time.monotonic() - start < bg_timeout:
             data = proc.stdout.read(65536)  # type: ignore[union-attr]
             if data:
-                chunks.append(data)
-                if sentinel.encode() in b"".join(chunks[-2:]):
+                buf += data
+                if sentinel.encode() in buf:   # exact CURRENT sentinel only
                     done = True
                     break
             else:
                 time.sleep(0.02)
-        out = b"".join(chunks).decode(errors="replace")
-        out = re.sub(r"__SW_DONE_\d+__\n?", "", out)
+        if done:
+            buf = buf[:buf.find(sentinel.encode())]
+        # Anything up to a LOWER-numbered sentinel is late output of a
+        # previously bgtimeout'd command that escaped the pre-drain window —
+        # discard it rather than misattribute it to this command.
+        stale = None
+        for m in re.finditer(rb"__SW_DONE_(\d+)__\n?", buf):
+            if int(m.group(1)) < self._sentinel_n:
+                stale = m
+        if stale is not None:
+            buf = buf[stale.end():]
+        out = re.sub(r"__SW_DONE_\d+__\n?", "",
+                     buf.decode(errors="replace"))
         return CommandResult(
             output=out[:MAX_TERMINAL_CHARS],
             resolve_reason="done" if done else "bgtimeout",

@@ -1,0 +1,210 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+sandbox symlink escape, unisolated-terminal default denial, directory
+snapshots, persistent-terminal sentinel misattribution, dataset remainder
+drop."""
+
+import os
+
+import pytest
+
+from senweaver_ide_tpu.rollout.checkpoints import (ConversationCheckpoints,
+                                                   DirectorySnapshot)
+from senweaver_ide_tpu.tools.sandbox import SandboxViolation, Workspace
+from senweaver_ide_tpu.tools.service import ToolsService
+from senweaver_ide_tpu.tools.terminal import (TerminalManager,
+                                              isolation_available)
+from senweaver_ide_tpu.training.data import Trajectory, TrajectoryDataset
+
+
+# ---- ADVICE #2: dangling-symlink sandbox escape ----
+
+def test_dangling_symlink_write_rejected(tmp_path):
+    ws = Workspace(tmp_path / "root")
+    outside = tmp_path / "outside.txt"
+    os.symlink(str(outside), str(ws.root / "link"))
+    with pytest.raises(SandboxViolation):
+        ws.write_file("link", "pwned")
+    assert not outside.exists()
+
+
+def test_symlink_to_inside_still_works(tmp_path):
+    ws = Workspace(tmp_path / "root")
+    ws.write_file("real.txt", "hello")
+    os.symlink(str(ws.root / "real.txt"), str(ws.root / "alias"))
+    assert ws.read_text("alias") == "hello"
+    ws.write_file("alias", "updated")
+    assert ws.read_text("real.txt") == "updated"
+
+
+def test_dangling_symlink_chain_outside_rejected(tmp_path):
+    ws = Workspace(tmp_path / "root")
+    os.symlink("/etc/hostname-like-missing-target", str(ws.root / "x"))
+    with pytest.raises(SandboxViolation):
+        ws.resolve("x")
+
+
+# ---- ADVICE #1: terminal isolation ----
+
+def test_unisolated_terminal_denied_by_default(tmp_path):
+    svc = ToolsService(Workspace(tmp_path / "ws"),
+                       terminal_isolation="none")
+    res = svc.call_tool("run_command", {"command": "echo hi"})
+    assert not res.ok
+    assert "approv" in (res.error or "").lower() or "denied" in \
+        (res.error or "").lower()
+    svc.close()
+
+
+@pytest.mark.skipif(not isolation_available(),
+                    reason="user+net namespaces unavailable")
+def test_isolated_terminal_has_no_network(tmp_path):
+    tm = TerminalManager(str(tmp_path), isolation="netns")
+    assert tm.isolated
+    # Loopback-only namespace: no interfaces are up, so any connect fails.
+    r = tm.run_command(
+        "python3 -c \"import socket; s=socket.socket(); s.settimeout(2); "
+        "s.connect(('1.1.1.1', 80))\" 2>&1; echo rc=$?")
+    assert "rc=0" not in r.output
+    r2 = tm.run_command("echo isolated-ok")
+    assert "isolated-ok" in r2.output
+    tm.close()
+
+
+@pytest.mark.skipif(not isolation_available(),
+                    reason="user+net namespaces unavailable")
+def test_isolated_terminal_auto_approved(tmp_path):
+    svc = ToolsService(Workspace(tmp_path / "ws"))
+    res = svc.call_tool("run_command", {"command": "echo hi"})
+    assert res.ok
+    svc.close()
+
+
+def test_explicit_override_allows_unisolated(tmp_path):
+    from senweaver_ide_tpu.tools.types import ApprovalType
+    svc = ToolsService(Workspace(tmp_path / "ws"),
+                       terminal_isolation="none",
+                       auto_approve={ApprovalType.TERMINAL: True})
+    res = svc.call_tool("run_command", {"command": "echo opted-in"})
+    assert res.ok and "opted-in" in str(res.result)
+    svc.close()
+
+
+# ---- ADVICE #3: directory snapshots ----
+
+def test_directory_delete_restores_contents(tmp_path):
+    ws = Workspace(tmp_path / "ws")
+    cp = ConversationCheckpoints(ws)
+    ws.create("pkg/")
+    ws.write_file("pkg/a.py", "A")
+    ws.write_file("pkg/sub/b.py", "B")
+    cp.add_checkpoint(0, "user_turn")
+
+    cp.snapshotter.ensure_before_state("pkg")
+    snap = cp.snapshotter._current["/pkg"]
+    assert isinstance(snap, DirectorySnapshot)
+    assert snap.files == {"/pkg/a.py": "A", "/pkg/sub/b.py": "B"}
+    ws.delete("pkg", is_recursive=True)
+    cp.add_checkpoint(1, "stream_end")
+
+    cp.jump_to_before_message(0, [])
+    assert ws.read_text("pkg/a.py") == "A"
+    assert ws.read_text("pkg/sub/b.py") == "B"
+
+
+def test_preexisting_dir_touched_by_create_survives_rewind(tmp_path):
+    ws = Workspace(tmp_path / "ws")
+    cp = ConversationCheckpoints(ws)
+    ws.create("data/")
+    ws.write_file("data/keep.txt", "precious")
+    cp.add_checkpoint(0, "user_turn")
+    cp.snapshotter.ensure_before_state("data")   # create_file_or_folder hook
+    ws.write_file("data/new.txt", "scratch")
+    cp.add_checkpoint(1, "stream_end")
+    cp.jump_to_before_message(0, [])
+    assert ws.read_text("data/keep.txt") == "precious"
+    assert not (ws.root / "data" / "new.txt").exists()
+
+
+def test_edit_then_delete_folder_rewinds_to_window_start(tmp_path):
+    """Within one window: edit a file, then delete its folder — rewind
+    must restore the ORIGINAL (window-start) content, not the mid-window
+    edit captured by the later directory snapshot."""
+    ws = Workspace(tmp_path / "ws")
+    cp = ConversationCheckpoints(ws)
+    ws.write_file("a/b.txt", "C1")
+    cp.add_checkpoint(0, "user_turn")
+    cp.snapshotter.ensure_before_state("a/b.txt")     # edit hook
+    ws.write_file("a/b.txt", "C2")
+    cp.snapshotter.ensure_before_state("a")           # delete hook
+    ws.delete("a", is_recursive=True)
+    cp.add_checkpoint(1, "stream_end")
+    cp.jump_to_before_message(0, [])
+    assert ws.read_text("a/b.txt") == "C1"
+
+
+def test_delete_folder_then_recreate_file_rewinds_fully(tmp_path):
+    """Reverse order: delete the folder, then recreate one of its files —
+    rewind must bring back the original folder contents (the later
+    None-snapshot of the recreated file must not win)."""
+    ws = Workspace(tmp_path / "ws")
+    cp = ConversationCheckpoints(ws)
+    ws.write_file("a/b.txt", "C1")
+    cp.add_checkpoint(0, "user_turn")
+    cp.snapshotter.ensure_before_state("a")           # delete hook
+    ws.delete("a", is_recursive=True)
+    cp.snapshotter.ensure_before_state("a/b.txt")     # create hook (None)
+    ws.write_file("a/b.txt", "NEW")
+    cp.add_checkpoint(1, "stream_end")
+    cp.jump_to_before_message(0, [])
+    assert ws.read_text("a/b.txt") == "C1"
+
+
+def test_empty_subdirs_survive_rewind(tmp_path):
+    ws = Workspace(tmp_path / "ws")
+    cp = ConversationCheckpoints(ws)
+    ws.create("pkg/empty/")
+    ws.write_file("pkg/a.py", "A")
+    cp.add_checkpoint(0, "user_turn")
+    cp.snapshotter.ensure_before_state("pkg")
+    ws.delete("pkg", is_recursive=True)
+    cp.add_checkpoint(1, "stream_end")
+    cp.jump_to_before_message(0, [])
+    assert (ws.root / "pkg" / "empty").is_dir()
+    assert ws.read_text("pkg/a.py") == "A"
+
+
+# ---- ADVICE #4: persistent-terminal sentinel ----
+
+def test_late_output_of_previous_command_not_misattributed(tmp_path):
+    tm = TerminalManager(str(tmp_path), isolation="none")
+    tid = tm.open_persistent()
+    # Command 1: keeps producing output past its bg window.
+    r1 = tm.run_persistent(tid, "sleep 1.2; echo LATE_OUTPUT",
+                           bg_timeout=0.3)
+    assert r1.resolve_reason == "bgtimeout"
+    # Command 2 starts before command 1's tail arrives; its result must not
+    # contain command 1's late output or resolve on its sentinel.
+    r2 = tm.run_persistent(tid, "sleep 1.5; echo SECOND", bg_timeout=3.0)
+    assert "SECOND" in r2.output
+    assert "LATE_OUTPUT" not in r2.output
+    assert "__SW_DONE_" not in r2.output
+    tm.close()
+
+
+# ---- ADVICE #5: dataset remainder ----
+
+def test_dataset_keeps_final_partial_batch():
+    trajs = [Trajectory([i], [i], reward=0.0, group_id=i) for i in range(10)]
+    ds = TrajectoryDataset(trajs, batch_size=4, seed=0)
+    assert ds.batches_per_epoch == 3
+    epoch_items = []
+    for c in range(3):
+        epoch_items += [t.group_id for t in ds.batch_at(c)]
+    assert sorted(epoch_items) == list(range(10))   # nothing dropped
+
+
+def test_dataset_small_set_single_batch():
+    trajs = [Trajectory([i], [i], reward=0.0, group_id=i) for i in range(3)]
+    ds = TrajectoryDataset(trajs, batch_size=8, seed=0)
+    assert ds.batches_per_epoch == 1
+    assert len(ds.batch_at(0)) == 3
