@@ -47,6 +47,10 @@ class ModelConfig:
     # The KV-cache (decode) path is unaffected — it has its own fused
     # decode kernel selection (rollout plane).
     attn_impl: str = "einsum"
+    # lax.scan unroll factor for the layer loop. Decode steps are tiny
+    # programs; TPU loop overhead per scan iteration is material at
+    # sq=1, and unrolling trades compile time for it. 1 = no unroll.
+    scan_unroll: int = 1
     # jax.default_matmul_precision for the forward pass. None = platform
     # default (bf16 MXU passes — the fast path for real models). The fp32
     # test config pins "highest" so cache-vs-full decode parity is exact.

@@ -259,7 +259,8 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
             return (x, aux + layer_aux), None
 
         (x, aux_total), _ = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)), params["layers"])
+            body, (x, jnp.zeros((), jnp.float32)), params["layers"],
+            unroll=c.scan_unroll)
         new_cache = None
     else:
         max_len = cache.k.shape[2]
@@ -280,7 +281,7 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
 
         (x, aux_total), (k_upd, v_upd) = jax.lax.scan(
             body, (x, jnp.zeros((), jnp.float32)),
-            (params["layers"], cache.k, cache.v))
+            (params["layers"], cache.k, cache.v), unroll=c.scan_unroll)
         new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)

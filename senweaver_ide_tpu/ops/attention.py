@@ -50,29 +50,51 @@ def attention(
     kv_mask: Optional[jnp.ndarray] = None,   # (B, Skv) True = valid
     causal: bool = True,
 ) -> jnp.ndarray:
-    """Grouped-query causal attention. Returns (B, Sq, Hq, D)."""
+    """Grouped-query causal attention. Returns (B, Sq, Hq, D).
+
+    The GQA group folds into the einsums (q reshaped to (Hkv, rep)) — K/V
+    are NEVER materialized at Hq heads. The repeat_kv formulation cost
+    ~24× the cache bytes in decode (rep× heads × fp32 cast) and was the
+    dominant share of the r1 decode-throughput gap.
+
+    Numerics: fp32 inputs take the exact path (fp32 casts +
+    Precision.HIGHEST — the default precision truncates fp32 operands to
+    bf16 on TPU, breaking cache-vs-full decode parity in the fp32 test
+    configs). Low-precision inputs (bf16 real models) stay in their native
+    dtype on the MXU with fp32 accumulation (preferred_element_type), with
+    softmax in fp32 and probabilities cast back for the PV matmul — the
+    same contract as the flash kernel.
+    """
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
-    k = repeat_kv(k, hq // hkv)
-    v = repeat_kv(v, hq // hkv)
+    rep = hq // hkv
+    qg = q.reshape(b, sq, hkv, rep, d)
 
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
-    # (B, H, Sq, Skv) scores in fp32. precision=HIGHEST: the default matmul
-    # precision truncates fp32 operands to bf16 on TPU, which breaks
-    # cache-vs-full decode parity; softmax inputs must be true fp32.
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        k.astype(jnp.float32),
-                        precision=jax.lax.Precision.HIGHEST) * scale
+    exact = q.dtype == jnp.float32
+    if exact:
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
+                            k.astype(jnp.float32),
+                            precision=jax.lax.Precision.HIGHEST)
+    else:
+        scores = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                            preferred_element_type=jnp.float32)
+    scores = scores * scale                   # (B, Hkv, rep, Sq, Skv) fp32
 
     if causal:
         mask = causal_mask(sq, k.shape[1], q_offset)
-        # (q, kv) → (1, 1, q, kv); (B, q, kv) → (B, 1, q, kv)
-        mask = mask[None, None] if mask.ndim == 2 else mask[:, None]
+        # (q, kv) → (1, 1, 1, q, kv); (B, q, kv) → (B, 1, 1, q, kv)
+        mask = mask[None, None, None] if mask.ndim == 2 \
+            else mask[:, None, None]
         scores = jnp.where(mask, scores, NEG_INF)
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, :], scores, NEG_INF)
+        scores = jnp.where(kv_mask[:, None, None, None, :], scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32),
-                     precision=jax.lax.Precision.HIGHEST)
-    return out.astype(q.dtype)
+    if exact:
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, v.astype(jnp.float32),
+                         precision=jax.lax.Precision.HIGHEST)
+    else:
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)

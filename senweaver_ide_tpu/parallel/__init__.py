@@ -8,5 +8,6 @@ from .sharding import (ACT_SPEC, KV_CACHE_SPEC, LOGITS_SPEC, PARAM_SPECS,
 from .distributed import (AXIS_ORDER, DistributedConfig, initialize,
                           make_named_mesh)
 from .expert import (MoEConfig, init_moe_params, moe_ffn, moe_ffn_sharded)
-from .pipeline import (pipeline_forward, place_pipeline_params,
+from .pipeline import (make_pp_train_state, pipeline_forward,
+                       place_pipeline_params, pp_train_step,
                        split_layers_for_stages, stage_param_specs)

@@ -24,7 +24,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from .ring_attention import shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 

@@ -21,7 +21,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from .ring_attention import shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
@@ -157,3 +157,71 @@ def place_pipeline_params(params: Params, mesh: Mesh) -> Params:
     return jax.tree_util.tree_map(
         lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
         params, specs)
+
+
+def make_pp_train_state(config: ModelConfig, key: jax.Array, mesh: Mesh,
+                        *, learning_rate: float = 1e-5,
+                        params: Optional[Params] = None,
+                        optimizer=None):
+    """TrainState whose params are stage-split and placed on the 'pp'
+    mesh; optimizer state inherits the param shardings (Adam moments are
+    param-shaped, so GSPMD propagates the stage axis)."""
+    from ..models.transformer import init_params
+    from ..training.trainer import TrainState, make_optimizer
+
+    if params is None:
+        params = init_params(config, key)
+    params = place_pipeline_params(
+        split_layers_for_stages(params, mesh.shape["pp"]), mesh)
+    opt = optimizer or make_optimizer(learning_rate)
+    opt_state = jax.jit(opt.init)(params)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32))
+
+
+def pp_train_step(state, config: ModelConfig, mesh: Mesh,
+                  tokens: jax.Array, completion_mask: jax.Array,
+                  rewards: jax.Array, group_ids: jax.Array, *,
+                  optimizer=None, n_microbatches: int = 2,
+                  grpo_config=None, num_groups: Optional[int] = None):
+    """One GRPO update with the transformer blocks pipelined over 'pp'.
+
+    The pp counterpart of training.trainer.train_step (which runs the
+    dp/fsdp/tp/sp layouts): same clipped objective and group-relative
+    advantages, but the forward is ``pipeline_forward`` — autodiff
+    differentiates through the ppermute ring, so the backward pass is the
+    reverse pipeline schedule. ``state`` comes from make_pp_train_state
+    (stage-split params). Dense models only (the MoE aux loss is not
+    plumbed through the pipelined region)."""
+    import optax
+
+    from ..training.grpo import (GRPOConfig, group_relative_advantages,
+                                 grpo_objective, token_logprobs)
+    from ..training.trainer import TrainState, make_optimizer
+
+    grpo_config = grpo_config or GRPOConfig()
+    opt = optimizer or make_optimizer()
+    n_groups = num_groups or int(tokens.shape[0])
+    adv = group_relative_advantages(
+        rewards, group_ids, n_groups,
+        normalize_std=grpo_config.normalize_std,
+        min_std=grpo_config.min_group_std)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    tgt_mask = completion_mask[:, 1:]
+
+    def loss_fn(params):
+        logits = pipeline_forward(params, config, inputs, mesh=mesh,
+                                  n_microbatches=n_microbatches)
+        logp = token_logprobs(logits, targets)
+        olp = jax.lax.stop_gradient(logp)
+        return grpo_objective(logp, olp, adv, tgt_mask, grpo_config)
+
+    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        state.params)
+    updates, opt_state = opt.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    metrics = dict(metrics)
+    metrics["loss"] = loss
+    metrics["grad_norm"] = optax.global_norm(grads)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=state.step + 1), metrics

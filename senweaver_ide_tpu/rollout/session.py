@@ -45,7 +45,8 @@ class RolloutSession:
                  collector: Optional[TraceCollector] = None,
                  skills: Optional[SkillService] = None,
                  apo_rules: Optional[List[str]] = None,
-                 include_tool_definitions: bool = True):
+                 include_tool_definitions: bool = True,
+                 perf_monitor=None):
         self.client = client
         self.chat_mode = chat_mode
         self.thread_id = thread_id
@@ -56,6 +57,7 @@ class RolloutSession:
         self.checkpoints = ConversationCheckpoints(self.workspace)
         self.subagents = SubagentRunner(client, self.tools)
         self.apo_rules = apo_rules or []
+        self.perf_monitor = perf_monitor
         # Tiny-window policies (tests, byte-level tokenizers) can skip the
         # ~6k-char tool-grammar section; real rollouts keep it.
         self.include_tool_definitions = include_tool_definitions
@@ -129,6 +131,8 @@ class RolloutSession:
 
     # -- system message ----------------------------------------------------
     def system_message(self) -> str:
+        import time as _time
+        t0 = _time.monotonic()
         comp = get_composition(self.chat_mode)
         sysmsg = chat_system_message(
             chat_mode=self.chat_mode,
@@ -139,6 +143,13 @@ class RolloutSession:
         catalog = self.skills.catalog_for_prompt()
         if catalog:
             sysmsg += "\n\n" + catalog
+        if self.perf_monitor is not None:
+            # The reference's monitored stage (performanceMonitor.ts:46:
+            # 2 s / 4k tokens on system-message prep); ~4 chars/token.
+            self.perf_monitor.record_ms(
+                "system_message_prep", (_time.monotonic() - t0) * 1000.0)
+            self.perf_monitor.record_tokens("system_message_tokens",
+                                            len(sysmsg) // 4)
         return sysmsg
 
     # -- turns -------------------------------------------------------------

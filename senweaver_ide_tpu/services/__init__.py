@@ -9,6 +9,8 @@ from .config import BUILD_DEFAULTS, RuntimeConfig
 from .extensions import (ExtensionServer, ExtensionServerError,
                          ExtensionTool, ExtensionToolRegistry)
 from .metrics import MetricsService, load_jsonl_metrics
+from .perf_monitor import (DEFAULT_THRESHOLDS_MS, PerformanceMonitor,
+                           profile_capture)
 from .skills import SkillInfo, SkillService
 
 __all__ = [

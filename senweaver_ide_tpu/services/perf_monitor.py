@@ -1,0 +1,89 @@
+"""PerformanceMonitor: threshold-checked stage timings + profiler capture.
+
+The trainer-side analogue of ``common/performanceMonitor.ts`` (271 LoC;
+DEFAULT_THRESHOLDS :46 — system-message prep 2 s / 4k tokens): named
+stages are timed, compared against thresholds, and over-threshold events
+are captured to MetricsService as warnings. The TPU addition is
+:func:`profile_capture` — a ``jax.profiler.trace`` context producing a
+TensorBoard-loadable device trace of any monitored region (SURVEY.md §5
+asks for jax.profiler hookup, which r1 lacked).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Dict, Iterator, Optional
+
+# Reference thresholds (performanceMonitor.ts:46-50) + trainer-side ones.
+DEFAULT_THRESHOLDS_MS: Dict[str, float] = {
+    "system_message_prep": 2_000.0,    # ref: sysmsg build 2 s
+    "message_fitting": 2_000.0,
+    "rollout_collect": 600_000.0,      # a full collection phase
+    "batch_build": 5_000.0,
+    "train_step": 300_000.0,
+}
+DEFAULT_TOKEN_THRESHOLDS: Dict[str, int] = {
+    "system_message_tokens": 4_000,    # ref: sysmsg 4k tokens
+}
+
+
+class PerformanceMonitor:
+    """Stage timing with threshold warnings, optionally metric-captured."""
+
+    def __init__(self, metrics=None,
+                 thresholds_ms: Optional[Dict[str, float]] = None,
+                 token_thresholds: Optional[Dict[str, int]] = None):
+        self.metrics = metrics
+        self.thresholds_ms = {**DEFAULT_THRESHOLDS_MS,
+                              **(thresholds_ms or {})}
+        self.token_thresholds = {**DEFAULT_TOKEN_THRESHOLDS,
+                                 **(token_thresholds or {})}
+        self.timings: Dict[str, float] = {}       # last duration per stage
+        self.warnings: list = []
+
+    @contextlib.contextmanager
+    def stage(self, name: str, **extra: Any) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            ms = (time.monotonic() - t0) * 1000.0
+            self.record_ms(name, ms, **extra)
+
+    def record_ms(self, name: str, ms: float, **extra: Any) -> None:
+        self.timings[name] = ms
+        limit = self.thresholds_ms.get(name)
+        if limit is not None and ms > limit:
+            self._warn(name, ms, limit, "ms", extra)
+
+    def record_tokens(self, name: str, tokens: int, **extra: Any) -> None:
+        limit = self.token_thresholds.get(name)
+        if limit is not None and tokens > limit:
+            self._warn(name, float(tokens), float(limit), "tokens", extra)
+
+    def _warn(self, name: str, value: float, limit: float, unit: str,
+              extra: Dict[str, Any]) -> None:
+        record = {"stage": name, "value": round(value, 1),
+                  "threshold": limit, "unit": unit, **extra}
+        self.warnings.append(record)
+        del self.warnings[:-100]
+        if self.metrics is not None:
+            self.metrics.capture("Performance Threshold Exceeded", record)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {k: round(v, 1) for k, v in self.timings.items()}
+
+
+@contextlib.contextmanager
+def profile_capture(log_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` over the wrapped region when ``log_dir`` is
+    set (no-op otherwise). The trace is TensorBoard-loadable and includes
+    device timelines — the trainer's self-observability hook."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -124,20 +124,44 @@ def grpo_round(state: TrainState, model_config, mesh,
                grpo_config: GRPOConfig = GRPOConfig(),
                reward_override=None,
                max_parallel: int = 8,
-               metrics_service=None) -> RoundResult:
+               metrics_service=None,
+               perf_monitor=None,
+               profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → single GRPO step.
 
     ``metrics_service`` (services.MetricsService) observes the trainer
     itself (SURVEY.md §7 step 8): per-phase wall time, episode rewards,
     and the update's loss/grad metrics — the trainer-side counterpart of
     the agent loop's 'Agent Loop Done' capture
-    (chatThreadService.ts:1742)."""
+    (chatThreadService.ts:1742). ``perf_monitor``
+    (services.PerformanceMonitor) threshold-checks each phase;
+    ``profile_dir`` wraps the whole round in a ``jax.profiler.trace``
+    capture (TensorBoard-loadable device timelines)."""
+    import time as _time
+
+    from ..services.perf_monitor import profile_capture
+    with profile_capture(profile_dir):
+        return _grpo_round_impl(
+            state, model_config, mesh, make_session, tasks,
+            group_size=group_size, pad_id=pad_id, max_len=max_len,
+            grpo_config=grpo_config, reward_override=reward_override,
+            max_parallel=max_parallel, metrics_service=metrics_service,
+            perf_monitor=perf_monitor)
+
+
+def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
+                     group_size, pad_id, max_len, grpo_config,
+                     reward_override, max_parallel, metrics_service,
+                     perf_monitor) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
         make_session, tasks, group_size=group_size,
         reward_override=reward_override, max_parallel=max_parallel)
     collect_s = _time.monotonic() - t0
+    if perf_monitor is not None:
+        perf_monitor.record_ms("rollout_collect", collect_s * 1000.0,
+                               episodes=len(episodes))
     if not trajectories:
         if metrics_service is not None:
             metrics_service.capture("GRPO Round Empty",
@@ -145,8 +169,13 @@ def grpo_round(state: TrainState, model_config, mesh,
                                      "collect_s": round(collect_s, 3)})
         return RoundResult(state=state, metrics={}, episodes=episodes,
                            trajectories=[])
+    t_b = _time.monotonic()
     tokens, mask, rewards, group_ids = make_batch(
         trajectories, pad_id=pad_id, max_len=max_len)
+    if perf_monitor is not None:
+        perf_monitor.record_ms("batch_build",
+                               (_time.monotonic() - t_b) * 1000.0,
+                               batch=len(trajectories))
     if mesh is None:
         tokens, mask, rewards, group_ids = map(
             jnp.asarray, (tokens, mask, rewards, group_ids))
@@ -180,6 +209,9 @@ def grpo_round(state: TrainState, model_config, mesh,
         state, model_config, mesh, tokens, mask, rewards, group_ids,
         grpo_config=grpo_config)
     out_metrics = {k: float(v) for k, v in metrics.items()}
+    if perf_monitor is not None:
+        perf_monitor.record_ms("train_step",
+                               (_time.monotonic() - t1) * 1000.0)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
         metrics_service.capture("GRPO Round Done", {

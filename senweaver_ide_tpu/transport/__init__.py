@@ -8,8 +8,11 @@ providers onto _sendOpenAICompatibleChat.
 """
 
 from .http_client import OpenAICompatClient, TransportUnavailable
+from .native_clients import (AnthropicMessagesClient, GeminiClient,
+                             make_client)
 from .providers import (PROVIDERS, ProviderSettings, get_provider,
                         resolve_model)
 
-__all__ = ["OpenAICompatClient", "TransportUnavailable", "PROVIDERS",
-           "ProviderSettings", "get_provider", "resolve_model"]
+__all__ = ["OpenAICompatClient", "TransportUnavailable",
+           "AnthropicMessagesClient", "GeminiClient", "make_client",
+           "PROVIDERS", "ProviderSettings", "get_provider", "resolve_model"]

@@ -21,7 +21,8 @@ from typing import Dict, Optional, Tuple
 @dataclasses.dataclass(frozen=True)
 class ProviderSettings:
     name: str
-    endpoint_style: str            # 'local' | 'openai-compat' | 'anthropic'
+    # 'local' | 'openai-compat' | 'anthropic' | 'gemini'
+    endpoint_style: str
     base_url: str = ""
     api_key_env: str = ""          # env var carrying the key
     supports_fim: bool = False
@@ -41,9 +42,8 @@ PROVIDERS: Dict[str, ProviderSettings] = {p.name: p for p in [
                      base_url="https://api.openai.com/v1",
                      api_key_env="OPENAI_API_KEY",
                      default_model="gpt-4o"),
-    ProviderSettings("gemini", "openai-compat",
-                     base_url="https://generativelanguage.googleapis.com"
-                              "/v1beta/openai",
+    ProviderSettings("gemini", "gemini",
+                     base_url="https://generativelanguage.googleapis.com",
                      api_key_env="GEMINI_API_KEY",
                      default_model="gemini-2.0-flash"),
     ProviderSettings("deepseek", "openai-compat",

@@ -53,7 +53,7 @@ def test_flash_grads_match_einsum(cfg, params, tokens):
     g_flash = jax.grad(loss)(params, flash_cfg)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(b), np.asarray(a), atol=2e-3, rtol=2e-3),
+            np.asarray(b), np.asarray(a), atol=2e-2, rtol=5e-3),
         g_ref, g_flash)
 
 

@@ -116,6 +116,32 @@ def test_top_k_top_p_masks():
     assert int(tok) == 3
 
 
+def test_top_p_cutoff_matches_exact():
+    """Bounded-candidate nucleus mask == full-sort mask whenever the
+    nucleus fits inside the cutoff."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.standard_normal((4, 1000)) * 3, jnp.float32)
+    for p, cutoff in ((0.3, 128), (0.8, 128), (0.95, 600)):
+        exact = np.asarray(apply_top_p(logits, p)) > -1e29
+        fast = np.asarray(apply_top_p(logits, p, cutoff=cutoff)) > -1e29
+        np.testing.assert_array_equal(fast, exact)
+    # Nucleus wider than the cutoff clips to exactly the cutoff.
+    clipped = np.asarray(apply_top_p(logits, 0.95, cutoff=64)) > -1e29
+    assert (clipped.sum(axis=-1) == 64).all()
+
+
+def test_top_p_zero_is_disabled():
+    """top_p=0 means DISABLED: sampling follows the temperature
+    distribution instead of collapsing to uniform (r1 bug: p=0 masked
+    every token and paid a full-vocab sort per decode step)."""
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]] * 64, jnp.float32)
+    toks = sample_token(logits, jax.random.PRNGKey(0), temperature=1.0,
+                        top_p=0.0)
+    # Token 0 holds ~99.99% of the mass; uniform sampling would pick it
+    # ~25% of the time — 64/64 hits is decisive.
+    assert (np.asarray(toks) == 0).all()
+
+
 def test_sharded_forward_on_8_device_mesh(model):
     """Multi-chip path: fsdp=2 × tp=4 mesh on the virtual CPU devices;
     sharded forward must equal single-device forward."""
