@@ -5,16 +5,20 @@ skillService.ts, mcpService.ts/mcpChannel.ts, metricsService.ts, and the
 tiered config system (product.json / settings / online config).
 """
 
-from .config import BUILD_DEFAULTS, RuntimeConfig
+from .config import BUILD_DEFAULTS, RuntimeConfig, install_config_channel
 from .extensions import (ExtensionServer, ExtensionServerError,
                          ExtensionTool, ExtensionToolRegistry)
 from .metrics import MetricsService, load_jsonl_metrics
+from .model_refresh import (CustomApiService, RefreshModelService,
+                            fetch_model_list)
 from .perf_monitor import (DEFAULT_THRESHOLDS_MS, PerformanceMonitor,
                            profile_capture)
 from .skills import SkillInfo, SkillService
 
 __all__ = [
-    "BUILD_DEFAULTS", "RuntimeConfig", "ExtensionServer",
-    "ExtensionServerError", "ExtensionTool", "ExtensionToolRegistry",
-    "MetricsService", "load_jsonl_metrics", "SkillInfo", "SkillService",
+    "BUILD_DEFAULTS", "RuntimeConfig", "install_config_channel",
+    "ExtensionServer", "ExtensionServerError", "ExtensionTool",
+    "ExtensionToolRegistry", "MetricsService", "load_jsonl_metrics",
+    "CustomApiService", "RefreshModelService", "fetch_model_list",
+    "SkillInfo", "SkillService",
 ]

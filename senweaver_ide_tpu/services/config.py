@@ -121,3 +121,48 @@ class _Missing:
 
 
 _MISSING = _Missing()
+
+
+def install_config_channel(server, config: "RuntimeConfig"):
+    """Online-config push channel over the trainer's JSON-RPC socket.
+
+    The reference pushes live config over a global WebSocket and reports
+    model usage back on the same channel
+    (browser/senweaverOnlineConfigContribution.ts:53-76
+    isOwnProviderEnabled / sendModelUsageReport). Here the trainer's
+    control server (runtime/control.py) IS the push channel: an operator
+    (or the C++ senweaver-ctl CLI) can push overrides into the live tier
+    at runtime without restarting training.
+
+    Registers three methods and returns the usage-report sink:
+      - ``config.push {..overrides.., allowed_models?}`` → replaces the
+        live tier atomically (model gating included)
+      - ``config.get {"key": dotted}`` → resolved value ("live > user >
+        default"); no key → {"allowed": [...] } summary
+      - ``config.usage_report {model, tokens, ...}`` → appended to the
+        returned list (the sendModelUsageReport analogue)
+    """
+    usage_reports: List[Dict[str, Any]] = []
+
+    def _push(params: Any) -> Dict[str, Any]:
+        if not isinstance(params, dict):
+            raise ValueError("config.push expects an object of overrides")
+        config.apply_live_config(params)
+        return {"ok": True, "keys": sorted(params.keys())}
+
+    def _get(params: Any) -> Any:
+        if isinstance(params, dict) and "key" in params:
+            return config.get(str(params["key"]))
+        return {"live_keys": sorted(config._live.keys()),
+                "model_gating": config._allowed_models}
+
+    def _usage(params: Any) -> Dict[str, Any]:
+        if not isinstance(params, dict):
+            raise ValueError("config.usage_report expects an object")
+        usage_reports.append(dict(params))
+        return {"ok": True, "count": len(usage_reports)}
+
+    server.register("config.push", _push)
+    server.register("config.get", _get)
+    server.register("config.usage_report", _usage)
+    return usage_reports

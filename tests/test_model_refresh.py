@@ -1,0 +1,216 @@
+"""RefreshModelService / CustomApiService / online-config push channel.
+
+Covers the reference behaviors of common/refreshModelService.ts (model-list
+polling state machine), common/customApiService.ts (user-defined
+endpoints), and senweaverOnlineConfigContribution.ts (live config push +
+usage reporting) re-homed onto the trainer's JSON-RPC control socket.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from senweaver_ide_tpu.runtime.control import ControlServer
+from senweaver_ide_tpu.services.config import (RuntimeConfig,
+                                               install_config_channel)
+from senweaver_ide_tpu.services.model_refresh import (
+    STATE_ERROR, STATE_INIT, STATE_REFRESHING, STATE_SUCCESS,
+    CustomApiService, RefreshModelService, fetch_model_list)
+from senweaver_ide_tpu.transport.providers import (PROVIDERS,
+                                                   ProviderSettings)
+
+
+# ---- RefreshModelService state machine (injected fetcher) ----
+
+def test_refresh_success_updates_models_and_state():
+    svc = RefreshModelService(fetcher=lambda s: ["m1", "m2"])
+    assert svc.state_of("ollama") == STATE_INIT
+    models = svc.refresh("ollama")
+    assert models == ["m1", "m2"]
+    assert svc.state_of("ollama") == STATE_SUCCESS
+    assert svc.models_of("ollama") == ["m1", "m2"]
+    assert svc.error_of("ollama") is None
+
+
+def test_refresh_error_records_state_and_message():
+    def boom(_s):
+        raise ConnectionError("refused")
+    svc = RefreshModelService(fetcher=boom)
+    assert svc.refresh("ollama") == []
+    assert svc.state_of("ollama") == STATE_ERROR
+    assert "refused" in svc.error_of("ollama")
+
+
+def test_refresh_notifies_listeners_through_state_transitions():
+    events = []
+    svc = RefreshModelService(fetcher=lambda s: ["x"])
+    svc.on_change(lambda p: events.append((p, svc.state_of(p))))
+    svc.refresh("vllm")
+    assert (("vllm", STATE_REFRESHING) in events
+            and ("vllm", STATE_SUCCESS) in events)
+
+
+def test_refresh_unknown_provider_raises():
+    svc = RefreshModelService(fetcher=lambda s: [])
+    with pytest.raises(KeyError):
+        svc.refresh("no-such-provider")
+
+
+def test_refresh_all_covers_refreshable_set():
+    seen = []
+    svc = RefreshModelService(
+        fetcher=lambda s: seen.append(s.name) or [s.name + "-model"])
+    out = svc.refresh_all()
+    assert "ollama" in out and out["ollama"] == ["ollama-model"]
+    assert set(seen) == set(out.keys())
+
+
+def test_auto_poll_fires_and_stops():
+    calls = []
+    svc = RefreshModelService(fetcher=lambda s: calls.append(1) or [])
+    svc.start_auto(["ollama"], interval_s=0.05)
+    time.sleep(0.3)
+    svc.stop_auto()
+    n = len(calls)
+    assert n >= 2
+    time.sleep(0.15)
+    assert len(calls) == n  # no more ticks after stop
+
+
+# ---- fetch_model_list over a real local HTTP server ----
+
+class _ModelsHandler(http.server.BaseHTTPRequestHandler):
+    payload: dict = {}
+
+    def do_GET(self):
+        body = json.dumps(self.payload).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def models_server():
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _ModelsHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+
+
+def test_fetch_model_list_openai_shape(models_server):
+    _ModelsHandler.payload = {"data": [{"id": "qwen2.5-coder"},
+                                       {"id": "deepseek-coder"}]}
+    s = ProviderSettings(
+        "t", "openai-compat",
+        base_url=f"http://127.0.0.1:{models_server.server_address[1]}")
+    assert fetch_model_list(s) == ["qwen2.5-coder", "deepseek-coder"]
+
+
+def test_fetch_model_list_bare_models_shape(models_server):
+    _ModelsHandler.payload = {"models": [{"name": "llama3"}, "phi-3"]}
+    s = ProviderSettings(
+        "t", "openai-compat",
+        base_url=f"http://127.0.0.1:{models_server.server_address[1]}")
+    assert fetch_model_list(s) == ["llama3", "phi-3"]
+
+
+# ---- CustomApiService ----
+
+def test_custom_api_add_resolve_remove(tmp_path):
+    cfg = RuntimeConfig(settings_path=str(tmp_path / "settings.json"))
+    svc = CustomApiService(cfg)
+    try:
+        svc.add_endpoint("mylab", "http://10.0.0.5:8000/v1",
+                         default_model="my-model")
+        assert "mylab" in svc.list_endpoints()
+        settings = PROVIDERS["custom:mylab"]
+        assert settings.base_url == "http://10.0.0.5:8000/v1"
+        assert settings.default_model == "my-model"
+
+        # Persisted in the user tier → restored by a fresh service.
+        cfg2 = RuntimeConfig(settings_path=str(tmp_path / "settings.json"))
+        PROVIDERS.pop("custom:mylab")
+        svc2 = CustomApiService(cfg2)
+        assert svc2.settings_of("mylab").base_url == "http://10.0.0.5:8000/v1"
+    finally:
+        svc.remove_endpoint("mylab")
+    assert "custom:mylab" not in PROVIDERS
+    assert cfg.get("custom_apis", {}).get("mylab") is None
+
+
+def test_custom_api_validates_inputs():
+    svc = CustomApiService()
+    with pytest.raises(ValueError):
+        svc.add_endpoint("", "http://x")
+    with pytest.raises(ValueError):
+        svc.add_endpoint("x", "")
+
+
+# ---- online-config push channel over the control socket ----
+
+def _rpc(server, method, params=None):
+    with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as c:
+        c.connect(server.socket_path)
+        c.sendall((json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                               "params": params}) + "\n").encode())
+        c.shutdown(socket.SHUT_WR)
+        data = b""
+        while True:
+            chunk = c.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    return json.loads(data.decode())
+
+
+@pytest.fixture()
+def ctl(tmp_path):
+    s = ControlServer(str(tmp_path / "ctl.sock"))
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_config_push_applies_live_tier_and_gating(ctl):
+    cfg = RuntimeConfig()
+    install_config_channel(ctl, cfg)
+    resp = _rpc(ctl, "config.push",
+                {"train": {"learning_rate": 5e-6},
+                 "allowed_models": ["qwen"]})
+    assert resp["result"]["ok"] is True
+    assert cfg.get("train.learning_rate") == 5e-6
+    assert cfg.is_model_allowed("qwen2.5-coder-1.5b")
+    assert not cfg.is_model_allowed("deepseek-coder-6.7b")
+
+    got = _rpc(ctl, "config.get", {"key": "train.learning_rate"})
+    assert got["result"] == 5e-6
+
+
+def test_config_push_replaces_previous_live_tier(ctl):
+    cfg = RuntimeConfig()
+    install_config_channel(ctl, cfg)
+    _rpc(ctl, "config.push", {"allowed_models": ["qwen"]})
+    _rpc(ctl, "config.push", {"chat_mode": "normal"})
+    # gating cleared by the second push (atomic replacement)
+    assert cfg.is_model_allowed("anything")
+    assert cfg.get("chat_mode") == "normal"
+
+
+def test_usage_report_sink(ctl):
+    cfg = RuntimeConfig()
+    reports = install_config_channel(ctl, cfg)
+    _rpc(ctl, "config.usage_report",
+         {"model": "qwen2.5-coder-1.5b", "tokens": 1234})
+    assert reports == [{"model": "qwen2.5-coder-1.5b", "tokens": 1234}]
+    bad = _rpc(ctl, "config.usage_report", None)
+    assert "error" in bad
