@@ -5,6 +5,7 @@ skillService.ts, mcpService.ts/mcpChannel.ts, metricsService.ts, and the
 tiered config system (product.json / settings / online config).
 """
 
+from .collaboration import CollabCoordinator, CollabSession
 from .config import BUILD_DEFAULTS, RuntimeConfig, install_config_channel
 from .extensions import (ExtensionServer, ExtensionServerError,
                          ExtensionTool, ExtensionToolRegistry)
@@ -16,6 +17,7 @@ from .perf_monitor import (DEFAULT_THRESHOLDS_MS, PerformanceMonitor,
 from .skills import SkillInfo, SkillService
 
 __all__ = [
+    "CollabCoordinator", "CollabSession",
     "BUILD_DEFAULTS", "RuntimeConfig", "install_config_channel",
     "ExtensionServer", "ExtensionServerError", "ExtensionTool",
     "ExtensionToolRegistry", "MetricsService", "load_jsonl_metrics",

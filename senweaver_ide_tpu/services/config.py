@@ -104,6 +104,14 @@ class RuntimeConfig:
                 return True
             return any(a in model_name for a in self._allowed_models)
 
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent view of the live tier + gating (single lock hold)."""
+        with self._lock:
+            return {"live_keys": sorted(self._live.keys()),
+                    "model_gating": (list(self._allowed_models)
+                                     if self._allowed_models is not None
+                                     else None)}
+
     # -- change notification ----------------------------------------------
     def on_change(self, fn: Callable[[], None]) -> None:
         self._listeners.append(fn)
@@ -138,11 +146,13 @@ def install_config_channel(server, config: "RuntimeConfig"):
       - ``config.push {..overrides.., allowed_models?}`` → replaces the
         live tier atomically (model gating included)
       - ``config.get {"key": dotted}`` → resolved value ("live > user >
-        default"); no key → {"allowed": [...] } summary
+        default"); no key → {"live_keys": [...], "model_gating": [...]}
       - ``config.usage_report {model, tokens, ...}`` → appended to the
-        returned list (the sendModelUsageReport analogue)
+        returned deque (the sendModelUsageReport analogue), bounded at
+        1000 entries so a long-running trainer doesn't leak
     """
-    usage_reports: List[Dict[str, Any]] = []
+    from collections import deque
+    usage_reports: Any = deque(maxlen=1000)
 
     def _push(params: Any) -> Dict[str, Any]:
         if not isinstance(params, dict):
@@ -153,8 +163,7 @@ def install_config_channel(server, config: "RuntimeConfig"):
     def _get(params: Any) -> Any:
         if isinstance(params, dict) and "key" in params:
             return config.get(str(params["key"]))
-        return {"live_keys": sorted(config._live.keys()),
-                "model_gating": config._allowed_models}
+        return config.snapshot()
 
     def _usage(params: Any) -> Dict[str, Any]:
         if not isinstance(params, dict):

@@ -51,7 +51,10 @@ def fetch_model_list(settings: ProviderSettings, *,
     req = urllib.request.Request(url, headers={"Accept": "application/json"})
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         payload = json.loads(resp.read().decode("utf-8", errors="replace"))
-    entries = payload.get("data") or payload.get("models") or []
+    if isinstance(payload, list):            # bare-array shape
+        entries = payload
+    else:
+        entries = payload.get("data") or payload.get("models") or []
     out: List[str] = []
     for e in entries:
         if isinstance(e, str):
@@ -75,6 +78,7 @@ class RefreshModelService:
         self._listeners: List[Callable[[str], None]] = []
         self._lock = threading.Lock()
         self._timer: Optional[threading.Timer] = None
+        self._auto_gen = 0
         self._auto_providers: List[str] = []
         self._interval_s = 0.0
 
@@ -125,28 +129,45 @@ class RefreshModelService:
         return {name: self.refresh(name) for name in names}
 
     # -- auto-poll ---------------------------------------------------------
+    # A generation counter makes start/stop race-free: each start_auto
+    # invalidates every timer chain from earlier generations, so a slow
+    # in-flight _tick from a previous chain cannot reschedule itself
+    # alongside the new one.
     def start_auto(self, providers: List[str], interval_s: float) -> None:
-        self.stop_auto()
-        self._auto_providers = list(providers)
-        self._interval_s = interval_s
-        self._schedule()
+        with self._lock:
+            self._auto_gen = self._auto_gen + 1
+            gen = self._auto_gen
+            self._auto_providers = list(providers)
+            self._interval_s = interval_s
+            if self._timer is not None:
+                self._timer.cancel()
+        self._schedule(gen)
 
-    def _schedule(self) -> None:
-        self._timer = threading.Timer(self._interval_s, self._tick)
-        self._timer.daemon = True
-        self._timer.start()
+    def _schedule(self, gen: int) -> None:
+        with self._lock:
+            if gen != self._auto_gen:
+                return
+            self._timer = threading.Timer(self._interval_s, self._tick,
+                                          args=(gen,))
+            self._timer.daemon = True
+            self._timer.start()
 
-    def _tick(self) -> None:
-        for p in self._auto_providers:
+    def _tick(self, gen: int) -> None:
+        with self._lock:
+            if gen != self._auto_gen:
+                return
+            providers = list(self._auto_providers)
+        for p in providers:
             try:
                 self.refresh(p)
             except KeyError:
                 pass
-        if self._timer is not None:
-            self._schedule()
+        self._schedule(gen)
 
     def stop_auto(self) -> None:
-        t, self._timer = self._timer, None
+        with self._lock:
+            self._auto_gen = self._auto_gen + 1
+            t, self._timer = self._timer, None
         if t is not None:
             t.cancel()
 
@@ -188,7 +209,11 @@ class CustomApiService:
                 "supports_fim": bool(supports_fim)}
         settings = self._register(name, spec)
         if self._config is not None:
-            self._config.set_user(f"custom_apis.{name}", spec)
+            # Whole-dict write: a dotted set_user path would nest a name
+            # like "my.lab" into {"my": {"lab": ...}} and lose it.
+            apis = dict(self._config.get("custom_apis", {}) or {})
+            apis[name] = spec
+            self._config.set_user("custom_apis", apis)
         return settings
 
     def remove_endpoint(self, name: str) -> None:

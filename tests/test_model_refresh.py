@@ -211,6 +211,7 @@ def test_usage_report_sink(ctl):
     reports = install_config_channel(ctl, cfg)
     _rpc(ctl, "config.usage_report",
          {"model": "qwen2.5-coder-1.5b", "tokens": 1234})
-    assert reports == [{"model": "qwen2.5-coder-1.5b", "tokens": 1234}]
+    assert list(reports) == [{"model": "qwen2.5-coder-1.5b",
+                              "tokens": 1234}]
     bad = _rpc(ctl, "config.usage_report", None)
     assert "error" in bad
