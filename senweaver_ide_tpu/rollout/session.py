@@ -27,6 +27,8 @@ from ..rollout.checkpoints import ConversationCheckpoints
 from ..services.skills import SkillService
 from ..tools.sandbox import Workspace
 from ..tools.service import ToolsService
+from ..tools.documents import DocumentServices
+from ..tools.types import APPROVAL_TYPE_OF_TOOL, ApprovalType
 from ..tools.sidecars import SidecarServices
 from ..traces.collector import TraceCollector
 from ..traces.schema import Trace
@@ -79,13 +81,31 @@ class RolloutSession:
         # spurious tool failures into reward dims 3/4.
         self.sidecars = SidecarServices(self.workspace)
         self.sidecars.install(self.tools)
-        # Snapshot files before any edit tool touches them (the before-edit
-        # capture of chatThreadService.ts:1062-1068).
-        edit_tools = ("edit_file", "rewrite_file", "delete_file_or_folder",
-                      "create_file_or_folder")
+        # Document family + browser/vision (tools/documents.py):
+        # create/edit/convert/merge/extract, pdf ops, fetch-backed
+        # open_browser; analyze_image degrades to header metadata and
+        # screenshot_to_code stays gated without a vision_fn.
+        self.documents = DocumentServices(self.workspace,
+                                          sidecars=self.sidecars)
+        self.documents.install(self.tools)
+        # Snapshot files before any mutating tool touches them (the
+        # before-edit capture of chatThreadService.ts:1062-1068). The edit
+        # set derives from the approval map (every EDITS-class tool) plus
+        # the document writers whose output lands at output_path — a
+        # hand-rolled list here silently drifts as tools are added.
+        edit_tools = {name for name, a in APPROVAL_TYPE_OF_TOOL.items()
+                      if a is ApprovalType.EDITS}
+        doc_tools = {"edit_document", "create_document", "pdf_operation",
+                     "document_convert", "document_merge"}
 
         def snapshot_hook(tool: str, p: Dict[str, Any]) -> None:
-            if tool in edit_tools:
+            if tool in doc_tools:
+                # mutation_targets mirrors each handler's real output-path
+                # arithmetic (split's per-page files, convert's format
+                # override) — p["output_path"] alone would miss them.
+                for target in self.documents.mutation_targets(tool, p):
+                    self.checkpoints.snapshotter.ensure_before_state(target)
+            elif tool in edit_tools and p.get("uri"):
                 self.checkpoints.snapshotter.ensure_before_state(p["uri"])
 
         self.tools.add_pre_execute_hook(snapshot_hook)

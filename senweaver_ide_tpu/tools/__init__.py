@@ -7,6 +7,9 @@ result-cap semantics, confined to a reproducible sandbox so rollout rewards
 are valid (SURVEY.md §7).
 """
 
+from .documents import (DocumentServices, docx_write, image_info,
+                        minipdf_extract_pages, minipdf_write, pptx_text,
+                        pptx_write, xlsx_write)
 from .registry import TOOL_SCHEMAS, ToolSchema
 from .sandbox import SandboxViolation, Workspace
 from .search_replace import (DIVIDER, FINAL, ORIGINAL, MalformedBlocksError,
@@ -20,6 +23,9 @@ from .types import (APPROVAL_TYPE_OF_TOOL, BUILTIN_TOOL_NAMES, ApprovalType,
                     ToolValidationError)
 
 __all__ = [
+    "DocumentServices", "docx_write", "image_info",
+    "minipdf_extract_pages", "minipdf_write", "pptx_text", "pptx_write",
+    "xlsx_write",
     "TOOL_SCHEMAS", "ToolSchema", "SandboxViolation", "Workspace",
     "ORIGINAL", "DIVIDER", "FINAL", "MalformedBlocksError",
     "SearchNotFoundError", "SearchReplaceBlock", "apply_blocks",

@@ -160,10 +160,17 @@ class SidecarServices:
                               indent=2, ensure_ascii=False)
         elif suffix in (".txt", ".md", ".markdown", ".rst", ".log", ""):
             text = path.read_text(errors="replace")
-        elif suffix in (".pdf", ".doc", ".xls", ".ppt", ".pptx"):
+        elif suffix == ".pdf":
+            from .documents import minipdf_extract_pages
+            text = "\n\n".join(minipdf_extract_pages(path.read_bytes()))
+        elif suffix == ".pptx":
+            from .documents import pptx_text
+            text = pptx_text(path)
+        elif suffix in (".doc", ".xls", ".ppt"):
             raise ValueError(
-                f"{suffix} extraction needs an external converter in this "
-                f"hermetic build (reference: documentReader sidecar)")
+                f"legacy {suffix} extraction needs an external converter "
+                f"in this hermetic build (reference: documentReader "
+                f"sidecar)")
         else:
             text = path.read_text(errors="replace")
         start = int(p.get("start_index") or 0)

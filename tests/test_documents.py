@@ -1,0 +1,330 @@
+"""Document tool family: office writers round-trip, mini-PDF, convert/
+merge/extract, pdf ops, open_browser, vision tools.
+
+Reference behaviors: startDocumentReaderServer.cjs (3793 LoC) + the
+document/browser/vision sidecars (SURVEY.md §2.5/L8), collapsed to
+hermetic in-process handlers.
+"""
+
+import base64
+import http.server
+import json
+import struct
+import threading
+import zlib
+
+import pytest
+
+from senweaver_ide_tpu.tools.documents import (DocumentServices, docx_write,
+                                               image_info,
+                                               minipdf_extract_pages,
+                                               minipdf_write, pptx_text,
+                                               pptx_write, xlsx_write)
+from senweaver_ide_tpu.tools.sandbox import Workspace
+from senweaver_ide_tpu.tools.service import ToolsService
+from senweaver_ide_tpu.tools.types import ToolUnavailableError
+
+
+@pytest.fixture()
+def ws(tmp_path):
+    root = tmp_path / "space"
+    root.mkdir()
+    return Workspace(str(root))
+
+
+@pytest.fixture()
+def docs(ws):
+    return DocumentServices(ws)
+
+
+# ---- mini-PDF ----
+
+def test_minipdf_roundtrip_multipage():
+    data = minipdf_write([["page one line a", "line b"], ["page two"]])
+    assert data.startswith(b"%PDF-1.4")
+    pages = minipdf_extract_pages(data)
+    assert len(pages) == 2
+    assert "page one line a" in pages[0] and "line b" in pages[0]
+    assert pages[1] == "page two"
+
+
+def test_minipdf_escapes_special_chars():
+    pages = minipdf_extract_pages(minipdf_write([[r"f(x) = \alpha * (y)"]]))
+    assert pages[0] == r"f(x) = \alpha * (y)"
+
+
+def test_minipdf_extract_flate_stream():
+    """Foreign-PDF shape: a FlateDecode content stream still extracts."""
+    inner = b"BT /F1 11 Tf 72 720 Td (compressed hello) Tj ET"
+    stream = zlib.compress(inner)
+    fake = (b"%PDF-1.4\n1 0 obj\n<< /Length " + str(len(stream)).encode()
+            + b" /Filter /FlateDecode >>\nstream\n" + stream
+            + b"\nendstream\nendobj\n%%EOF")
+    assert minipdf_extract_pages(fake) == ["compressed hello"]
+
+
+def test_minipdf_extract_rejects_non_pdf_and_imageonly():
+    with pytest.raises(ValueError, match="not a PDF"):
+        minipdf_extract_pages(b"hello")
+    with pytest.raises(ValueError, match="no extractable text"):
+        minipdf_extract_pages(b"%PDF-1.4\nstream\n\xff\xfe\nendstream")
+
+
+# ---- office writers round-trip through the sidecar extractors ----
+
+def test_docx_roundtrip(ws, docs, tmp_path):
+    p = ws.resolve("a.docx")
+    p.write_bytes(docx_write(["Title", "Body with <angle> & amp"]))
+    text = docs.read_text_any(p)
+    assert text == "Title\nBody with <angle> & amp"
+
+
+def test_xlsx_roundtrip_mixed_types(ws, docs):
+    p = ws.resolve("t.xlsx")
+    p.write_bytes(xlsx_write([["name", "score"], ["qwen", 7], ["ds", 3.5]]))
+    text = docs.read_text_any(p)
+    assert text.split("\n") == ["name\tscore", "qwen\t7", "ds\t3.5"]
+
+
+def test_pptx_roundtrip(ws, docs):
+    p = ws.resolve("deck.pptx")
+    p.write_bytes(pptx_write([
+        {"title": "Slide 1", "content": ["b1", "b2"]},
+        {"title": "Slide 2", "content": []}]))
+    assert pptx_text(p) == "Slide 1\nb1\nb2\n\nSlide 2"
+
+
+# ---- create / edit ----
+
+def test_create_document_word_and_read_back(ws, docs):
+    out = docs.create_document({"type": "word", "file_path": "doc.docx",
+                                "document_data":
+                                    {"paragraphs": ["alpha", "beta"]}})
+    assert out["bytes"] > 0
+    assert docs.read_text_any(ws.resolve("doc.docx")) == "alpha\nbeta"
+
+
+def test_create_document_excel_from_rows(ws, docs):
+    docs.create_document({"type": "excel", "file_path": "t.xlsx",
+                          "document_data": {"rows": [["a", 1], ["b", 2]]}})
+    assert docs.read_text_any(ws.resolve("t.xlsx")) == "a\t1\nb\t2"
+
+
+def test_create_document_rejects_unknown_type(docs):
+    with pytest.raises(ValueError, match="unsupported document type"):
+        docs.create_document({"type": "hologram", "file_path": "x",
+                              "document_data": ""})
+
+
+def test_edit_document_replacements_docx(ws, docs):
+    ws.resolve("e.docx").write_bytes(docx_write(["hello world", "keep"]))
+    out = docs.edit_document({"uri": "e.docx", "replacements":
+                              [{"find": "world", "replace": "TPU"}]})
+    assert out["changes"] == 1
+    assert docs.read_text_any(ws.resolve("e.docx")) == "hello TPU\nkeep"
+
+
+def test_edit_document_full_content_text(ws, docs):
+    ws.resolve("n.md").write_text("old")
+    docs.edit_document({"uri": "n.md", "content": "# new\nbody"})
+    assert ws.resolve("n.md").read_text() == "# new\nbody"
+
+
+def test_edit_document_missing_file(docs):
+    with pytest.raises(FileNotFoundError):
+        docs.edit_document({"uri": "ghost.docx", "content": "x"})
+
+
+# ---- pdf_operation ----
+
+def test_pdf_merge_split_watermark(ws, docs):
+    ws.resolve("a.pdf").write_bytes(minipdf_write([["doc A"]]))
+    ws.resolve("b.pdf").write_bytes(minipdf_write([["doc B p1"],
+                                                   ["doc B p2"]]))
+    merged = docs.pdf_operation({"operation": "merge",
+                                 "input_files": ["a.pdf", "b.pdf"],
+                                 "output_path": "m.pdf"})
+    assert merged["pages"] == 3
+    assert minipdf_extract_pages(ws.resolve("m.pdf").read_bytes()) == \
+        ["doc A", "doc B p1", "doc B p2"]
+
+    split = docs.pdf_operation({"operation": "split",
+                                "input_files": "m.pdf",
+                                "output_path": "out.pdf"})
+    assert split["created"] == ["out_page1.pdf", "out_page2.pdf",
+                               "out_page3.pdf"]
+    assert minipdf_extract_pages(
+        ws.resolve("out_page2.pdf").read_bytes()) == ["doc B p1"]
+
+    wm = docs.pdf_operation({"operation": "watermark",
+                             "input_files": "a.pdf",
+                             "output_path": "w.pdf",
+                             "watermark_text": "CONFIDENTIAL"})
+    assert wm["watermark"] == "CONFIDENTIAL"
+    assert minipdf_extract_pages(ws.resolve("w.pdf").read_bytes()) == \
+        ["[CONFIDENTIAL]\ndoc A"]
+
+
+# ---- convert / merge / extract ----
+
+def test_convert_md_to_pdf_to_docx_chain(ws, docs):
+    ws.resolve("notes.md").write_text("# Notes\nline two")
+    docs.document_convert({"input_file": "notes.md",
+                           "output_path": "notes.pdf"})
+    assert "line two" in docs.read_text_any(ws.resolve("notes.pdf"))
+    docs.document_convert({"input_file": "notes.pdf",
+                           "output_path": "notes2", "format": "docx"})
+    assert "# Notes" in docs.read_text_any(ws.resolve("notes2.docx"))
+
+
+def test_convert_html_to_text(ws, docs):
+    ws.resolve("p.html").write_text(
+        "<html><body><p>Para one</p><p>Para two</p></body></html>")
+    out = docs.document_convert({"input_file": "p.html",
+                                 "output_path": "p.txt"})
+    assert out["format"] == "txt"
+    assert "Para one" in ws.resolve("p.txt").read_text()
+
+
+def test_document_merge_into_docx(ws, docs):
+    ws.resolve("1.txt").write_text("first")
+    ws.resolve("2.md").write_text("second")
+    out = docs.document_merge({"input_files": ["1.txt", "2.md"],
+                               "output_path": "all.docx"})
+    assert out["inputs"] == 2
+    assert docs.read_text_any(ws.resolve("all.docx")) == "first\n\nsecond"
+
+
+def test_document_extract_kinds(ws, docs):
+    ws.resolve("d.md").write_text(
+        "See https://example.com/x and mail a@b.io or c@d.org\n"
+        "| h1 | h2 |\n| v1 | v2 |\n")
+    links = docs.document_extract({"input_file": "d.md",
+                                   "extract_type": "links"})
+    assert links["links"] == ["https://example.com/x"]
+    emails = docs.document_extract({"input_file": "d.md",
+                                    "extract_type": "emails"})
+    assert emails["emails"] == ["a@b.io", "c@d.org"]
+    tables = docs.document_extract({"input_file": "d.md",
+                                    "extract_type": "tables"})
+    assert tables["rows"] == [["h1", "h2"], ["v1", "v2"]]
+    meta = docs.document_extract({"input_file": "d.md",
+                                  "extract_type": "metadata"})
+    assert meta["format"] == ".md" and meta["words"] > 5
+
+
+# ---- open_browser over a real local HTTP server ----
+
+class _Page(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = (b"<html><head><title>Home</title></head><body>"
+                b"<p>Welcome to the lab</p>"
+                b"<a href='/docs'>docs</a><a href='/about'>about</a>"
+                b"</body></html>")
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_open_browser_fetches_page(docs):
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _Page)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        out = docs.open_browser(
+            {"url": f"http://127.0.0.1:{srv.server_address[1]}/"})
+        assert out["title"] == "Home"
+        assert "Welcome to the lab" in out["content"]
+        assert out["links"] == ["/docs", "/about"]
+        assert out["session_id"].startswith("browser-")
+    finally:
+        srv.shutdown()
+
+
+# ---- vision tools ----
+
+def _png(w=4, h=2):
+    return (b"\x89PNG\r\n\x1a\n" + b"\x00\x00\x00\rIHDR"
+            + struct.pack(">II", w, h) + b"\x08\x06\x00\x00\x00")
+
+
+def test_analyze_image_metadata_only(docs):
+    out = docs.analyze_image(
+        {"image_data": base64.b64encode(_png(64, 48)).decode()})
+    assert (out["format"], out["width"], out["height"]) == ("png", 64, 48)
+    assert "note" in out            # degraded: no vision model
+
+
+def test_analyze_image_with_vision_fn(ws):
+    docs = DocumentServices(ws, vision_fn=lambda b, p: f"seen {len(b)}B")
+    out = docs.analyze_image(
+        {"image_data": base64.b64encode(_png()).decode(),
+         "prompt": "what is it"})
+    assert out["analysis"].startswith("seen ")
+
+
+def test_image_info_gif_and_reject():
+    assert image_info(b"GIF89a" + struct.pack("<HH", 10, 20)) == \
+        {"format": "gif", "width": 10, "height": 20}
+    with pytest.raises(ValueError):
+        image_info(b"not an image")
+
+
+def test_screenshot_to_code_gated_without_vision(docs):
+    with pytest.raises(ToolUnavailableError):
+        docs.screenshot_to_code({"source": "image",
+                                 "image_data":
+                                     base64.b64encode(_png()).decode()})
+
+
+def test_screenshot_to_code_with_vision(ws):
+    docs = DocumentServices(
+        ws, vision_fn=lambda b, p: "<div>ui</div>")
+    out = docs.screenshot_to_code(
+        {"source": "image",
+         "image_data": base64.b64encode(_png()).decode(),
+         "stack": "react"})
+    assert out == {"stack": "react", "code": "<div>ui</div>"}
+
+
+# ---- mutation targets (before-edit snapshot source of truth) ----
+
+def test_mutation_targets_split_and_convert(ws, docs):
+    ws.resolve("m.pdf").write_bytes(minipdf_write([["p1"], ["p2"]]))
+    # pre-existing page files that split would overwrite
+    ws.resolve("out_page1.pdf").write_bytes(minipdf_write([["old"]]))
+    targets = docs.mutation_targets(
+        "pdf_operation", {"operation": "split", "input_files": "m.pdf",
+                          "output_path": "out.pdf"})
+    assert targets == ["out_page1.pdf"]
+    # convert with a format override writes r.pdf, not r.txt
+    assert docs.mutation_targets(
+        "document_convert", {"input_file": "x.md", "output_path": "r.txt",
+                             "format": "pdf"}) == ["r.pdf"]
+    assert docs.mutation_targets(
+        "create_document", {"file_path": "n.docx"}) == ["n.docx"]
+
+
+def test_create_document_missing_key_is_actionable(docs):
+    with pytest.raises(ValueError, match="must contain 'paragraphs'"):
+        docs.create_document({"type": "word", "file_path": "a.docx",
+                              "document_data": {"text": "hi"}})
+
+
+# ---- through ToolsService (the real dispatch path) ----
+
+def test_tools_service_dispatch_document_family(ws):
+    tools = ToolsService(ws)
+    DocumentServices(ws).install(tools)
+    # params arrive as strings through the XML tool-call grammar
+    tr = tools.call_tool("create_document", {
+        "type": "word", "file_path": "r.docx",
+        "document_data": json.dumps({"paragraphs": ["via service"]})})
+    assert tr.error is None and tr.result["created"] == "r.docx"
+    tr2 = tools.call_tool("document_extract",
+                          {"input_file": "r.docx",
+                           "extract_type": "text"})
+    assert tr2.error is None and tr2.result["content"] == "via service"

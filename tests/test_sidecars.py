@@ -120,10 +120,21 @@ def test_read_document_text_csv_json_docx_xlsx(tmp_path, sidecars):
     assert "name\t7" in out["content"] and "alice\t9" in out["content"]
 
 
-def test_read_document_pdf_rejected(tmp_path, sidecars):
+def test_read_document_pdf_via_minipdf(sidecars):
+    from senweaver_ide_tpu.tools.documents import minipdf_write
+    (sidecars.workspace.root / "f.pdf").write_bytes(
+        minipdf_write([["hello pdf"]]))
+    out = sidecars.read_document({"uri": "f.pdf"})
+    assert out["content"] == "hello pdf"
+
+
+def test_read_document_textless_pdf_and_legacy_doc_rejected(sidecars):
     sidecars.workspace.write_file("f.pdf", "%PDF-fake")
-    with pytest.raises(ValueError, match="extraction"):
+    with pytest.raises(ValueError, match="no extractable text"):
         sidecars.read_document({"uri": "f.pdf"})
+    sidecars.workspace.write_file("f.doc", "binary-ish")
+    with pytest.raises(ValueError, match="legacy"):
+        sidecars.read_document({"uri": "f.doc"})
 
 
 def test_web_search_offline_is_graceful(sidecars):
