@@ -14,6 +14,7 @@ from .model_refresh import (CustomApiService, RefreshModelService,
                             fetch_model_list)
 from .perf_monitor import (DEFAULT_THRESHOLDS_MS, PerformanceMonitor,
                            profile_capture)
+from .scm import GitRepo, SCMService, extract_commit_message
 from .skills import SkillInfo, SkillService
 
 __all__ = [
@@ -22,5 +23,6 @@ __all__ = [
     "ExtensionServer", "ExtensionServerError", "ExtensionTool",
     "ExtensionToolRegistry", "MetricsService", "load_jsonl_metrics",
     "CustomApiService", "RefreshModelService", "fetch_model_list",
+    "GitRepo", "SCMService", "extract_commit_message",
     "SkillInfo", "SkillService",
 ]

@@ -172,8 +172,9 @@ class CollabCoordinator:
                     room = _Room(code, pid)
                     p = room.participants[pid] = _Participant(pid)
                     self.rooms[code] = room
-                with p.conn_lock:
-                    p.conn = conn
+                if not req.get("polling"):
+                    with p.conn_lock:
+                        p.conn = conn
                 self._reply(conn, {"type": "ok", "id": rid, "room": code})
                 return p
             if op == "join_room":
@@ -332,10 +333,12 @@ class CollabSession:
         self._conn: Optional[socket.socket] = None
         self._conn_lock = threading.Lock()
         self._pending: Dict[int, Dict[str, Any]] = {}
+        self._waiting: set = set()     # rids a _request still awaits
         self._pending_cv = threading.Condition()
         self._next_id = 1
         self._running = False
         self._reconnect_lock = threading.Lock()
+        self._stop_event = threading.Event()
         self._threads: List[threading.Thread] = []
 
     # -- connection --------------------------------------------------------
@@ -343,6 +346,7 @@ class CollabSession:
         self._conn = socket.create_connection(self._addr, timeout=5)
         self._conn.settimeout(0.5)
         self._running = True
+        self._stop_event.clear()
         if not self._threads:
             for target in (self._read_loop, self._heartbeat_loop):
                 t = threading.Thread(target=target, daemon=True)
@@ -351,6 +355,7 @@ class CollabSession:
 
     def close(self) -> None:
         self._running = False
+        self._stop_event.set()
         for t in self._threads:
             t.join(timeout=2)
         self._threads = []
@@ -405,6 +410,7 @@ class CollabSession:
         with self._pending_cv:
             rid = self._next_id
             self._next_id += 1
+            self._waiting.add(rid)
         req["id"] = rid
         line = (json.dumps(req) + "\n").encode()
         try:
@@ -414,6 +420,8 @@ class CollabSession:
                     raise OSError("not connected")
                 conn.sendall(line)
         except OSError:
+            with self._pending_cv:
+                self._waiting.discard(rid)
             self._handle_disconnect(conn)
             # Bounded per-request retries: a flapping coordinator that
             # accepts then drops each connection would otherwise recurse
@@ -427,13 +435,20 @@ class CollabSession:
                                   if k not in ("id", "client_id")},
                                  _attempt + 1)
         with self._pending_cv:
-            deadline = time.time() + 5
-            while rid not in self._pending:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    raise TimeoutError(f"no response for {req.get('op')}")
-                self._pending_cv.wait(remaining)
-            resp = self._pending.pop(rid)
+            try:
+                deadline = time.time() + 5
+                while rid not in self._pending:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"no response for {req.get('op')}")
+                    self._pending_cv.wait(remaining)
+                resp = self._pending.pop(rid)
+            finally:
+                # Abandoned rid: the read loop must drop (not store) a
+                # reply that straggles in after this timeout/raise.
+                self._waiting.discard(rid)
+                self._pending.pop(rid, None)
         if resp.get("type") == "error":
             raise RuntimeError(resp.get("error", "collab error"))
         return resp
@@ -466,9 +481,15 @@ class CollabSession:
 
     def _read_loop(self) -> None:
         buf = b""
+        last_conn: Optional[socket.socket] = None
         while self._running:
             with self._conn_lock:
                 conn = self._conn
+            if conn is not last_conn:
+                # New transport: a partial line from the dead socket must
+                # not prefix (and corrupt) the first reply on this one.
+                buf = b""
+                last_conn = conn
             if conn is None:
                 if self.polling:
                     return
@@ -495,8 +516,10 @@ class CollabSession:
                     continue
                 if "id" in msg and msg["id"] is not None:
                     with self._pending_cv:
-                        self._pending[msg["id"]] = msg
-                        self._pending_cv.notify_all()
+                        if msg["id"] in self._waiting:
+                            self._pending[msg["id"]] = msg
+                            self._pending_cv.notify_all()
+                        # else: straggler reply for an abandoned request
                 elif msg.get("type") not in ("ok", "error"):
                     # id-less ok/error replies come from fire-and-forget
                     # rejoins after a reconnect — not room traffic.
@@ -512,7 +535,9 @@ class CollabSession:
 
     def _heartbeat_loop(self) -> None:
         while self._running:
-            time.sleep(self.heartbeat_interval_s)
+            # Event-based wait so close() interrupts a 30 s sleep instantly.
+            if self._stop_event.wait(self.heartbeat_interval_s):
+                return
             if not self._running or not self.room:
                 continue
             try:
@@ -545,7 +570,8 @@ class CollabSession:
                     self._conn = None
             if self.polling:
                 return
-            while self.reconnects_used < self.max_reconnects:
+            while (self._running and not self._stop_event.is_set()
+                   and self.reconnects_used < self.max_reconnects):
                 self.reconnects_used += 1
                 try:
                     conn = socket.create_connection(self._addr, timeout=2)

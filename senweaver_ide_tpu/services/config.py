@@ -66,6 +66,18 @@ class RuntimeConfig:
             return default
 
     # -- tier 2: user settings --------------------------------------------
+    def get_user(self, dotted_key: str, default: Any = None) -> Any:
+        """Read the user tier ONLY (no live-tier shadowing) — for
+        read-modify-write persistence where resolving through the live
+        tier would copy transient pushed values into user settings."""
+        with self._lock:
+            v: Any = self._user
+            for part in dotted_key.split("."):
+                if not isinstance(v, dict) or part not in v:
+                    return default
+                v = v[part]
+            return v
+
     def set_user(self, dotted_key: str, value: Any) -> None:
         with self._lock:
             d = self._user

@@ -192,8 +192,11 @@ class CustomApiService:
     def __init__(self, config: Optional[RuntimeConfig] = None):
         self._config = config
         self._names: List[str] = []
+        self._lock = threading.Lock()
         if config is not None:
-            stored = config.get("custom_apis", {}) or {}
+            # User tier only: live-pushed endpoints are transient and must
+            # not be resurrected from (or copied into) the settings file.
+            stored = config.get_user("custom_apis", {}) or {}
             for name, spec in stored.items():
                 if isinstance(spec, dict) and spec.get("base_url"):
                     self._register(name, spec)
@@ -207,25 +210,27 @@ class CustomApiService:
         spec = {"base_url": base_url, "api_key_env": api_key_env,
                 "default_model": default_model,
                 "supports_fim": bool(supports_fim)}
-        settings = self._register(name, spec)
-        if self._config is not None:
-            # Whole-dict write: a dotted set_user path would nest a name
-            # like "my.lab" into {"my": {"lab": ...}} and lose it.
-            apis = dict(self._config.get("custom_apis", {}) or {})
-            apis[name] = spec
-            self._config.set_user("custom_apis", apis)
+        with self._lock:
+            settings = self._register(name, spec)
+            if self._config is not None:
+                # Whole-dict write keyed off the USER tier (a dotted
+                # set_user path would nest a name like "my.lab"; reading
+                # the merged view would persist live-pushed endpoints).
+                apis = dict(self._config.get_user("custom_apis", {}) or {})
+                apis[name] = spec
+                self._config.set_user("custom_apis", apis)
         return settings
 
     def remove_endpoint(self, name: str) -> None:
-        key = self.PREFIX + name
-        PROVIDERS.pop(key, None)
-        if name in self._names:
-            self._names.remove(name)
-        if self._config is not None:
-            apis = dict(self._config.get("custom_apis", {}) or {})
-            if name in apis:
-                del apis[name]
-                self._config.set_user("custom_apis", apis)
+        with self._lock:
+            PROVIDERS.pop(self.PREFIX + name, None)
+            if name in self._names:
+                self._names.remove(name)
+            if self._config is not None:
+                apis = dict(self._config.get_user("custom_apis", {}) or {})
+                if name in apis:
+                    del apis[name]
+                    self._config.set_user("custom_apis", apis)
 
     def list_endpoints(self) -> List[str]:
         return list(self._names)

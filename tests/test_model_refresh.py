@@ -148,6 +148,21 @@ def test_custom_api_add_resolve_remove(tmp_path):
     assert cfg.get("custom_apis", {}).get("mylab") is None
 
 
+def test_custom_api_ignores_live_tier(tmp_path):
+    """Live-pushed endpoints must not leak into persisted user settings."""
+    cfg = RuntimeConfig(settings_path=str(tmp_path / "s.json"))
+    cfg.apply_live_config({"custom_apis": {"pushed": {"base_url": "http://t"}}})
+    svc = CustomApiService(cfg)
+    assert svc.list_endpoints() == []        # live tier not restored
+    try:
+        svc.add_endpoint("mine", "http://m")
+        assert cfg.get_user("custom_apis") == {
+            "mine": {"base_url": "http://m", "api_key_env": "",
+                     "default_model": "", "supports_fim": False}}
+    finally:
+        svc.remove_endpoint("mine")
+
+
 def test_custom_api_validates_inputs():
     svc = CustomApiService()
     with pytest.raises(ValueError):

@@ -1,0 +1,103 @@
+"""SCM commit-message service vs reference semantics
+(senweaverSCMService.ts + senweaverSCMMainService.ts)."""
+
+import subprocess
+
+import pytest
+
+from senweaver_ide_tpu.agents.llm import LLMResponse
+from senweaver_ide_tpu.services.scm import (MAX_DIFF_FILES, GitRepo,
+                                            SCMService,
+                                            commit_message_user_prompt,
+                                            extract_commit_message)
+
+
+class FakeClient:
+    def __init__(self, text):
+        self.text = text
+        self.calls = []
+
+    def chat(self, messages, **kw):
+        self.calls.append(messages)
+        return LLMResponse(text=self.text)
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (tmp_path / "a.py").write_text("x = 1\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "initial commit")
+    return tmp_path
+
+
+def test_extract_commit_message_tags():
+    assert extract_commit_message(
+        "<output>Fix the bug</output><reasoning>why</reasoning>") == \
+        "Fix the bug"
+    assert extract_commit_message("no tags at all") == ""
+
+
+def test_prompt_has_four_sections():
+    p = commit_message_user_prompt("S", "D", "main", "L")
+    for sec in ("Section 1 - Summary of Changes",
+                "Section 2 - Sampled File Diffs",
+                "Section 3 - Current Git Branch",
+                "Section 4 - Last 5 Commits"):
+        assert sec in p
+
+
+def test_working_tree_context_and_generation(repo):
+    (repo / "a.py").write_text("x = 2\nprint(x)\n")
+    client = FakeClient("<output>Update a.py computation</output>"
+                        "<reasoning>r</reasoning>")
+    svc = SCMService(client)
+    msg = svc.generate_commit_message(str(repo))
+    assert msg == "Update a.py computation"
+    user = client.calls[0][1].content
+    assert "a.py" in user and "main" in user
+    assert "initial commit" in user          # log section
+    assert "+x = 2" in user                  # unified=0 diff body
+
+
+def test_staged_changes_preferred(repo):
+    (repo / "staged.py").write_text("s = 1\n")
+    subprocess.run(["git", "add", "staged.py"], cwd=repo, check=True)
+    (repo / "a.py").write_text("x = 99\n")   # unstaged edit, must be ignored
+    svc = SCMService(FakeClient("<output>m</output>"))
+    repo_ctx = svc.gather_context(GitRepo(str(repo)))
+    stat, sampled, branch, log = repo_ctx
+    assert "staged.py" in stat and "a.py" not in stat
+    assert "staged.py" in sampled and "x = 99" not in sampled
+
+
+def test_top_files_capped_at_ten(repo):
+    for i in range(MAX_DIFF_FILES + 5):
+        # more churn in low-numbered files → they win the sampling
+        (repo / f"f{i:02d}.py").write_text(
+            "\n".join(f"line{j}" for j in range(30 - i)))
+    # intent-to-add so untracked files appear in the working-tree diff
+    subprocess.run(["git", "add", "-N", "."], cwd=repo, check=True)
+    svc = SCMService(FakeClient("<output>m</output>"))
+    _stat, sampled, _b, _l = svc.gather_context(GitRepo(str(repo)))
+    assert sampled.count("==== ") == MAX_DIFF_FILES
+    assert "==== f00.py ====" in sampled        # highest churn kept
+    assert "==== f14.py ====" not in sampled    # lowest churn dropped
+
+
+def test_clean_tree_raises(repo):
+    svc = SCMService(FakeClient("<output>m</output>"))
+    with pytest.raises(RuntimeError, match="clean tree"):
+        svc.generate_commit_message(str(repo))
+
+
+def test_missing_output_tag_raises(repo):
+    (repo / "a.py").write_text("x = 3\n")
+    svc = SCMService(FakeClient("I refuse to use tags"))
+    with pytest.raises(RuntimeError, match="no <output>"):
+        svc.generate_commit_message(str(repo))
