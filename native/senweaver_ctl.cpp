@@ -1,25 +1,41 @@
 // senweaver-ctl — native job-control CLI for the trainer runtime.
 //
 // Role: the reference ships a 17.5k-LoC Rust `code-cli` (cli/src/) doing
-// tunnels/auth/json_rpc/msgpack_rpc against its server. Rust is not in
-// this image (SURVEY.md §2.6), so this is the C++ equivalent scoped to
-// the trainer: JSON-RPC 2.0 over a unix domain socket to the Python
-// control server (senweaver_ide_tpu/runtime/control.py).
+// tunnels/auth/json_rpc/msgpack_rpc/singleton against its server. Rust is
+// not in this image (SURVEY.md §2.6), so this is the C++ equivalent
+// scoped to the trainer, speaking to the Python control server
+// (senweaver_ide_tpu/runtime/control.py) over a unix domain socket:
+//
+//   - JSON-RPC 2.0 (default) and msgpack-RPC (--msgpack) framings
+//     (cli/src/json_rpc.rs / msgpack_rpc.rs roles)
+//   - auth tokens via --token-file or $SENWEAVER_CTL_TOKEN
+//     (cli/src/auth.rs role; server enforces when configured)
+//   - singleton lock via --singleton-lock PATH (flock; exit 3 when
+//     another instance holds it — cli/src/singleton.rs role)
+//   - watch: poll status until no job is queued/running
 //
 // Usage:
-//   senweaver-ctl [--socket PATH] ping
-//   senweaver-ctl [--socket PATH] status
-//   senweaver-ctl [--socket PATH] submit '<params-json>'
-//   senweaver-ctl [--socket PATH] stop <job_id>
-//   senweaver-ctl [--socket PATH] call <method> ['<params-json>']
+//   senweaver-ctl [opts] ping|status|watch
+//   senweaver-ctl [opts] submit '<params-json>'
+//   senweaver-ctl [opts] stop <job_id>
+//   senweaver-ctl [opts] call <method> ['<params-json>']
+//   opts: --socket PATH --token-file PATH --msgpack
+//         --singleton-lock PATH --interval SECONDS
 //
-// Prints the raw JSON-RPC response to stdout; exit 0 on a "result"
-// response, 2 on an "error" response, 1 on transport failure.
+// Prints the JSON-RPC response (msgpack responses are re-rendered as
+// JSON) to stdout; exit 0 on "result", 2 on "error", 1 on transport
+// failure, 3 when the singleton lock is held elsewhere.
 
+#include <cerrno>
+#include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+#include <vector>
 
+#include <fcntl.h>
+#include <sys/file.h>
 #include <sys/socket.h>
 #include <sys/un.h>
 #include <unistd.h>
@@ -60,26 +76,302 @@ bool looks_like_json(const std::string& s) {
   return false;
 }
 
+// ---- msgpack encoding (request envelope: map of str → str|int) ----
+
+void mp_str(std::string& out, const std::string& s) {
+  size_t n = s.size();
+  if (n < 32) {
+    out += (char)(0xa0 | n);
+  } else if (n < 0x100) {
+    out += (char)0xd9;
+    out += (char)n;
+  } else if (n < 0x10000) {
+    out += (char)0xda;
+    out += (char)(n >> 8);
+    out += (char)(n & 0xff);
+  } else {            // str32: a 70 kB params blob must not truncate
+    out += (char)0xdb;
+    out += (char)((n >> 24) & 0xff);
+    out += (char)((n >> 16) & 0xff);
+    out += (char)((n >> 8) & 0xff);
+    out += (char)(n & 0xff);
+  }
+  out += s;
+}
+
+// ---- msgpack decoding → JSON rendering (response path) ----
+
+struct MpReader {
+  const unsigned char* p;
+  size_t len;
+  size_t off = 0;
+  bool ok = true;
+
+  bool need(size_t n) {
+    if (off + n > len) { ok = false; return false; }
+    return true;
+  }
+  uint64_t be(size_t n) {
+    uint64_t v = 0;
+    for (size_t i = 0; i < n; i++) v = (v << 8) | p[off + i];
+    off += n;
+    return v;
+  }
+};
+
+void mp_to_json(MpReader& r, std::string& out);
+
+void mp_str_to_json(MpReader& r, size_t n, std::string& out) {
+  if (!r.need(n)) return;
+  out += '"';
+  out += json_escape(std::string((const char*)r.p + r.off, n));
+  out += '"';
+  r.off += n;
+}
+
+void mp_seq_to_json(MpReader& r, size_t n, bool map, std::string& out) {
+  out += map ? '{' : '[';
+  for (size_t i = 0; i < n && r.ok; i++) {
+    if (i) out += ", ";
+    mp_to_json(r, out);
+    if (map) {
+      out += ": ";
+      mp_to_json(r, out);
+    }
+  }
+  out += map ? '}' : ']';
+}
+
+void mp_to_json(MpReader& r, std::string& out) {
+  if (!r.need(1)) return;
+  unsigned char b = r.p[r.off++];
+  char buf[32];
+  if (b <= 0x7f) {
+    std::snprintf(buf, sizeof buf, "%u", b);
+    out += buf;
+  } else if (b >= 0xe0) {
+    std::snprintf(buf, sizeof buf, "%d", (int)b - 256);
+    out += buf;
+  } else if (b >= 0x80 && b <= 0x8f) {
+    mp_seq_to_json(r, b & 0x0f, true, out);
+  } else if (b >= 0x90 && b <= 0x9f) {
+    mp_seq_to_json(r, b & 0x0f, false, out);
+  } else if (b >= 0xa0 && b <= 0xbf) {
+    mp_str_to_json(r, b & 0x1f, out);
+  } else if (b == 0xc0) {
+    out += "null";
+  } else if (b == 0xc2) {
+    out += "false";
+  } else if (b == 0xc3) {
+    out += "true";
+  } else if (b == 0xc4 || b == 0xc5 || b == 0xc6) {   // bin → str
+    size_t w = (size_t)1 << (b - 0xc4);
+    if (r.need(w)) mp_str_to_json(r, (size_t)r.be(w == 4 ? 4 : w), out);
+  } else if (b == 0xcb) {                              // float64
+    if (r.need(8)) {
+      uint64_t bits = r.be(8);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      std::snprintf(buf, sizeof buf, "%.17g", d);
+      out += buf;
+    }
+  } else if (b >= 0xcc && b <= 0xcf) {                 // uint
+    size_t w = (size_t)1 << (b - 0xcc);
+    if (r.need(w)) {
+      std::snprintf(buf, sizeof buf, "%llu",
+                    (unsigned long long)r.be(w));
+      out += buf;
+    }
+  } else if (b >= 0xd0 && b <= 0xd3) {                 // int
+    size_t w = (size_t)1 << (b - 0xd0);
+    if (r.need(w)) {
+      uint64_t raw = r.be(w);
+      int64_t v;
+      if (w == 1) v = (int8_t)raw;
+      else if (w == 2) v = (int16_t)raw;
+      else if (w == 4) v = (int32_t)raw;
+      else v = (int64_t)raw;
+      std::snprintf(buf, sizeof buf, "%lld", (long long)v);
+      out += buf;
+    }
+  } else if (b == 0xd9 || b == 0xda || b == 0xdb) {    // str8/16/32
+    size_t w = (size_t)1 << (b - 0xd9);
+    if (r.need(w)) mp_str_to_json(r, (size_t)r.be(w), out);
+  } else if (b == 0xdc || b == 0xdd) {                 // array16/32
+    size_t w = b == 0xdc ? 2 : 4;
+    if (r.need(w)) mp_seq_to_json(r, (size_t)r.be(w), false, out);
+  } else if (b == 0xde || b == 0xdf) {                 // map16/32
+    size_t w = b == 0xde ? 2 : 4;
+    if (r.need(w)) mp_seq_to_json(r, (size_t)r.be(w), true, out);
+  } else {
+    r.ok = false;
+  }
+}
+
+// ---- transport ----
+
+int send_request(const char* socket_path, const std::string& request,
+                 std::string& response) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
+    std::fprintf(stderr, "connect %s: %s\n", socket_path,
+                 std::strerror(errno));
+    ::close(fd);
+    return 1;
+  }
+  size_t off = 0;
+  while (off < request.size()) {
+    ssize_t w = ::write(fd, request.data() + off, request.size() - off);
+    if (w <= 0) {
+      std::perror("write");
+      ::close(fd);
+      return 1;
+    }
+    off += (size_t)w;
+  }
+  ::shutdown(fd, SHUT_WR);
+  char buf[4096];
+  ssize_t r;
+  while ((r = ::read(fd, buf, sizeof buf)) > 0)
+    response.append(buf, (size_t)r);
+  ::close(fd);
+  return 0;
+}
+
+std::string read_token_file(const char* path) {
+  FILE* f = std::fopen(path, "r");
+  if (!f) {
+    std::fprintf(stderr, "token file %s: %s\n", path, std::strerror(errno));
+    std::exit(1);
+  }
+  char buf[512];
+  size_t n = std::fread(buf, 1, sizeof buf - 1, f);
+  std::fclose(f);
+  buf[n] = 0;
+  std::string tok(buf);
+  while (!tok.empty() && (tok.back() == '\n' || tok.back() == '\r' ||
+                          tok.back() == ' '))
+    tok.pop_back();
+  return tok;
+}
+
+std::string build_request(bool msgpack, const std::string& method,
+                          const std::string& params_json,
+                          const std::string& token) {
+  if (!msgpack) {
+    std::string req = std::string("{\"jsonrpc\": \"2.0\", \"id\": 1, ") +
+                      "\"method\": \"" + json_escape(method) + "\"";
+    if (!token.empty()) req += ", \"auth\": \"" + json_escape(token) + "\"";
+    req += ", \"params\": " + params_json + "}\n";
+    return req;
+  }
+  // msgpack envelope: map{jsonrpc, id, method, params_json[, auth]} —
+  // params stay as embedded JSON text (argv already carries JSON); the
+  // server inflates params_json (control.py _dispatch_msgpack).
+  int n_keys = token.empty() ? 4 : 5;
+  std::string out;
+  out += (char)(0x80 | n_keys);
+  mp_str(out, "jsonrpc");
+  mp_str(out, "2.0");
+  mp_str(out, "id");
+  out += (char)1;                     // positive fixint 1
+  mp_str(out, "method");
+  mp_str(out, method);
+  mp_str(out, "params_json");
+  mp_str(out, params_json);
+  if (!token.empty()) {
+    mp_str(out, "auth");
+    mp_str(out, token);
+  }
+  return out;
+}
+
+// exit code from a JSON response body: 0 result, 2 error.
+int response_exit_code(const std::string& response) {
+  size_t err_pos = response.find("\"error\":");
+  size_t res_pos = response.find("\"result\":");
+  if (err_pos == std::string::npos) return 0;
+  if (res_pos == std::string::npos) return 2;
+  return err_pos < res_pos ? 2 : 0;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   const char* socket_path = kDefaultSocket;
+  const char* token_file = nullptr;
+  const char* singleton_lock = nullptr;
+  bool msgpack = false;
+  int interval_s = 2;
   int argi = 1;
-  if (argi + 1 < argc && std::strcmp(argv[argi], "--socket") == 0) {
-    socket_path = argv[argi + 1];
-    argi += 2;
+  while (argi < argc && argv[argi][0] == '-') {
+    if (argi + 1 < argc && std::strcmp(argv[argi], "--socket") == 0) {
+      socket_path = argv[++argi];
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--token-file") == 0) {
+      token_file = argv[++argi];
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--singleton-lock") == 0) {
+      singleton_lock = argv[++argi];
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--interval") == 0) {
+      interval_s = std::atoi(argv[++argi]);
+      if (interval_s < 1) interval_s = 1;
+    } else if (std::strcmp(argv[argi], "--msgpack") == 0) {
+      msgpack = true;
+    } else {
+      std::fprintf(stderr, "unknown option: %s\n", argv[argi]);
+      return 1;
+    }
+    argi++;
   }
   if (argi >= argc) {
     std::fprintf(stderr,
-                 "usage: senweaver-ctl [--socket PATH] "
-                 "<ping|status|submit|stop|call> [args]\n");
+                 "usage: senweaver-ctl [--socket PATH] [--token-file PATH] "
+                 "[--msgpack] [--singleton-lock PATH] [--interval S] "
+                 "<ping|status|watch|submit|stop|call> [args]\n");
     return 1;
+  }
+
+  // Singleton lock (cli/src/singleton.rs role): exclusive flock held for
+  // the process lifetime; a second instance exits 3 immediately.
+  if (singleton_lock) {
+    int lfd = ::open(singleton_lock, O_CREAT | O_RDWR, 0644);
+    if (lfd < 0) {
+      std::perror("singleton lock open");
+      return 1;
+    }
+    if (::flock(lfd, LOCK_EX | LOCK_NB) != 0) {
+      std::fprintf(stderr,
+                   "another senweaver-ctl holds the singleton lock %s\n",
+                   singleton_lock);
+      return 3;
+    }
+    // lfd intentionally stays open: the lock lives as long as we do.
+  }
+
+  std::string token;
+  if (token_file) {
+    token = read_token_file(token_file);
+  } else if (const char* env = std::getenv("SENWEAVER_CTL_TOKEN")) {
+    token = env;
   }
 
   std::string cmd = argv[argi++];
   std::string method, params = "null";
+  bool watch = false;
   if (cmd == "ping" || cmd == "status") {
     method = cmd;
+  } else if (cmd == "watch") {
+    method = "status";
+    watch = true;
   } else if (cmd == "submit") {
     method = "submit";
     if (argi < argc) params = argv[argi++];
@@ -106,48 +398,33 @@ int main(int argc, char** argv) {
     params = "\"" + json_escape(params) + "\"";
   }
 
-  std::string request = std::string("{\"jsonrpc\": \"2.0\", \"id\": 1, ") +
-                        "\"method\": \"" + json_escape(method) +
-                        "\", \"params\": " + params + "}\n";
+  std::string request = build_request(msgpack, method, params, token);
 
-  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd < 0) {
-    std::perror("socket");
-    return 1;
-  }
-  sockaddr_un addr{};
-  addr.sun_family = AF_UNIX;
-  std::strncpy(addr.sun_path, socket_path, sizeof(addr.sun_path) - 1);
-  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0) {
-    std::fprintf(stderr, "connect %s: %s\n", socket_path,
-                 std::strerror(errno));
-    ::close(fd);
-    return 1;
-  }
-  size_t off = 0;
-  while (off < request.size()) {
-    ssize_t w = ::write(fd, request.data() + off, request.size() - off);
-    if (w <= 0) {
-      std::perror("write");
-      ::close(fd);
-      return 1;
+  for (;;) {
+    std::string response;
+    int rc = send_request(socket_path, request, response);
+    if (rc != 0) return rc;
+
+    std::string rendered;
+    if (msgpack) {
+      MpReader r{(const unsigned char*)response.data(), response.size()};
+      mp_to_json(r, rendered);
+      if (!r.ok) {
+        std::fprintf(stderr, "malformed msgpack response\n");
+        return 1;
+      }
+    } else {
+      rendered = response;
     }
-    off += (size_t)w;
-  }
-  ::shutdown(fd, SHUT_WR);
+    std::printf("%s\n", rendered.c_str());
+    std::fflush(stdout);
 
-  std::string response;
-  char buf[4096];
-  ssize_t r;
-  while ((r = ::read(fd, buf, sizeof buf)) > 0) response.append(buf, (size_t)r);
-  ::close(fd);
-  std::printf("%s\n", response.c_str());
-  // A JSON-RPC response carries exactly one of "result"/"error" at the
-  // top level; whichever KEY appears first decides. (A payload merely
-  // containing the text "error" must not flip the exit code.)
-  size_t err_pos = response.find("\"error\":");
-  size_t res_pos = response.find("\"result\":");
-  if (err_pos == std::string::npos) return 0;
-  if (res_pos == std::string::npos) return 2;
-  return err_pos < res_pos ? 2 : 0;
+    if (!watch) return response_exit_code(rendered);
+    // watch: stop once no job is queued or running (or on RPC error).
+    if (response_exit_code(rendered) != 0) return 2;
+    if (rendered.find("\"queued\"") == std::string::npos &&
+        rendered.find("\"running\"") == std::string::npos)
+      return 0;
+    ::sleep((unsigned)interval_s);
+  }
 }

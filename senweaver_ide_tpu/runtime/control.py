@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from .msgpack_lite import is_msgpack_request, pack, unpack_prefix
+
 DEFAULT_SOCKET = "/tmp/senweaver-ctl.sock"
 
 
@@ -32,10 +34,21 @@ class Job:
 
 
 class ControlServer:
+    """JSON-RPC / msgpack-RPC job-control endpoint.
+
+    ``token``: when set, every method except ``ping`` requires the
+    request to carry a matching ``auth`` field — the trainer-scoped
+    analogue of the reference CLI's auth layer (cli/src/auth.rs).
+    Requests whose first byte is a msgpack map are answered in msgpack
+    (cli/src/msgpack_rpc.rs framing); JSON stays the default.
+    """
+
     def __init__(self, socket_path: str = DEFAULT_SOCKET, *,
-                 on_submit: Optional[Callable[[Job], None]] = None):
+                 on_submit: Optional[Callable[[Job], None]] = None,
+                 token: Optional[str] = None):
         self.socket_path = socket_path
         self.on_submit = on_submit
+        self.token = token
         self.jobs: Dict[str, Job] = {}
         self._handlers: Dict[str, Callable[[Any], Any]] = {
             "ping": lambda p: "pong",
@@ -111,39 +124,91 @@ class ControlServer:
                 try:
                     data = b""
                     conn.settimeout(2.0)
+                    msgpack_mode = False
                     while True:
                         chunk = conn.recv(65536)
                         if not chunk:
                             break
                         data += chunk
+                        msgpack_mode = is_msgpack_request(data[0])
+                        if msgpack_mode:
+                            # msgpack has no line terminator: stop once
+                            # one complete value has arrived (the client
+                            # half-closes after writing anyway).
+                            try:
+                                unpack_prefix(data)
+                                break
+                            except ValueError:
+                                continue
                         if b"\n" in data:
                             break
-                    resp = self._dispatch(data.decode(errors="replace"))
-                    conn.sendall(resp.encode())
+                    if msgpack_mode:
+                        conn.sendall(self._dispatch_msgpack(data))
+                    else:
+                        resp = self._dispatch(data.decode(errors="replace"))
+                        conn.sendall(resp.encode())
                 except OSError:
                     pass
 
+    def _handle_request(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Shared auth + dispatch core for both wire framings."""
+        rid = req.get("id")
+        method = req.get("method", "")
+        if self.token and method != "ping" \
+                and req.get("auth") != self.token:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32001,
+                              "message": "unauthorized: bad or missing "
+                                         "auth token"}}
+        handler = self._handlers.get(method)
+        if handler is None:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32601,
+                              "message": f"method not found: {method}"}}
+        try:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "result": handler(req.get("params"))}
+        except Exception as e:
+            return {"jsonrpc": "2.0", "id": rid,
+                    "error": {"code": -32000,
+                              "message": f"{type(e).__name__}: {e}"}}
+
     def _dispatch(self, raw: str) -> str:
-        rid: Any = None
+        # Every failure path must produce an error RESPONSE: an uncaught
+        # exception here kills the serve thread (a one-packet DoS).
         try:
             req = json.loads(raw)
-            rid = req.get("id")
-            method = req.get("method", "")
-            handler = self._handlers.get(method)
-            if handler is None:
-                return json.dumps({
-                    "jsonrpc": "2.0", "id": rid,
-                    "error": {"code": -32601,
-                              "message": f"method not found: {method}"}})
-            result = handler(req.get("params"))
-            return json.dumps({"jsonrpc": "2.0", "id": rid,
-                               "result": result})
         except json.JSONDecodeError as e:
             return json.dumps({"jsonrpc": "2.0", "id": None,
                                "error": {"code": -32700,
                                          "message": f"parse error: {e}"}})
-        except Exception as e:
-            return json.dumps({"jsonrpc": "2.0", "id": rid,
+        try:
+            if not isinstance(req, dict):
+                raise ValueError("request must be a JSON object")
+            return json.dumps(self._handle_request(req))
+        except Exception as e:   # non-dict req, unserializable result, …
+            return json.dumps({"jsonrpc": "2.0", "id": None,
                                "error": {"code": -32000,
                                          "message": f"{type(e).__name__}: "
                                                     f"{e}"}})
+
+    def _dispatch_msgpack(self, raw: bytes) -> bytes:
+        try:
+            req, _end = unpack_prefix(raw)
+            if not isinstance(req, dict):
+                raise ValueError("request must be a map")
+            # msgpack envelope may carry params as embedded JSON text
+            # (the CLI has argv JSON in hand; cf. params_json below).
+            if "params_json" in req and "params" not in req:
+                pj = req.pop("params_json")
+                req["params"] = json.loads(pj) if pj else None
+        except (ValueError, json.JSONDecodeError, RecursionError) as e:
+            return pack({"jsonrpc": "2.0", "id": None,
+                         "error": {"code": -32700,
+                                   "message": f"parse error: {e}"}})
+        try:
+            return pack(self._handle_request(req))
+        except Exception as e:   # e.g. a handler result pack() rejects
+            return pack({"jsonrpc": "2.0", "id": None,
+                         "error": {"code": -32000,
+                                   "message": f"{type(e).__name__}: {e}"}})
