@@ -41,6 +41,7 @@ class OpenAICompatClient:
                  rate_limiter: Optional[TPMRateLimiter] = None):
         settings = get_provider(provider) or ProviderSettings(
             provider, "openai-compat")
+        self.settings = settings
         self.provider = settings.name
         self.model = model or settings.default_model
         self.base_url = (base_url or settings.base_url).rstrip("/")
@@ -54,10 +55,6 @@ class OpenAICompatClient:
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
              max_tokens: Optional[int] = None) -> LLMResponse:
-        wait = self.limiter.get_wait_time(self.provider)
-        if wait > 0:
-            import time
-            time.sleep(wait)
         body = {
             "model": self.model,
             "messages": [{"role": m.role if m.role != "tool" else "user",
@@ -67,8 +64,60 @@ class OpenAICompatClient:
             body["temperature"] = temperature
         if max_tokens is not None:
             body["max_tokens"] = max_tokens
+        payload = self._post("/chat/completions", body)
+        choice = (payload.get("choices") or [{}])[0]
+        usage = payload.get("usage") or {}
+        return LLMResponse(
+            text=(choice.get("message") or {}).get("content") or "",
+            usage=LLMUsage(
+                input_tokens=int(usage.get("prompt_tokens", 0)),
+                output_tokens=int(usage.get("completion_tokens", 0))),
+            model=payload.get("model", self.model))
+
+    def fim_complete(self, prefix: str, suffix: str = "", *,
+                     max_tokens: int = 64,
+                     temperature: float = 0.0) -> str:
+        """Remote fill-in-the-middle completion.
+
+        The reference exposes FIM for exactly two remote providers
+        (sendLLMMessage.impl.ts): mistral via its dedicated
+        ``/fim/completions`` endpoint and deepseek via the beta
+        prompt+suffix ``/completions`` shape (:174). Everything else
+        raises — callers fall back to pseudo-FIM chat or the local policy
+        (editor/autocomplete.py).
+        """
+        if not self.settings.supports_fim:
+            # Unregistered providers get the __init__ fallback settings
+            # (supports_fim=False), so they raise here too — no silent
+            # POST to an endpoint that likely doesn't exist.
+            raise TransportUnavailable(
+                f"provider {self.provider} does not support remote FIM")
+        body = {"model": self.model, "prompt": prefix, "suffix": suffix,
+                "max_tokens": max_tokens, "temperature": temperature}
+        if self.provider == "mistral":
+            payload = self._post("/fim/completions", body)
+        elif self.provider == "deepseek" and self.base_url.endswith("/v1"):
+            # deepseek serves prompt+suffix completions only under the
+            # /beta base, not /v1 (the beta API of
+            # sendLLMMessage.impl.ts:174)
+            payload = self._post("/completions", body,
+                                 base=self.base_url[:-len("v1")] + "beta")
+        else:
+            payload = self._post("/completions", body)
+        choice = (payload.get("choices") or [{}])[0]
+        # mistral replies chat-shaped, deepseek completion-shaped
+        return (choice.get("text")
+                or (choice.get("message") or {}).get("content") or "")
+
+    def _post(self, path: str, body: dict,
+              base: Optional[str] = None) -> dict:
+        """POST with rate limiting + the reference's error taxonomy."""
+        wait = self.limiter.get_wait_time(self.provider)
+        if wait > 0:
+            import time
+            time.sleep(wait)
         req = urllib.request.Request(
-            f"{self.base_url}/chat/completions",
+            f"{base or self.base_url}{path}",
             data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json",
                      **({"Authorization": f"Bearer {self.api_key}"}
@@ -105,11 +154,4 @@ class OpenAICompatClient:
             raise TransportUnavailable(
                 f"{self.provider} unreachable at {self.base_url}: {e}")
         self.limiter.record_success(self.provider)
-        choice = (payload.get("choices") or [{}])[0]
-        usage = payload.get("usage") or {}
-        return LLMResponse(
-            text=(choice.get("message") or {}).get("content") or "",
-            usage=LLMUsage(
-                input_tokens=int(usage.get("prompt_tokens", 0)),
-                output_tokens=int(usage.get("completion_tokens", 0))),
-            model=payload.get("model", self.model))
+        return payload

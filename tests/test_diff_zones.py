@@ -1,0 +1,184 @@
+"""Diff zones: find_diffs classification, streaming zones, accept/reject,
+snapshot/restore (reference: editCodeService.ts diff plane +
+helpers/findDiffs.ts)."""
+
+import pytest
+
+from senweaver_ide_tpu.editor.diff_zones import (DiffZoneService,
+                                                 find_diffs)
+from senweaver_ide_tpu.tools.sandbox import Workspace
+
+
+@pytest.fixture()
+def ws(tmp_path):
+    root = tmp_path / "space"
+    root.mkdir()
+    return Workspace(str(root))
+
+
+@pytest.fixture()
+def svc(ws):
+    return DiffZoneService(ws)
+
+
+# ---- find_diffs ----
+
+def test_find_diffs_edit():
+    (d,) = find_diffs("a\nb\nc", "a\nB\nc")
+    assert d.type == "edit"
+    assert (d.original_start_line, d.original_end_line) == (2, 2)
+    assert (d.start_line, d.end_line) == (2, 2)
+    assert d.original_code == "b" and d.code == "B"
+
+
+def test_find_diffs_insertion_empty_original_range():
+    (d,) = find_diffs("a\nc", "a\nb\nc")
+    assert d.type == "insertion"
+    assert d.original_end_line == d.original_start_line - 1  # empty range
+    assert (d.start_line, d.end_line) == (2, 2) and d.code == "b"
+
+
+def test_find_diffs_deletion():
+    (d,) = find_diffs("a\nb\nc", "a\nc")
+    assert d.type == "deletion"
+    assert (d.original_start_line, d.original_end_line) == (2, 2)
+    assert d.end_line == d.start_line - 1
+    assert d.original_code == "b"
+
+
+def test_find_diffs_trailing_newline_is_insertion():
+    """E vs E\\n must classify as insertion, not edit (findDiffs.ts:12)."""
+    (d,) = find_diffs("E", "E\n")
+    assert d.type == "insertion"
+
+
+def test_find_diffs_adjacent_changes_merge_to_one_streak():
+    # replace one line AND insert right after → single contiguous diff
+    diffs = find_diffs("a\nb\nc", "a\nB\nB2\nc")
+    assert len(diffs) == 1 and diffs[0].type == "edit"
+    assert diffs[0].code == "B\nB2"
+
+
+def test_find_diffs_multiple_regions():
+    diffs = find_diffs("a\nb\nc\nd\ne", "A\nb\nc\nd\nE")
+    assert [d.type for d in diffs] == ["edit", "edit"]
+    assert diffs[0].start_line == 1 and diffs[1].original_start_line == 5
+
+
+def test_find_diffs_identical_is_empty():
+    assert find_diffs("same\ntext", "same\ntext") == []
+
+
+# ---- streaming zone lifecycle ----
+
+def test_stream_updates_file_and_diffs(ws, svc):
+    ws.write_file("m.py", "def f():\n    return 1\n")
+    zid = svc.create_zone("m.py")
+    # stream arrives in two chunks, file follows each write
+    svc.write_stream(zid, "def f():\n    return 2")
+    assert "return 2" in ws.read_text("m.py")
+    diffs = svc.write_stream(zid, "def f():\n    return 2\n\ndef g():\n    return 3\n")
+    assert ws.read_text("m.py").count("def ") == 2
+    kinds = sorted(d.computed.type for d in diffs)
+    assert "edit" in kinds or "insertion" in kinds
+    final = svc.finish_stream(zid)
+    assert final                      # zone kept while diffs remain
+    zone = svc.zone_of_id[zid]
+    assert not zone.is_streaming
+
+
+def test_zone_with_no_changes_is_garbage_collected(ws, svc):
+    ws.write_file("x.txt", "keep\n")
+    zid = svc.create_zone("x.txt")
+    svc.write_stream(zid, "keep\n")
+    assert svc.finish_stream(zid) == []
+    assert zid not in svc.zone_of_id  # editCodeService.ts:350-360
+
+
+def test_accept_diff_keeps_file_removes_diff(ws, svc):
+    ws.write_file("a.txt", "one\ntwo\nthree")
+    zid = svc.create_zone("a.txt")
+    svc.write_stream(zid, "one\nTWO\nthree")
+    (d,) = svc.finish_stream(zid)
+    svc.accept_diff(zid, d.diffid)
+    assert ws.read_text("a.txt") == "one\nTWO\nthree"
+    assert zid not in svc.zone_of_id     # resolved zone gc'd
+
+
+def test_reject_diff_reverts_file(ws, svc):
+    ws.write_file("a.txt", "one\ntwo\nthree")
+    zid = svc.create_zone("a.txt")
+    svc.write_stream(zid, "one\nTWO\nthree")
+    (d,) = svc.finish_stream(zid)
+    svc.reject_diff(zid, d.diffid)
+    assert ws.read_text("a.txt") == "one\ntwo\nthree"
+    assert zid not in svc.zone_of_id
+
+
+def test_partial_accept_then_reject_other(ws, svc):
+    ws.write_file("a.txt", "a\nb\nc\nd\ne")
+    zid = svc.create_zone("a.txt")
+    svc.write_stream(zid, "A\nb\nc\nd\nE")
+    diffs = svc.finish_stream(zid)
+    assert len(diffs) == 2
+    first = min(diffs, key=lambda d: d.computed.start_line)
+    second = max(diffs, key=lambda d: d.computed.start_line)
+    svc.accept_diff(zid, first.diffid)
+    # re-fetch the recomputed remaining diff
+    (remaining,) = svc.diffs_of(zid)
+    assert remaining.computed.original_code == "e"
+    svc.reject_diff(zid, remaining.diffid)
+    assert ws.read_text("a.txt") == "A\nb\nc\nd\ne"
+
+
+def test_accept_all_and_reject_all(ws, svc):
+    ws.write_file("a.txt", "x\ny")
+    z1 = svc.create_zone("a.txt")
+    svc.write_stream(z1, "x1\ny1")
+    svc.finish_stream(z1)
+    svc.accept_all(z1)
+    assert ws.read_text("a.txt") == "x1\ny1"
+
+    z2 = svc.create_zone("a.txt")
+    svc.write_stream(z2, "x2\ny2")
+    svc.finish_stream(z2)
+    svc.reject_all(z2)
+    assert ws.read_text("a.txt") == "x1\ny1"
+    assert svc.zone_of_id == {}
+
+
+def test_zone_over_subrange_only_touches_its_span(ws, svc):
+    ws.write_file("a.txt", "h1\nbody1\nbody2\nfooter")
+    zid = svc.create_zone("a.txt", start_line=2, end_line=3)
+    svc.write_stream(zid, "BODY-A\nBODY-B\nBODY-C")
+    assert ws.read_text("a.txt") == "h1\nBODY-A\nBODY-B\nBODY-C\nfooter"
+    svc.finish_stream(zid)
+    svc.reject_all(zid)
+    assert ws.read_text("a.txt") == "h1\nbody1\nbody2\nfooter"
+
+
+def test_streaming_zone_rejects_late_writes(ws, svc):
+    ws.write_file("a.txt", "x")
+    zid = svc.create_zone("a.txt")
+    svc.write_stream(zid, "y")
+    svc.finish_stream(zid)
+    with pytest.raises(ValueError, match="not streaming"):
+        svc.write_stream(zid, "z")
+
+
+def test_snapshot_restore_roundtrip(ws, svc):
+    ws.write_file("a.txt", "alpha\nbeta")
+    zid = svc.create_zone("a.txt")
+    svc.write_stream(zid, "alpha\nBETA")
+    svc.finish_stream(zid)
+    snap = svc.snapshot("a.txt")
+
+    svc.accept_all(zid)
+    ws.write_file("a.txt", "totally different")
+
+    svc.restore("a.txt", snap)
+    assert ws.read_text("a.txt") == "alpha\nBETA"
+    (zone,) = svc.zones_of_uri("a.txt")
+    assert zone.original_code == "alpha\nbeta"
+    (d,) = svc.diffs_of(zone.diffareaid)
+    assert d.computed.type == "edit" and d.computed.code == "BETA"

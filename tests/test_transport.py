@@ -87,3 +87,60 @@ def test_unreachable_raises_transport_unavailable():
                            rate_limiter=TPMRateLimiter())
     with pytest.raises(TransportUnavailable):
         c.chat([ChatMessage("user", "hi")])
+
+
+# ---- remote FIM (mistral /fim/completions, deepseek /completions) ----
+
+class _FimHandler(http.server.BaseHTTPRequestHandler):
+    seen_paths: list = []
+
+    def do_POST(self):
+        body = json.loads(self.rfile.read(
+            int(self.headers["Content-Length"])))
+        _FimHandler.seen_paths.append(self.path)
+        resp = {"choices": [{"text":
+                             f"mid({body['prompt']}|{body['suffix']})"}]}
+        data = json.dumps(resp).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def fim_server():
+    _FimHandler.seen_paths = []
+    srv = http.server.HTTPServer(("127.0.0.1", 0), _FimHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_fim_mistral_uses_fim_completions_path(fim_server):
+    c = OpenAICompatClient("mistral", model="codestral-latest",
+                           base_url=fim_server, api_key="k",
+                           rate_limiter=TPMRateLimiter())
+    out = c.fim_complete("def f(", "):\n    pass")
+    assert out == "mid(def f(|):\n    pass)"
+    assert _FimHandler.seen_paths == ["/fim/completions"]
+
+
+def test_fim_deepseek_swaps_v1_base_for_beta(fim_server):
+    c = OpenAICompatClient("deepseek", model="deepseek-chat",
+                           base_url=fim_server + "/v1", api_key="k",
+                           rate_limiter=TPMRateLimiter())
+    out = c.fim_complete("x = ", "")
+    assert out.startswith("mid(x = |")
+    # deepseek FIM lives under /beta, not /v1 (beta completions API)
+    assert _FimHandler.seen_paths == ["/beta/completions"]
+
+
+def test_fim_unsupported_provider_raises(fim_server):
+    c = OpenAICompatClient("openai", model="gpt-4o", base_url=fim_server,
+                           rate_limiter=TPMRateLimiter())
+    with pytest.raises(TransportUnavailable, match="does not support"):
+        c.fim_complete("a", "b")
