@@ -99,7 +99,7 @@ def _measure(model_name: str, batch: int, prompt_len: int,
 
 
 def _measure_steps(model_name: str, batch: int, prompt_len: int,
-                   decode_tokens: int) -> float:
+                   decode_tokens: int, *, quantized: bool = False) -> float:
     """Decode tokens/sec via pipelined per-step dispatch (the `generate`
     / rollout-engine serving path): prefill once, then ``decode_tokens``
     back-to-back ``decode_step`` dispatches, blocking only at the end.
@@ -123,7 +123,8 @@ def _measure_steps(model_name: str, batch: int, prompt_len: int,
     config = get_config(model_name)
     params = jax.block_until_ready(init_params(config, jax.random.PRNGKey(0)))
     sample = SampleParams(temperature=0.8, top_k=0, top_p=0.0)
-    cache = init_kv_cache(config, batch, prompt_len + decode_tokens + 1)
+    cache = init_kv_cache(config, batch, prompt_len + decode_tokens + 1,
+                          quantized=quantized)
     logits, cache = prefill(params, config,
                             jnp.ones((batch, prompt_len), jnp.int32), cache)
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -158,27 +159,32 @@ def main() -> None:
 
     extra = {}
     if on_accel:
-        for name, b, p, n, key in (
-                ("qwen2.5-coder-1.5b", 32, 512, 128, "qwen1.5b_b32"),
-                # b8 is the 16 GB-HBM ceiling: 13.4 GB bf16 weights +
-                # 1.6 GB MHA KV cache (b16 ResourceExhausted's).
-                ("deepseek-coder-6.7b", 8, 256, 64, "deepseek6.7b_b8"),
+        for name, b, p, n, key, quant, mode in (
+                ("qwen2.5-coder-1.5b", 32, 512, 128, "qwen1.5b_b32",
+                 False, "scan"),
+                # int8 KV cache + donated cache buffers are what fit b16
+                # next to 13.4 GB of bf16 weights (bf16 cache tops out at
+                # b8 ≈ 166 tok/s); the AOT helper rejects this model's
+                # prefill+scan graphs, so measure via the per-step serving
+                # path directly.
+                ("deepseek-coder-6.7b", 16, 128, 96,
+                 "deepseek6.7b_b16_int8kv", True, "steps"),
         ):
+            if mode == "scan":
+                try:
+                    extra[key] = round(_measure(name, b, p, n), 2)
+                    continue
+                except Exception:
+                    # Fall through OUTSIDE this handler: the in-flight
+                    # exception's traceback pins _measure's frame (GBs of
+                    # params) and retrying under it double-allocates.
+                    pass
+                import gc
+                gc.collect()  # release the failed attempt's device buffers
+                key += "_hostloop"
             try:
-                extra[key] = round(_measure(name, b, p, n), 2)
-                continue
-            except Exception:
-                # AOT helper rejects some prefill+scan graphs (observed at
-                # 6.7b); the per-step serving path still measures decode.
-                # Fall through OUTSIDE this handler: the in-flight
-                # exception's traceback pins _measure's frame (13.4 GB of
-                # params) and retrying under it double-allocates → OOM.
-                pass
-            import gc
-            gc.collect()      # release the failed attempt's device buffers
-            try:
-                extra[key + "_hostloop"] = round(
-                    _measure_steps(name, b, p, n), 2)
+                extra[key] = round(
+                    _measure_steps(name, b, p, n, quantized=quant), 2)
             except Exception as e:
                 extra[key] = f"error: {type(e).__name__}: {e}"[:200]
 

@@ -52,6 +52,16 @@ class Diff:
     computed: ComputedDiff
 
 
+def _pad(s: str) -> List[str]:
+    """The padded line space find_diffs numbers its ranges in."""
+    return (s + "\n").split("\n")
+
+
+def _unpad(lines: List[str]) -> str:
+    s = "\n".join(lines)
+    return s[:-1] if s.endswith("\n") else s
+
+
 def find_diffs(old: str, new: str) -> List[ComputedDiff]:
     """Line diffs as maximal contiguous changed regions (findDiffs.ts).
 
@@ -129,18 +139,24 @@ class DiffZoneService:
     def create_zone(self, uri: str, *, start_line: int = 1,
                     end_line: Optional[int] = None) -> int:
         """Open a streaming DiffZone over file lines
-        [start_line, end_line] (default: the whole file)."""
+        [start_line, end_line] (default: the whole file).
+
+        A zone always covers ≥1 line ("" is exactly one empty line, the
+        ``str.split`` convention) — zero-line zones would make the empty
+        string ambiguous between "no lines" and "one blank line"."""
         try:
             text = self.workspace.read_text(uri)
         except FileNotFoundError:
             text = ""
         lines = text.split("\n")
+        start_line = max(1, min(start_line, len(lines)))
         if end_line is None:
             end_line = len(lines)
-        end_line = max(min(end_line, len(lines)), start_line - 1)
+        end_line = max(min(end_line, len(lines)), start_line)
         zone = DiffZone(
             diffareaid=self._next_zone, uri=uri, start_line=start_line,
-            original_code="\n".join(lines[start_line - 1:end_line]))
+            original_code="\n".join(lines[start_line - 1:end_line]),
+            file_span=(start_line, end_line))
         zone.current_code = zone.original_code
         self._next_zone += 1
         self.zone_of_id[zone.diffareaid] = zone
@@ -174,11 +190,15 @@ class DiffZoneService:
         it no longer differs. The file is already in the new state."""
         zone, d = self._zone_diff(zone_id, diffid)
         c = d.computed
-        orig = zone.original_code.split("\n")
-        new = zone.current_code.split("\n")
+        # Splice in the same trailing-newline-PADDED space find_diffs
+        # computed the line numbers in — a diff touching the synthetic
+        # last line (the E vs E\n case) is out of range in unpadded space
+        # and would silently no-op, leaving an unresolvable zone.
+        orig = _pad(zone.original_code)
+        new = _pad(zone.current_code)
         orig[c.original_start_line - 1:c.original_end_line] = \
             new[c.start_line - 1:c.end_line]
-        zone.original_code = "\n".join(orig)
+        zone.original_code = _unpad(orig)
         self._recompute(zone)
         self._gc(zone)
 
@@ -186,11 +206,11 @@ class DiffZoneService:
         """Revert the diff: splice the original lines back into the file."""
         zone, d = self._zone_diff(zone_id, diffid)
         c = d.computed
-        new = zone.current_code.split("\n")
-        orig = zone.original_code.split("\n")
+        new = _pad(zone.current_code)
+        orig = _pad(zone.original_code)
         new[c.start_line - 1:c.end_line] = \
             orig[c.original_start_line - 1:c.original_end_line]
-        zone.current_code = "\n".join(new)
+        zone.current_code = _unpad(new)
         self._write_zone(zone)
         self._recompute(zone)
         self._gc(zone)
@@ -224,6 +244,7 @@ class DiffZoneService:
                 "original_code": z.original_code,
                 "current_code": z.current_code,
                 "is_streaming": z.is_streaming,
+                "file_span": list(z.file_span) if z.file_span else None,
             } for z in self.zones_of_uri(uri)],
         }
 
@@ -232,12 +253,20 @@ class DiffZoneService:
         for z in self.zones_of_uri(uri):
             del self.zone_of_id[z.diffareaid]
         for entry in snap["zones"]:
+            span = entry.get("file_span")
+            if span is None:
+                # the restored file holds current_code, so the occupied
+                # span follows ITS line count (not original_code's)
+                span = [entry["start_line"],
+                        entry["start_line"]
+                        + entry["current_code"].count("\n")]
             zone = DiffZone(
                 diffareaid=entry["diffareaid"], uri=uri,
                 start_line=entry["start_line"],
                 original_code=entry["original_code"],
                 current_code=entry["current_code"],
-                is_streaming=entry["is_streaming"])
+                is_streaming=entry["is_streaming"],
+                file_span=(span[0], span[1]))
             self.zone_of_id[zone.diffareaid] = zone
             self._next_zone = max(self._next_zone, zone.diffareaid + 1)
             self._recompute(zone)
@@ -280,13 +309,22 @@ class DiffZoneService:
         """Replace the zone's slice of the file with current_code."""
         text = self._read(zone.uri)
         lines = text.split("\n")
-        if zone.file_span is None:
-            orig_len = len(zone.original_code.split("\n")) \
-                if zone.original_code else 0
-            zone.file_span = (zone.start_line,
-                              zone.start_line + orig_len - 1)
+        assert zone.file_span is not None   # set at create/restore time
+        old_start, old_end = zone.file_span
         new_lines = zone.current_code.split("\n")
-        lines[zone.file_span[0] - 1:zone.file_span[1]] = new_lines
-        zone.file_span = (zone.file_span[0],
-                          zone.file_span[0] + len(new_lines) - 1)
+        lines[old_start - 1:old_end] = new_lines
+        zone.file_span = (old_start, old_start + len(new_lines) - 1)
         self.workspace.write_file(zone.uri, "\n".join(lines))
+        # Sibling zones below the edit shift by the line-count delta —
+        # without this, a later zone on the same file splices at stale
+        # coordinates and clobbers unrelated lines (the reference shifts
+        # diffareas on every document change).
+        delta = len(new_lines) - (old_end - old_start + 1)
+        if delta:
+            for other in self.zone_of_id.values():
+                if (other is not zone and other.uri == zone.uri
+                        and other.file_span is not None
+                        and other.file_span[0] > old_end):
+                    other.start_line += delta
+                    other.file_span = (other.file_span[0] + delta,
+                                       other.file_span[1] + delta)

@@ -31,6 +31,10 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     qkv_bias: bool = False
+    # int8 KV cache with per-(position, head) scales: halves cache HBM so
+    # memory-capacity-bound serving (6.7b on one 16 GB chip) fits 2× the
+    # decode batch. See models/transformer.py _quantize_kv.
+    kv_quant: bool = False
     dtype: jnp.dtype = jnp.bfloat16
     # Sliding-window attention width (None = full causal).
     sliding_window: Optional[int] = None

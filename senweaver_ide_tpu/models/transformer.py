@@ -31,20 +31,53 @@ Params = Dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    k: jax.Array  # (L, B, Smax, Hkv, Dh)
+    k: jax.Array  # (L, B, Smax, Hkv, Dh) — bf16, or int8 when quantized
     v: jax.Array  # (L, B, Smax, Hkv, Dh)
     # () int32 — tokens currently in cache; or (B,) int32 for per-slot
     # lengths (continuous batching, rollout/engine.py).
     length: jax.Array
+    # Per-(layer, slot, position, head) dequantization scales, present
+    # only for the int8 cache (absmax/127 over head_dim). Halving cache
+    # bytes is a CAPACITY lever: a 16 GB chip serving deepseek-6.7b
+    # (13.4 GB bf16 weights) fits 2× the decode batch.
+    k_scale: Optional[jax.Array] = None  # (L, B, Smax, Hkv) f32
+    v_scale: Optional[jax.Array] = None
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
 
 
 def init_kv_cache(config: ModelConfig, batch: int, max_len: int,
-                  dtype=None) -> KVCache:
-    dtype = dtype or config.dtype
+                  dtype=None, *, quantized: Optional[bool] = None) -> KVCache:
+    quantized = config.kv_quant if quantized is None else quantized
     shape = (config.num_layers, batch, max_len, config.num_kv_heads,
              config.head_dim)
+    if quantized:
+        sshape = shape[:-1]
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       length=jnp.zeros((), jnp.int32),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
+    dtype = dtype or config.dtype
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    length=jnp.zeros((), jnp.int32))
+
+
+def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(B, S, H, D) → int8 values + (B, S, H) f32 absmax/127 scales."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                   dtype) -> jnp.ndarray:
+    """int8 (B, S, H, D) + (B, S, H) scales → ``dtype`` values."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def init_params(config: ModelConfig, key: jax.Array) -> Params:
@@ -158,7 +191,34 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
     h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
     q, k, v = _qkv(c, lp, h, cos, sin)
 
-    if cache_kv is not None:
+    if cache_kv is not None and len(cache_kv) == 5:
+        # int8 cache: quantize the block's new k/v, scatter values AND
+        # scales, attend over the dequantized cache (transient in compute
+        # dtype; the HBM-resident cache stays int8).
+        k_cache, v_cache, length, k_scale, v_scale = cache_kv
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        if length.ndim == 0:
+            k_cache = jax.lax.dynamic_update_slice(k_cache, kq,
+                                                   (0, length, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(v_cache, vq,
+                                                   (0, length, 0, 0))
+            k_scale = jax.lax.dynamic_update_slice(k_scale, ks,
+                                                   (0, length, 0))
+            v_scale = jax.lax.dynamic_update_slice(v_scale, vs,
+                                                   (0, length, 0))
+        else:
+            slot = jnp.arange(b)[:, None]                      # (B, 1)
+            pos = length[:, None] + jnp.arange(s)[None, :]     # (B, s)
+            k_cache = k_cache.at[slot, pos].set(kq, mode="drop")
+            v_cache = v_cache.at[slot, pos].set(vq, mode="drop")
+            k_scale = k_scale.at[slot, pos].set(ks, mode="drop")
+            v_scale = v_scale.at[slot, pos].set(vs, mode="drop")
+        out = attention(q, _dequantize_kv(k_cache, k_scale, x.dtype),
+                        _dequantize_kv(v_cache, v_scale, x.dtype),
+                        q_offset=length, kv_mask=kv_mask, causal=True)
+        kv_out = (k_cache, v_cache, k_scale, v_scale)
+    elif cache_kv is not None:
         k_cache, v_cache, length = cache_kv
         if length.ndim == 0:
             k_cache = jax.lax.dynamic_update_slice(
@@ -276,17 +336,34 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
         if attn_mask is not None:
             valid = valid & attn_mask
 
-        def body(carry, inputs):
-            x, aux = carry
-            lp, k_cache, v_cache = inputs
-            x, (k_cache, v_cache), layer_aux = _layer(
-                c, lp, x, cos, sin, (k_cache, v_cache, cache.length), valid)
-            return (x, aux + layer_aux), (k_cache, v_cache)
+        if cache.quantized:
+            def body_q(carry, inputs):
+                x, aux = carry
+                lp, k_c, v_c, k_s, v_s = inputs
+                x, kv_out, layer_aux = _layer(
+                    c, lp, x, cos, sin,
+                    (k_c, v_c, cache.length, k_s, v_s), valid)
+                return (x, aux + layer_aux), kv_out
 
-        (x, aux_total), (k_upd, v_upd) = jax.lax.scan(
-            body, (x, jnp.zeros((), jnp.float32)),
-            (params["layers"], cache.k, cache.v), unroll=c.scan_unroll)
-        new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s)
+            (x, aux_total), (k_upd, v_upd, ks_upd, vs_upd) = jax.lax.scan(
+                body_q, (x, jnp.zeros((), jnp.float32)),
+                (params["layers"], cache.k, cache.v, cache.k_scale,
+                 cache.v_scale), unroll=c.scan_unroll)
+            new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s,
+                                k_scale=ks_upd, v_scale=vs_upd)
+        else:
+            def body(carry, inputs):
+                x, aux = carry
+                lp, k_cache, v_cache = inputs
+                x, (k_cache, v_cache), layer_aux = _layer(
+                    c, lp, x, cos, sin, (k_cache, v_cache, cache.length),
+                    valid)
+                return (x, aux + layer_aux), (k_cache, v_cache)
+
+            (x, aux_total), (k_upd, v_upd) = jax.lax.scan(
+                body, (x, jnp.zeros((), jnp.float32)),
+                (params["layers"], cache.k, cache.v), unroll=c.scan_unroll)
+            new_cache = KVCache(k=k_upd, v=v_upd, length=cache.length + s)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")

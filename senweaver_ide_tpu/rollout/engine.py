@@ -58,7 +58,15 @@ def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
         cache.k, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
     sub_v = jax.lax.dynamic_slice(
         cache.v, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
-    sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32))
+    if cache.quantized:          # int8 pool: slice the scales alongside
+        sub_ks = jax.lax.dynamic_slice(
+            cache.k_scale, (0, slot, 0, 0), (L, 1, max_len, hkv))
+        sub_vs = jax.lax.dynamic_slice(
+            cache.v_scale, (0, slot, 0, 0), (L, 1, max_len, hkv))
+        sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32),
+                      k_scale=sub_ks, v_scale=sub_vs)
+    else:
+        sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32))
 
     # Mask padding so it can't be attended during prefill; padded positions
     # are overwritten by subsequent decode steps before they become visible.
@@ -69,9 +77,16 @@ def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
 
     new_k = jax.lax.dynamic_update_slice(cache.k, sub.k, (0, slot, 0, 0, 0))
     new_v = jax.lax.dynamic_update_slice(cache.v, sub.v, (0, slot, 0, 0, 0))
+    new_ks = new_vs = None
+    if cache.quantized:
+        new_ks = jax.lax.dynamic_update_slice(cache.k_scale, sub.k_scale,
+                                              (0, slot, 0, 0))
+        new_vs = jax.lax.dynamic_update_slice(cache.v_scale, sub.v_scale,
+                                              (0, slot, 0, 0))
     new_len = cache.length.at[slot].set(true_len)
     last = logits[0, true_len - 1, :]
-    return last, KVCache(k=new_k, v=new_v, length=new_len)
+    return last, KVCache(k=new_k, v=new_v, length=new_len,
+                         k_scale=new_ks, v_scale=new_vs)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "sample"),
@@ -87,7 +102,9 @@ def _pool_decode_step(params: Params, config: ModelConfig, cur_tok: jax.Array,
                             top_k=sample.top_k, top_p=sample.top_p)
     next_tok = jnp.where(active, next_tok, cur_tok)
     length = jnp.where(active, new_cache.length, cache.length)
-    return next_tok, KVCache(k=new_cache.k, v=new_cache.v, length=length)
+    return next_tok, KVCache(k=new_cache.k, v=new_cache.v, length=length,
+                             k_scale=new_cache.k_scale,
+                             v_scale=new_cache.v_scale)
 
 
 @dataclasses.dataclass
@@ -123,18 +140,31 @@ class RolloutEngine:
         self._key = jax.random.PRNGKey(seed)
         shape = (config.num_layers, num_slots, max_len, config.num_kv_heads,
                  config.head_dim)
-        k0 = jnp.zeros(shape, config.dtype)
-        v0 = jnp.zeros(shape, config.dtype)
+        quantized = config.kv_quant
+        k0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
+        v0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
+        ks0 = vs0 = None
+        if quantized:
+            ks0 = jnp.zeros(shape[:-1], jnp.float32)
+            vs0 = jnp.zeros(shape[:-1], jnp.float32)
         if mesh is not None:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec
             from ..parallel.sharding import KV_CACHE_SPEC, restrict_spec
             cache_sharding = NamedSharding(mesh,
                                            restrict_spec(KV_CACHE_SPEC,
                                                          mesh))
             k0 = jax.device_put(k0, cache_sharding)
             v0 = jax.device_put(v0, cache_sharding)
+            if quantized:
+                # scales lack the head_dim axis; same layout otherwise
+                scale_spec = PartitionSpec(*KV_CACHE_SPEC[:-1])
+                scale_sharding = NamedSharding(
+                    mesh, restrict_spec(scale_spec, mesh))
+                ks0 = jax.device_put(ks0, scale_sharding)
+                vs0 = jax.device_put(vs0, scale_sharding)
         self.cache = KVCache(k=k0, v=v0,
-                             length=jnp.zeros((num_slots,), jnp.int32))
+                             length=jnp.zeros((num_slots,), jnp.int32),
+                             k_scale=ks0, v_scale=vs0)
         self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
         self._queue: Deque[_Request] = deque()

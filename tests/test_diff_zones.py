@@ -166,6 +166,58 @@ def test_streaming_zone_rejects_late_writes(ws, svc):
         svc.write_stream(zid, "z")
 
 
+def test_sibling_zones_shift_when_line_count_changes(ws, svc):
+    """Zone A growing the file must shift zone B's coordinates."""
+    ws.write_file("f.txt", "l1\nl2\nl3\nl4\nl5")
+    za = svc.create_zone("f.txt", start_line=1, end_line=1)
+    zb = svc.create_zone("f.txt", start_line=4, end_line=5)
+    svc.write_stream(za, "A1\nA2\nA3")       # +2 lines above zone B
+    svc.write_stream(zb, "B4\nB5")
+    assert ws.read_text("f.txt") == "A1\nA2\nA3\nl2\nl3\nB4\nB5"
+    svc.finish_stream(za)
+    svc.finish_stream(zb)
+    svc.reject_all(zb)
+    svc.reject_all(za)
+    assert ws.read_text("f.txt") == "l1\nl2\nl3\nl4\nl5"
+
+
+def test_restore_then_reject_is_consistent(ws, svc):
+    ws.write_file("r.txt", "a\nb")
+    zid = svc.create_zone("r.txt")
+    svc.write_stream(zid, "a\nX\nY\nb")
+    svc.finish_stream(zid)
+    snap = svc.snapshot("r.txt")
+    svc.restore("r.txt", snap)
+    (zone,) = svc.zones_of_uri("r.txt")
+    svc.reject_all(zone.diffareaid)
+    assert ws.read_text("r.txt") == "a\nb"
+
+
+def test_zone_over_single_empty_line(ws, svc):
+    """'' must mean exactly one empty line, not a zero-line region."""
+    ws.write_file("e.txt", "a\n\nb")
+    zid = svc.create_zone("e.txt", start_line=2, end_line=2)
+    svc.write_stream(zid, "X")
+    assert ws.read_text("e.txt") == "a\nX\nb"
+    svc.finish_stream(zid)
+    svc.reject_all(zid)
+    assert ws.read_text("e.txt") == "a\n\nb"
+
+
+def test_trailing_newline_diff_accept_and_reject_resolve(ws, svc):
+    """The E vs E\\n diff lives on the padded synthetic last line; both
+    accept and reject must resolve it (not silently no-op)."""
+    for op, expect in (("accept", "E\n"), ("reject", "E")):
+        ws.write_file("t.txt", "E")
+        zid = svc.create_zone("t.txt")
+        svc.write_stream(zid, "E\n")
+        (d,) = svc.finish_stream(zid)
+        assert d.computed.type == "insertion"
+        getattr(svc, f"{op}_diff")(zid, d.diffid)
+        assert zid not in svc.zone_of_id, op      # zone resolved
+        assert ws.read_text("t.txt") == expect, op
+
+
 def test_snapshot_restore_roundtrip(ws, svc):
     ws.write_file("a.txt", "alpha\nbeta")
     zid = svc.create_zone("a.txt")

@@ -189,3 +189,22 @@ def test_engine_tp_sharded_and_weight_sync():
     eng.update_params(new_params)
     r3 = eng.submit(prompt, max_new_tokens=6)
     assert len(eng.run()[r3]) == 6
+
+
+def test_engine_int8_kv_cache_serves(model):
+    """Continuous batching over the int8 slot pool: requests complete,
+    slots recycle, and the pool cache stays int8 throughout."""
+    import dataclasses
+
+    params, config = model
+    qconfig = dataclasses.replace(config, kv_quant=True)
+    eng = RolloutEngine(params, qconfig, num_slots=2, max_len=64,
+                        sample=GREEDY)
+    assert eng.cache.k.dtype == jnp.int8 and eng.cache.quantized
+    rids = [eng.submit([5, 9, 2, 7], max_new_tokens=8) for _ in range(4)]
+    out = eng.run()
+    assert all(len(out[r]) == 8 for r in rids)
+    # greedy + identical prompts → identical outputs across slots
+    assert len({tuple(out[r]) for r in rids}) == 1
+    assert eng.cache.k.dtype == jnp.int8
+    assert eng.cache.k_scale is not None

@@ -1,0 +1,106 @@
+"""int8 KV cache: quantization round-trip, decode parity vs the bf16
+cache, generate() end-to-end, and per-slot scatter writes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import get_config, init_params
+from senweaver_ide_tpu.models.transformer import (KVCache, _dequantize_kv,
+                                                  _quantize_kv, forward,
+                                                  init_kv_cache)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    config = get_config("tiny-test")
+    params = init_params(config, jax.random.PRNGKey(0))
+    return config, params
+
+
+def test_quantize_roundtrip_error_small():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 3, 16),
+                          jnp.float32)
+    q, scale = _quantize_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 5, 3)
+    back = _dequantize_kv(q, scale, jnp.float32)
+    # int8 absmax quantization: ≤ absmax/254 per-element error
+    err = jnp.max(jnp.abs(back - x))
+    bound = jnp.max(jnp.abs(x)) / 254 * 1.01
+    assert float(err) <= float(bound)
+
+
+def test_init_quantized_cache_dtypes(setup):
+    config, _ = setup
+    cache = init_kv_cache(config, 2, 32, quantized=True)
+    assert cache.quantized
+    assert cache.k.dtype == jnp.int8 and cache.v.dtype == jnp.int8
+    assert cache.k_scale.dtype == jnp.float32
+    assert cache.k_scale.shape == cache.k.shape[:-1]
+    assert not init_kv_cache(config, 2, 32).quantized
+
+
+def test_decode_parity_quantized_vs_full(setup):
+    """Prefill + 4 decode steps: logits with the int8 cache track the
+    full-precision cache closely (same top-1 on a tiny model)."""
+    config, params = setup
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 12), 0,
+                                config.vocab_size)
+    # Teacher-forced continuation: BOTH runs must see identical inputs,
+    # or one flipped near-tie makes the sequences (and logits) diverge
+    # for reasons unrelated to cache precision.
+    forced = jax.random.randint(jax.random.PRNGKey(3), (4, 2, 1), 0,
+                                config.vocab_size)
+    caches = {
+        "full": init_kv_cache(config, 2, 20),
+        "int8": init_kv_cache(config, 2, 20, quantized=True),
+    }
+    logits = {}
+    for name, cache in caches.items():
+        lg, cache = forward(params, config, prompt, cache=cache)
+        steps = [lg[:, -1]]
+        for i in range(4):
+            lg, cache = forward(params, config, forced[i], cache=cache)
+            steps.append(lg[:, -1])
+        logits[name] = jnp.stack(steps)
+    a, b = logits["full"], logits["int8"]
+    # Random-init logits are near-uniform, so top-1 equality is noise —
+    # the meaningful parity metrics are elementwise error and direction.
+    rel = jnp.max(jnp.abs(a - b)) / (jnp.max(jnp.abs(a)) + 1e-9)
+    assert float(rel) < 0.05, float(rel)
+    cos = jnp.sum(a * b) / (jnp.linalg.norm(a) * jnp.linalg.norm(b))
+    assert float(cos) > 0.995, float(cos)
+
+
+def test_generate_scan_with_quantized_cache(setup):
+    config, params = setup
+    from senweaver_ide_tpu.rollout.sampler import (SampleParams,
+                                                   generate_scan)
+    prompt = jnp.ones((2, 8), jnp.int32)
+    cache = init_kv_cache(config, 2, 24, quantized=True)
+    toks, out_cache = generate_scan(
+        params, config, prompt, cache, jax.random.PRNGKey(0),
+        max_new_tokens=8, sample=SampleParams(0.8, 0, 0.0))
+    assert toks.shape == (2, 8)
+    assert out_cache.k.dtype == jnp.int8
+    # prefill (8) + 7 decode writes; the final sampled token is returned
+    # but never written back
+    assert int(out_cache.length) == 15
+
+
+def test_per_slot_scatter_writes_scales(setup):
+    """Continuous-batching path: (B,) lengths scatter values + scales at
+    per-slot offsets."""
+    config, params = setup
+    cache = init_kv_cache(config, 3, 16, quantized=True)
+    lengths = jnp.array([0, 4, 9], jnp.int32)
+    cache = KVCache(k=cache.k, v=cache.v, length=lengths,
+                    k_scale=cache.k_scale, v_scale=cache.v_scale)
+    tok = jnp.ones((3, 1), jnp.int32)
+    _lg, new_cache = forward(params, config, tok, cache=cache)
+    scales = np.asarray(new_cache.k_scale)  # (L, B, S, H)
+    for slot, ln in enumerate([0, 4, 9]):
+        assert (scales[:, slot, ln] > 0).all(), f"slot {slot} not written"
+        # untouched positions stay zero
+        assert (scales[:, slot, ln + 1:] == 0).all()
