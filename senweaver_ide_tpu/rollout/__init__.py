@@ -5,3 +5,4 @@ from .policy_client import EnginePolicyClient, render_chat_template
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill)
 from .session import RolloutSession, TurnResult
+from .speculative import SpeculativeDecoder

@@ -1,0 +1,198 @@
+"""Speculative decoding: draft-model proposals, one-forward verification.
+
+A TPU-serving feature beyond the reference's remote-API path (which
+streams one token per round trip): a small draft model proposes ``k``
+tokens autoregressively, the big target model scores all of them in a
+SINGLE forward, and the standard rejection rule (Leviathan et al. 2023)
+keeps the longest valid prefix — so the target's cost per emitted token
+drops toward 1/k of a per-token loop while the output distribution is
+exactly the target's. On this repo's dispatch-bound serving path (each
+host→TPU step costs fixed overhead; see bench.py _measure_steps) the
+verify-k-at-once shape is also what amortizes dispatches.
+
+Greedy (temperature 0) acceptance is ``proposal == target argmax``,
+which makes the output IDENTICAL to vanilla greedy decoding of the
+target — the property the tests pin. (Identical up to numerics: a
+(1, k) verify forward and a (1, 1) decode step may tile matmuls
+differently, so a last-ulp difference can flip a near-tie argmax on
+low-precision configs. The tests pin it on the fp32
+matmul_precision="highest" test config, where the shapes agree
+bitwise.) Stochastic sampling uses the exact
+accept-with-prob(min(1, p/q)) rule with residual resampling on
+rejection, which preserves the target distribution.
+
+Cache bookkeeping: both models keep a "pending" token (emitted but not
+yet written to cache). Each round feeds ``[pending, d_1..d_{k-1}]`` so
+position i's logits are the target distribution FOR proposal d_{i+1};
+on acceptance of m ≤ k proposals both caches truncate to the valid
+prefix by resetting ``length`` (stale positions beyond ``length`` are
+never attended — models/transformer.py kv validity mask).
+
+Single-sequence (B=1): per-sequence acceptance lengths make batched
+caches ragged; latency-oriented speculation is the B=1 regime.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.config import ModelConfig
+from ..models.transformer import KVCache, Params, forward, init_kv_cache
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def _verify_forward(params: Params, config: ModelConfig, tokens: jax.Array,
+                    cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """Feed (1, k) tokens; return fp32 logits (k, V) + updated cache."""
+    logits, cache = forward(params, config, tokens, cache=cache)
+    return logits[0], cache
+
+
+def _truncate(cache: KVCache, length: int) -> KVCache:
+    """Roll the cache back to ``length`` valid tokens (pure metadata —
+    stale entries past length are masked out of attention)."""
+    return cache._replace(length=jnp.asarray(length, jnp.int32))
+
+
+def _softmax(logits: np.ndarray, temperature: float) -> np.ndarray:
+    x = logits.astype(np.float64) / max(temperature, 1e-6)
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+class SpeculativeDecoder:
+    """Draft/target pair with independent KV caches."""
+
+    def __init__(self, target_params: Params, target_config: ModelConfig,
+                 draft_params: Params, draft_config: ModelConfig, *,
+                 k: int = 4):
+        if target_config.vocab_size != draft_config.vocab_size:
+            raise ValueError(
+                "draft and target must share a vocabulary "
+                f"({draft_config.vocab_size} vs {target_config.vocab_size})")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.tp, self.tc = target_params, target_config
+        self.dp, self.dc = draft_params, draft_config
+        self.k = k
+        self.rounds = 0          # verify forwards issued (observability)
+        self.accepted = 0        # proposals accepted across rounds
+        self.proposed = 0
+
+    def generate(self, prompt: List[int], *, max_new_tokens: int,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 key: Optional[jax.Array] = None,
+                 max_len: Optional[int] = None) -> List[int]:
+        """Decode ``max_new_tokens`` tokens (stops early at ``eos_id``)."""
+        k = self.k
+        seed = int(jax.random.randint(key, (), 0, 2**31 - 1)) \
+            if key is not None else 0
+        rng = np.random.default_rng(seed)
+        n_prompt = len(prompt)
+        max_len = max_len or n_prompt + max_new_tokens + k + 1
+        t_cache = init_kv_cache(self.tc, 1, max_len)
+        d_cache = init_kv_cache(self.dc, 1, max_len)
+        toks = jnp.asarray([prompt], jnp.int32)
+
+        # sampler.prefill slices the last-token logits INSIDE the jit —
+        # verify-shaped prefill would materialize (n_prompt, V) fp32 per
+        # model only to discard all but one row.
+        from .sampler import prefill
+        t_last, t_cache = prefill(self.tp, self.tc, toks, t_cache)
+        _d_last, d_cache = prefill(self.dp, self.dc, toks, d_cache)
+        # pending = emitted-but-uncached; its target dist is in hand
+        pending = self._pick(np.asarray(t_last[0]), temperature, rng)
+        out = [pending]
+        n_cached = n_prompt
+
+        while len(out) < max_new_tokens and \
+                (eos_id is None or out[-1] != eos_id):
+            # -- draft k proposals (q-dists for each) ----------------------
+            # Feed pending, then each sampled proposal; the k-th proposal
+            # is sampled from the final dist but never fed, keeping draft
+            # and target caches in lockstep at [pending, d_1..d_{k-1}].
+            q_logits: List[np.ndarray] = []
+            proposals: List[int] = []
+            tok = pending
+            for _ in range(k):
+                dl, d_cache = _verify_forward(
+                    self.dp, self.dc, jnp.asarray([[tok]], jnp.int32),
+                    d_cache)
+                q_logits.append(np.asarray(dl[-1]))
+                tok = self._pick(q_logits[-1], temperature, rng)
+                proposals.append(tok)
+
+            # -- verify in ONE target forward ------------------------------
+            verify_in = jnp.asarray([[pending] + proposals[:-1]], jnp.int32)
+            p_logits, t_cache = _verify_forward(self.tp, self.tc,
+                                                verify_in, t_cache)
+            p_logits = np.asarray(p_logits)      # (k, V): row i ↔ prop i
+            self.rounds += 1
+            self.proposed += k
+
+            # -- acceptance --------------------------------------------------
+            m = 0
+            correction: Optional[int] = None
+            for i, d_i in enumerate(proposals):
+                if temperature <= 0.0:
+                    ok = int(np.argmax(p_logits[i])) == d_i
+                else:
+                    p = _softmax(p_logits[i], temperature)
+                    q = _softmax(q_logits[i], temperature)
+                    ok = rng.random() < min(1.0, p[d_i] / max(q[d_i], 1e-12))
+                if not ok:
+                    if temperature <= 0.0:
+                        correction = int(np.argmax(p_logits[i]))
+                    else:
+                        residual = np.maximum(p - q, 0.0)
+                        total = residual.sum()
+                        if total <= 0:
+                            correction = int(rng.choice(len(p), p=p))
+                        else:
+                            correction = int(rng.choice(
+                                len(residual), p=residual / total))
+                    break
+                m += 1
+            self.accepted += m
+
+            if m == k:
+                emitted = proposals
+                new_pending = proposals[-1]
+                # caches hold pending + proposals[:-1] = 1 + (k-1) tokens
+                n_cached += k
+            else:
+                emitted = proposals[:m] + [correction]
+                new_pending = correction
+                n_cached += 1 + m            # pending + accepted prefix
+                t_cache = _truncate(t_cache, n_cached)
+                d_cache = _truncate(d_cache, n_cached)
+
+            for tok in emitted:
+                out.append(int(tok))
+                if eos_id is not None and tok == eos_id:
+                    break
+                if len(out) >= max_new_tokens:
+                    break
+            pending = new_pending
+
+        return out[:max_new_tokens]
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    @staticmethod
+    def _pick(logits: np.ndarray, temperature: float,
+              rng: np.random.Generator) -> int:
+        if temperature <= 0.0:
+            return int(np.argmax(logits))
+        p = _softmax(logits, temperature)
+        return int(rng.choice(len(p), p=p))

@@ -1,0 +1,108 @@
+"""Speculative decoding: greedy equivalence with vanilla target decoding
+(the correctness property), full-acceptance upper bound, eos, stats."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout.sampler import SampleParams, generate
+from senweaver_ide_tpu.rollout.speculative import SpeculativeDecoder
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(scope="module")
+def models():
+    target_cfg = tiny_test()
+    # a genuinely different (smaller) draft: fewer layers, same vocab
+    draft_cfg = dataclasses.replace(target_cfg, num_layers=2,
+                                    name="tiny-draft")
+    target = init_params(target_cfg, jax.random.PRNGKey(0))
+    draft = init_params(draft_cfg, jax.random.PRNGKey(7))
+    return target, target_cfg, draft, draft_cfg
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_greedy_output_equals_vanilla_target(models, k):
+    """Whatever the draft proposes, greedy speculative output must be
+    EXACTLY the target's own greedy continuation."""
+    target, tc, draft, dc = models
+    prompt = [5, 9, 2, 7, 1, 3]
+    n = 12
+    ref = generate(target, tc, jnp.asarray([prompt], jnp.int32),
+                   max_new_tokens=n, sample=GREEDY, max_len=64)
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=k)
+    out = dec.generate(prompt, max_new_tokens=n, max_len=64)
+    assert out == np.asarray(ref[0]).tolist(), f"k={k}"
+    assert dec.rounds >= 1 and dec.proposed == dec.rounds * k
+
+
+def test_self_draft_accepts_everything(models):
+    """draft == target → greedy proposals always match → every round
+    accepts all k, so verify-forward count ≈ tokens/k."""
+    target, tc, _, _ = models
+    n, k = 16, 4
+    dec = SpeculativeDecoder(target, tc, target, tc, k=k)
+    ref = generate(target, tc,
+                   jnp.asarray([[5, 9, 2, 7]], jnp.int32),
+                   max_new_tokens=n, sample=GREEDY, max_len=64)
+    out = dec.generate([5, 9, 2, 7], max_new_tokens=n, max_len=64)
+    assert out == np.asarray(ref[0]).tolist()
+    assert dec.acceptance_rate == 1.0
+    # n-1 tokens come from rounds of k each (the first comes from prefill)
+    assert dec.rounds <= -(-(n - 1) // k) + 1
+
+
+def test_eos_stops_early(models):
+    target, tc, draft, dc = models
+    prompt = [5, 9, 2, 7]
+    ref = np.asarray(generate(target, tc, jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=24, sample=GREEDY,
+                              max_len=64)[0]).tolist()
+    eos = ref[5]                    # force an eos mid-stream
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=3)
+    out = dec.generate(prompt, max_new_tokens=24, eos_id=eos, max_len=64)
+    assert out == ref[:6]           # stops right after emitting eos
+    assert out[-1] == eos
+
+
+def test_stochastic_runs_and_self_draft_accepts(models):
+    target, tc, _, _ = models
+    dec = SpeculativeDecoder(target, tc, target, tc, k=3)
+    out = dec.generate([1, 2, 3], max_new_tokens=10, temperature=0.8,
+                       key=jax.random.PRNGKey(3), max_len=64)
+    assert len(out) == 10
+    # identical models → p == q → min(1, p/q) = 1 → all accepted
+    assert dec.acceptance_rate == 1.0
+
+
+def test_stochastic_rejection_path_with_distinct_draft(models):
+    """Distinct random draft vs target → p != q, so the rejection branch
+    (residual resampling) genuinely fires."""
+    target, tc, draft, dc = models
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=3)
+    out = dec.generate([4, 8, 6], max_new_tokens=24, temperature=1.0,
+                       key=jax.random.PRNGKey(11), max_len=96)
+    assert len(out) == 24
+    assert all(0 <= t < tc.vocab_size for t in out)
+    # two unrelated random models at temperature 1.0 disagree often
+    assert 0.0 < dec.acceptance_rate < 1.0, dec.acceptance_rate
+    assert dec.proposed == dec.rounds * 3
+
+
+def test_k_validation():
+    cfg = tiny_test()
+    p = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="k must be"):
+        SpeculativeDecoder(p, cfg, p, cfg, k=0)
+
+
+def test_vocab_mismatch_rejected(models):
+    target, tc, draft, dc = models
+    bad = dataclasses.replace(dc, vocab_size=dc.vocab_size + 1)
+    with pytest.raises(ValueError, match="vocabulary"):
+        SpeculativeDecoder(target, tc, draft, bad)
