@@ -48,9 +48,16 @@ class ModelConfig:
     #               with an sp axis and S divisible by its size.
     #   "ulysses" — Ulysses all-to-all head/sequence swap over 'sp'; head
     #               counts must divide by the sp axis size.
-    # The KV-cache (decode) path is unaffected — it has its own fused
-    # decode kernel selection (rollout plane).
+    # The KV-cache (decode) path has its own selection below.
     attn_impl: str = "einsum"
+    # Attention implementation for the KV-cache single-token decode path:
+    #   "einsum" — ops/attention.py over the whole cache (materializes
+    #              the (B, Hkv, rep, 1, Smax) fp32 scores per step).
+    #   "flash"  — ops/flash_decode.py: streamed KV blocks with online
+    #              softmax and per-slot length skipping; interpret-mode
+    #              on non-TPU backends. Applies only when s == 1 and no
+    #              extra attention mask is in play (prefill keeps einsum).
+    decode_attn_impl: str = "einsum"
     # lax.scan unroll factor for the layer loop. Decode steps are tiny
     # programs; TPU loop overhead per scan iteration is material at
     # sq=1, and unrolling trades compile time for it. 1 = no unroll.

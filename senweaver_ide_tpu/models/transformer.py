@@ -173,10 +173,24 @@ def _self_attention(c: ModelConfig, q, k, v, kv_mask, mesh):
                      f"einsum|flash|ring|ulysses")
 
 
+def _cache_attention(c: ModelConfig, q, k_full, v_full, length, kv_mask,
+                     flash_decode_ok: bool):
+    """Cache-path attention dispatch: einsum over the whole cache, or the
+    streamed flash-decode kernel when the step shape allows it."""
+    if flash_decode_ok:
+        from ..ops.flash_decode import flash_decode
+        smax = k_full.shape[1]
+        blk = 128 if smax % 128 == 0 else smax
+        # post-write valid count: the current token's k/v is in the cache
+        return flash_decode(q, k_full, v_full, length + 1, block_kv=blk)
+    return attention(q, k_full, v_full, q_offset=length, kv_mask=kv_mask,
+                     causal=True)
+
+
 def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
            cos: jax.Array, sin: jax.Array,
            cache_kv: Optional[Tuple[jax.Array, jax.Array, jax.Array]],
-           kv_mask, mesh=None):
+           kv_mask, mesh=None, flash_decode_ok: bool = False):
     """One transformer block. x: (B, S, D).
 
     Without cache_kv: full self-attention over the block's own k/v, via the
@@ -214,9 +228,10 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
             v_cache = v_cache.at[slot, pos].set(vq, mode="drop")
             k_scale = k_scale.at[slot, pos].set(ks, mode="drop")
             v_scale = v_scale.at[slot, pos].set(vs, mode="drop")
-        out = attention(q, _dequantize_kv(k_cache, k_scale, x.dtype),
-                        _dequantize_kv(v_cache, v_scale, x.dtype),
-                        q_offset=length, kv_mask=kv_mask, causal=True)
+        out = _cache_attention(c, q,
+                               _dequantize_kv(k_cache, k_scale, x.dtype),
+                               _dequantize_kv(v_cache, v_scale, x.dtype),
+                               length, kv_mask, flash_decode_ok)
         kv_out = (k_cache, v_cache, k_scale, v_scale)
     elif cache_kv is not None:
         k_cache, v_cache, length = cache_kv
@@ -234,8 +249,8 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                                                 mode="drop")
             v_cache = v_cache.at[slot, pos].set(v.astype(v_cache.dtype),
                                                 mode="drop")
-        out = attention(q, k_cache, v_cache, q_offset=length, kv_mask=kv_mask,
-                        causal=True)
+        out = _cache_attention(c, q, k_cache, v_cache, length, kv_mask,
+                               flash_decode_ok)
         kv_out = (k_cache, v_cache)
     else:
         out = _self_attention(c, q, k, v, kv_mask, mesh)
@@ -335,6 +350,11 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
         valid = jnp.broadcast_to(kv_pos < bound, (b, max_len))
         if attn_mask is not None:
             valid = valid & attn_mask
+        # Flash-decode applies only when the validity mask is exactly
+        # "pos < length + 1" (single new token, no extra mask) and the
+        # cache is tileable ((8,128) sublane constraint on the kv block).
+        flash_ok = (c.decode_attn_impl == "flash" and s == 1
+                    and attn_mask is None and max_len % 8 == 0)
 
         if cache.quantized:
             def body_q(carry, inputs):
@@ -342,7 +362,8 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
                 lp, k_c, v_c, k_s, v_s = inputs
                 x, kv_out, layer_aux = _layer(
                     c, lp, x, cos, sin,
-                    (k_c, v_c, cache.length, k_s, v_s), valid)
+                    (k_c, v_c, cache.length, k_s, v_s), valid,
+                    flash_decode_ok=flash_ok)
                 return (x, aux + layer_aux), kv_out
 
             (x, aux_total), (k_upd, v_upd, ks_upd, vs_upd) = jax.lax.scan(
@@ -357,7 +378,7 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
                 lp, k_cache, v_cache = inputs
                 x, (k_cache, v_cache), layer_aux = _layer(
                     c, lp, x, cos, sin, (k_cache, v_cache, cache.length),
-                    valid)
+                    valid, flash_decode_ok=flash_ok)
                 return (x, aux + layer_aux), (k_cache, v_cache)
 
             (x, aux_total), (k_upd, v_upd) = jax.lax.scan(

@@ -97,7 +97,12 @@ class SpeculativeDecoder:
             if key is not None else 0
         rng = np.random.default_rng(seed)
         n_prompt = len(prompt)
-        max_len = max_len or n_prompt + max_new_tokens + k + 1
+        # Each verify round writes up to k tokens past the accepted prefix
+        # before truncation; a cache sized for vanilla decoding clamps
+        # those writes onto VALID positions (silent corruption, not an
+        # error) — so enforce the speculative headroom on top of any
+        # caller-supplied max_len.
+        max_len = max(max_len or 0, n_prompt + max_new_tokens + k + 1)
         t_cache = init_kv_cache(self.tc, 1, max_len)
         d_cache = init_kv_cache(self.dc, 1, max_len)
         toks = jnp.asarray([prompt], jnp.int32)
@@ -109,16 +114,23 @@ class SpeculativeDecoder:
         t_last, t_cache = prefill(self.tp, self.tc, toks, t_cache)
         _d_last, d_cache = prefill(self.dp, self.dc, toks, d_cache)
         # pending = emitted-but-uncached; its target dist is in hand
-        pending = self._pick(np.asarray(t_last[0]), temperature, rng)
+        pending = int(jnp.argmax(t_last[0])) if temperature <= 0.0 \
+            else self._pick(np.asarray(t_last[0]), temperature, rng)
         out = [pending]
         n_cached = n_prompt
 
         while len(out) < max_new_tokens and \
                 (eos_id is None or out[-1] != eos_id):
-            # -- draft k proposals (q-dists for each) ----------------------
+            greedy = temperature <= 0.0
+            # -- draft k proposals ----------------------------------------
             # Feed pending, then each sampled proposal; the k-th proposal
             # is sampled from the final dist but never fed, keeping draft
             # and target caches in lockstep at [pending, d_1..d_{k-1}].
+            # Greedy mode argmaxes ON DEVICE and transfers one int per
+            # step; a full fp32 (V,) row per step would move ~600 kB per
+            # proposal at a 152k vocab, rivaling the dispatch overhead
+            # speculation exists to amortize. Stochastic mode still needs
+            # the q-rows host-side for the accept/residual math.
             q_logits: List[np.ndarray] = []
             proposals: List[int] = []
             tok = pending
@@ -126,32 +138,37 @@ class SpeculativeDecoder:
                 dl, d_cache = _verify_forward(
                     self.dp, self.dc, jnp.asarray([[tok]], jnp.int32),
                     d_cache)
-                q_logits.append(np.asarray(dl[-1]))
-                tok = self._pick(q_logits[-1], temperature, rng)
+                if greedy:
+                    tok = int(jnp.argmax(dl[-1]))
+                else:
+                    q_logits.append(np.asarray(dl[-1]))
+                    tok = self._pick(q_logits[-1], temperature, rng)
                 proposals.append(tok)
 
             # -- verify in ONE target forward ------------------------------
             verify_in = jnp.asarray([[pending] + proposals[:-1]], jnp.int32)
-            p_logits, t_cache = _verify_forward(self.tp, self.tc,
-                                                verify_in, t_cache)
-            p_logits = np.asarray(p_logits)      # (k, V): row i ↔ prop i
+            p_dev, t_cache = _verify_forward(self.tp, self.tc,
+                                             verify_in, t_cache)
             self.rounds += 1
             self.proposed += k
 
             # -- acceptance --------------------------------------------------
             m = 0
             correction: Optional[int] = None
-            for i, d_i in enumerate(proposals):
-                if temperature <= 0.0:
-                    ok = int(np.argmax(p_logits[i])) == d_i
-                else:
+            if greedy:
+                t_arg = np.asarray(jnp.argmax(p_dev, axis=-1))  # (k,) ints
+                for i, d_i in enumerate(proposals):
+                    if int(t_arg[i]) != d_i:
+                        correction = int(t_arg[i])
+                        break
+                    m += 1
+            else:
+                p_logits = np.asarray(p_dev)     # (k, V): row i maps prop i
+                for i, d_i in enumerate(proposals):
                     p = _softmax(p_logits[i], temperature)
                     q = _softmax(q_logits[i], temperature)
-                    ok = rng.random() < min(1.0, p[d_i] / max(q[d_i], 1e-12))
-                if not ok:
-                    if temperature <= 0.0:
-                        correction = int(np.argmax(p_logits[i]))
-                    else:
+                    if rng.random() >= min(1.0,
+                                           p[d_i] / max(q[d_i], 1e-12)):
                         residual = np.maximum(p - q, 0.0)
                         total = residual.sum()
                         if total <= 0:
@@ -159,8 +176,8 @@ class SpeculativeDecoder:
                         else:
                             correction = int(rng.choice(
                                 len(residual), p=residual / total))
-                    break
-                m += 1
+                        break
+                    m += 1
             self.accepted += m
 
             if m == k:

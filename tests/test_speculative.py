@@ -94,6 +94,23 @@ def test_stochastic_rejection_path_with_distinct_draft(models):
     assert dec.proposed == dec.rounds * 3
 
 
+def test_tight_max_len_still_correct(models):
+    """A max_len sized for VANILLA decoding (prompt + n) must not corrupt
+    speculative output — the verify round writes up to k tokens past the
+    accepted prefix, and a clamped cache write would silently land on
+    valid positions with wrong RoPE phases (reviewer repro)."""
+    target, tc, draft, dc = models
+    prompt = [5, 9, 2, 7, 1, 3]
+    n = 12
+    ref = np.asarray(generate(target, tc, jnp.asarray([prompt], jnp.int32),
+                              max_new_tokens=n, sample=GREEDY,
+                              max_len=len(prompt) + n)[0]).tolist()
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=4)
+    out = dec.generate(prompt, max_new_tokens=n,
+                       max_len=len(prompt) + n)     # tight: no headroom
+    assert out == ref
+
+
 def test_k_validation():
     cfg = tiny_test()
     p = init_params(cfg, jax.random.PRNGKey(0))
