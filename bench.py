@@ -199,15 +199,40 @@ def main() -> None:
     }))
 
 
+def _error_line(msg: str) -> None:
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0.0,
+        "error": msg,
+    }), flush=True)
+
+
 if __name__ == "__main__":
+    # Watchdog: a wedged accelerator backend HANGS compiles rather than
+    # raising (observed on the axon tunnel), which would leave the driver
+    # without its JSON line. A daemon Timer (not SIGALRM: a Python signal
+    # handler can't run while the main thread is blocked inside a C++
+    # compile call) emits the error line and hard-exits.
+    import os
+    import threading
+
+    def _on_timeout():
+        _error_line("bench watchdog expired: accelerator backend hung "
+                    "(compile/execute never returned)")
+        os._exit(0)
+
+    watchdog = threading.Timer(
+        float(os.environ.get("BENCH_TIMEOUT_S", "1500")), _on_timeout)
+    watchdog.daemon = True
+    watchdog.start()
     try:
         main()
     except Exception as e:  # never leave the driver without a JSON line
-        print(json.dumps({
-            "metric": "decode_tokens_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "tokens/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}",
-        }))
-        sys.exit(0)
+        _error_line(f"{type(e).__name__}: {e}")
+    finally:
+        # A late firing after the success line would append a second,
+        # contradictory JSON line.
+        watchdog.cancel()
+    sys.exit(0)

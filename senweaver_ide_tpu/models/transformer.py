@@ -352,9 +352,15 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
             valid = valid & attn_mask
         # Flash-decode applies only when the validity mask is exactly
         # "pos < length + 1" (single new token, no extra mask) and the
-        # cache is tileable ((8,128) sublane constraint on the kv block).
+        # cache splits into proper KV blocks: either 128-aligned (the
+        # streamed multi-block grid) or small enough that one whole-cache
+        # block still fits VMEM comfortably. An unaligned LARGE cache
+        # would degenerate to block_kv = max_len — no per-slot skipping
+        # and a VMEM-busting block — so it falls back to einsum instead.
+        tileable = (max_len % 128 == 0
+                    or (max_len % 8 == 0 and max_len <= 512))
         flash_ok = (c.decode_attn_impl == "flash" and s == 1
-                    and attn_mask is None and max_len % 8 == 0)
+                    and attn_mask is None and tileable)
 
         if cache.quantized:
             def body_q(carry, inputs):
