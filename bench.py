@@ -223,8 +223,16 @@ if __name__ == "__main__":
                     "(compile/execute never returned)")
         os._exit(0)
 
-    watchdog = threading.Timer(
-        float(os.environ.get("BENCH_TIMEOUT_S", "1500")), _on_timeout)
+    try:
+        timeout_s = float(os.environ.get("BENCH_TIMEOUT_S", "1500"))
+    except ValueError:  # malformed env must not kill the JSON contract
+        timeout_s = 1500.0
+    if not (0.0 < timeout_s <= threading.TIMEOUT_MAX):
+        # 'inf'/1e30 silently kills the Timer thread (OverflowError at
+        # start); a negative value fires immediately. Both disarm the
+        # watchdog this block exists to guarantee.
+        timeout_s = 1500.0
+    watchdog = threading.Timer(timeout_s, _on_timeout)
     watchdog.daemon = True
     watchdog.start()
     try:

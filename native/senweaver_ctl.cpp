@@ -1,9 +1,9 @@
 // senweaver-ctl — native job-control CLI for the trainer runtime.
 //
 // Role: the reference ships a 17.5k-LoC Rust `code-cli` (cli/src/) doing
-// tunnels/auth/json_rpc/msgpack_rpc/singleton against its server. Rust is
-// not in this image (SURVEY.md §2.6), so this is the C++ equivalent
-// scoped to the trainer, speaking to the Python control server
+// tunnels/auth/json_rpc/msgpack_rpc/singleton/self_update against its
+// server. Rust is not in this image (SURVEY.md §2.6), so this is the C++
+// equivalent scoped to the trainer, speaking to the Python control server
 // (senweaver_ide_tpu/runtime/control.py) over a unix domain socket:
 //
 //   - JSON-RPC 2.0 (default) and msgpack-RPC (--msgpack) framings
@@ -13,19 +13,32 @@
 //   - singleton lock via --singleton-lock PATH (flock; exit 3 when
 //     another instance holds it — cli/src/singleton.rs role)
 //   - watch: poll status until no job is queued/running
+//   - tunnel: expose the unix-socket control plane on a loopback TCP
+//     port (cli/src/tunnels.rs role, re-scoped: the reference tunnels
+//     an IDE server to the vscode.dev relay; the trainer equivalent
+//     forwards the coordinator's control socket so a remote operator —
+//     e.g. over an SSH -L hop — can drive jobs)
+//   - self-update: SHA-256-verified atomic in-place binary replacement
+//     (cli/src/self_update.rs role, without the download half — the
+//     candidate binary arrives by whatever channel ships checkpoints)
 //
 // Usage:
-//   senweaver-ctl [opts] ping|status|watch
+//   senweaver-ctl [opts] ping|status|watch|version
 //   senweaver-ctl [opts] submit '<params-json>'
 //   senweaver-ctl [opts] stop <job_id>
 //   senweaver-ctl [opts] call <method> ['<params-json>']
+//   senweaver-ctl [opts] tunnel <tcp-port>
+//   senweaver-ctl [opts] self-update <new-binary>
 //   opts: --socket PATH --token-file PATH --msgpack
 //         --singleton-lock PATH --interval SECONDS
+//         --accept-count N (tunnel: exit after N connections; 0 = forever)
+//         --sha256 HEX --target PATH (self-update)
 //
 // Prints the JSON-RPC response (msgpack responses are re-rendered as
 // JSON) to stdout; exit 0 on "result", 2 on "error", 1 on transport
 // failure, 3 when the singleton lock is held elsewhere.
 
+#include <cctype>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -34,15 +47,22 @@
 #include <string>
 #include <vector>
 
+#include <arpa/inet.h>
 #include <fcntl.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <signal.h>
 #include <sys/file.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/un.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 namespace {
 
 const char* kDefaultSocket = "/tmp/senweaver-ctl.sock";
+const char* kVersion = "senweaver-ctl 2.1.0";
 
 std::string json_escape(const std::string& s) {
   std::string out;
@@ -293,6 +313,307 @@ std::string build_request(bool msgpack, const std::string& method,
   return out;
 }
 
+// ---- SHA-256 (FIPS 180-4; compact table-driven implementation) ----
+
+struct Sha256 {
+  uint32_t h[8] = {0x6a09e667u, 0xbb67ae85u, 0x3c6ef372u, 0xa54ff53au,
+                   0x510e527fu, 0x9b05688cu, 0x1f83d9abu, 0x5be0cd19u};
+  unsigned char block[64];
+  size_t fill = 0;
+  uint64_t total = 0;
+
+  static uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+  }
+
+  void compress(const unsigned char* p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t)p[4 * i] << 24 | (uint32_t)p[4 * i + 1] << 16 |
+             (uint32_t)p[4 * i + 2] << 8 | p[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^
+                    (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^
+                    (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t s1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + K[i] + w[i];
+      uint32_t s0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const unsigned char* p, size_t n) {
+    total += n;
+    while (n > 0) {
+      size_t take = 64 - fill < n ? 64 - fill : n;
+      std::memcpy(block + fill, p, take);
+      fill += take;
+      p += take;
+      n -= take;
+      if (fill == 64) {
+        compress(block);
+        fill = 0;
+      }
+    }
+  }
+
+  std::string hexdigest() {
+    uint64_t bits = total * 8;
+    unsigned char pad = 0x80;
+    update(&pad, 1);
+    unsigned char zero = 0;
+    while (fill != 56) update(&zero, 1);
+    unsigned char len[8];
+    for (int i = 0; i < 8; i++) len[i] = (unsigned char)(bits >> (56 - 8 * i));
+    update(len, 8);
+    char out[65];
+    for (int i = 0; i < 8; i++)
+      std::snprintf(out + 8 * i, 9, "%08x", h[i]);
+    return std::string(out, 64);
+  }
+};
+
+// ---- tunnel: loopback TCP port → unix-socket control plane ----
+
+// Bidirectional byte relay with half-close propagation: the control
+// protocol frames a request by shutdown(SHUT_WR), so EOF on one side
+// must become SHUT_WR on the other (not a full close) or the server
+// never sees end-of-request / the client never gets the response tail.
+void relay(int a, int b) {
+  bool a_open = true, b_open = true;
+  char buf[1 << 16];
+  while (a_open || b_open) {
+    // Closed sides get fd=-1: poll() ignores negative fds, whereas
+    // events=0 would still report POLLHUP and busy-spin the loop while
+    // the other direction drains.
+    pollfd fds[2] = {{a_open ? a : -1, POLLIN, 0},
+                     {b_open ? b : -1, POLLIN, 0}};
+    if (::poll(fds, 2, -1) < 0) {
+      if (errno == EINTR) continue;
+      return;
+    }
+    for (int i = 0; i < 2; i++) {
+      if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      int src = i == 0 ? a : b, dst = i == 0 ? b : a;
+      ssize_t n = ::read(src, buf, sizeof buf);
+      if (n <= 0) {
+        ::shutdown(dst, SHUT_WR);
+        (i == 0 ? a_open : b_open) = false;
+        continue;
+      }
+      ssize_t off = 0;
+      while (off < n) {
+        ssize_t w = ::write(dst, buf + off, n - off);
+        if (w <= 0) return;
+        off += w;
+      }
+    }
+  }
+}
+
+int run_tunnel(const char* socket_path, int port, long accept_count) {
+  ::signal(SIGCHLD, SIG_IGN);  // auto-reap per-connection children
+  ::signal(SIGPIPE, SIG_IGN);
+  int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) {
+    std::perror("socket");
+    return 1;
+  }
+  int one = 1;
+  ::setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);  // loopback ONLY: the
+  addr.sin_port = htons((uint16_t)port);          // control plane is not
+  if (::bind(lfd, (sockaddr*)&addr, sizeof addr) != 0) {  // for the LAN
+    std::perror("bind");
+    ::close(lfd);
+    return 1;
+  }
+  if (::listen(lfd, 16) != 0) {
+    std::perror("listen");
+    ::close(lfd);
+    return 1;
+  }
+  if (port == 0) {  // kernel-assigned: report it for the caller
+    socklen_t alen = sizeof addr;
+    ::getsockname(lfd, (sockaddr*)&addr, &alen);
+    port = ntohs(addr.sin_port);
+  }
+  std::printf("tunnel listening on 127.0.0.1:%d -> %s\n", port, socket_path);
+  std::fflush(stdout);
+
+  for (long n = 0; accept_count == 0 || n < accept_count; n++) {
+    int cfd = ::accept(lfd, nullptr, nullptr);
+    if (cfd < 0) {
+      // Transient accept errnos must not tear down a long-lived tunnel:
+      // ECONNABORTED/EPROTO = the client reset before accept completed;
+      // EMFILE/ENFILE = fd-limit burst, retry after the in-flight
+      // children release theirs.
+      if (errno == EINTR || errno == ECONNABORTED || errno == EPROTO) {
+        n--;
+        continue;
+      }
+      if (errno == EMFILE || errno == ENFILE) {
+        std::fprintf(stderr, "tunnel: accept: %s; retrying\n",
+                     std::strerror(errno));
+        ::sleep(1);
+        n--;
+        continue;
+      }
+      std::perror("accept");
+      ::close(lfd);
+      return 1;
+    }
+    int ufd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+    sockaddr_un uaddr{};
+    uaddr.sun_family = AF_UNIX;
+    std::strncpy(uaddr.sun_path, socket_path, sizeof(uaddr.sun_path) - 1);
+    if (ufd < 0 ||
+        ::connect(ufd, (sockaddr*)&uaddr, sizeof uaddr) != 0) {
+      std::fprintf(stderr, "tunnel: connect %s: %s\n", socket_path,
+                   std::strerror(errno));
+      if (ufd >= 0) ::close(ufd);
+      ::close(cfd);
+      continue;  // server may come back; keep the listener alive
+    }
+    pid_t pid = ::fork();
+    if (pid == 0) {
+      ::close(lfd);
+      relay(cfd, ufd);
+      ::close(cfd);
+      ::close(ufd);
+      ::_exit(0);
+    }
+    if (pid < 0) {
+      // Out of processes: serve this connection inline rather than
+      // silently dropping it (blocks the accept loop for its duration).
+      std::fprintf(stderr, "tunnel: fork: %s; relaying inline\n",
+                   std::strerror(errno));
+      relay(cfd, ufd);
+    }
+    ::close(cfd);
+    ::close(ufd);
+  }
+  ::close(lfd);
+  return 0;
+}
+
+// ---- self-update: verified atomic binary replacement ----
+
+int run_self_update(const char* new_binary, const char* sha256_hex,
+                    const char* target_override) {
+  std::string target;
+  if (target_override) {
+    target = target_override;
+  } else {
+    char buf[4096];
+    ssize_t n = ::readlink("/proc/self/exe", buf, sizeof buf - 1);
+    if (n <= 0) {
+      std::perror("readlink /proc/self/exe");
+      return 1;
+    }
+    buf[n] = 0;
+    target = buf;
+  }
+
+  // Stage FIRST, then hash the staged copy: hashing the source and
+  // copying it afterwards would verify bytes that a concurrent writer
+  // could have swapped between the two reads (TOCTOU) — the checksum
+  // must cover exactly what rename() installs.
+  std::string tmp = target + ".update.tmp";
+  FILE* src = std::fopen(new_binary, "rb");
+  if (!src) {
+    std::perror("self-update: open source");
+    return 1;
+  }
+  FILE* dst = std::fopen(tmp.c_str(), "wb");
+  if (!dst) {
+    std::perror("self-update: open staging");
+    std::fclose(src);
+    return 1;
+  }
+  char buf[1 << 16];
+  size_t n;
+  bool ok = true;
+  int saved_errno = 0;  // errno at the FAILING call; later cleanup
+                        // calls (fflush/fsync/fclose) overwrite errno
+  Sha256 ctx;
+  while ((n = std::fread(buf, 1, sizeof buf, src)) > 0) {
+    if (std::fwrite(buf, 1, n, dst) != n) {
+      saved_errno = errno;
+      ok = false;
+      break;
+    }
+    ctx.update((const unsigned char*)buf, n);
+  }
+  if (ok && std::ferror(src)) { saved_errno = errno; ok = false; }
+  std::fclose(src);
+  if (std::fflush(dst) != 0 && ok) { saved_errno = errno; ok = false; }
+  if (::fsync(::fileno(dst)) != 0 && ok) { saved_errno = errno; ok = false; }
+  std::fclose(dst);
+  if (!ok) {
+    std::fprintf(stderr, "self-update: staging %s failed: %s\n",
+                 tmp.c_str(), std::strerror(saved_errno));
+    ::unlink(tmp.c_str());
+    return 1;
+  }
+  std::string actual = ctx.hexdigest();
+  if (sha256_hex) {
+    std::string expect(sha256_hex);
+    for (auto& c : expect) c = (char)std::tolower((unsigned char)c);
+    if (expect != actual) {
+      std::fprintf(stderr,
+                   "self-update: checksum mismatch\n  expect %s\n  actual "
+                   "%s\n(target left untouched)\n",
+                   expect.c_str(), actual.c_str());
+      ::unlink(tmp.c_str());
+      return 2;
+    }
+  } else {
+    std::fprintf(stderr,
+                 "self-update: warning: no --sha256 given — installing "
+                 "UNVERIFIED binary (sha256 %s)\n",
+                 actual.c_str());
+  }
+  if (::chmod(tmp.c_str(), 0755) != 0 ||
+      ::rename(tmp.c_str(), target.c_str()) != 0) {
+    std::fprintf(stderr, "self-update: installing %s failed: %s\n",
+                 tmp.c_str(), std::strerror(errno));
+    ::unlink(tmp.c_str());
+    return 1;
+  }
+  std::printf("self-update: %s <- %s (sha256 %s)\n", target.c_str(),
+              new_binary, actual.c_str());
+  return 0;
+}
+
 // exit code from a JSON response body: 0 result, 2 error.
 int response_exit_code(const std::string& response) {
   size_t err_pos = response.find("\"error\":");
@@ -308,8 +629,11 @@ int main(int argc, char** argv) {
   const char* socket_path = kDefaultSocket;
   const char* token_file = nullptr;
   const char* singleton_lock = nullptr;
+  const char* sha256_hex = nullptr;
+  const char* update_target = nullptr;
   bool msgpack = false;
   int interval_s = 2;
+  long accept_count = 0;
   int argi = 1;
   while (argi < argc && argv[argi][0] == '-') {
     if (argi + 1 < argc && std::strcmp(argv[argi], "--socket") == 0) {
@@ -324,8 +648,20 @@ int main(int argc, char** argv) {
                std::strcmp(argv[argi], "--interval") == 0) {
       interval_s = std::atoi(argv[++argi]);
       if (interval_s < 1) interval_s = 1;
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--accept-count") == 0) {
+      accept_count = std::atol(argv[++argi]);
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--sha256") == 0) {
+      sha256_hex = argv[++argi];
+    } else if (argi + 1 < argc &&
+               std::strcmp(argv[argi], "--target") == 0) {
+      update_target = argv[++argi];
     } else if (std::strcmp(argv[argi], "--msgpack") == 0) {
       msgpack = true;
+    } else if (std::strcmp(argv[argi], "--version") == 0) {
+      std::printf("%s\n", kVersion);
+      return 0;
     } else {
       std::fprintf(stderr, "unknown option: %s\n", argv[argi]);
       return 1;
@@ -336,7 +672,9 @@ int main(int argc, char** argv) {
     std::fprintf(stderr,
                  "usage: senweaver-ctl [--socket PATH] [--token-file PATH] "
                  "[--msgpack] [--singleton-lock PATH] [--interval S] "
-                 "<ping|status|watch|submit|stop|call> [args]\n");
+                 "[--accept-count N] [--sha256 HEX] [--target PATH] "
+                 "<ping|status|watch|version|submit|stop|call|tunnel|"
+                 "self-update> [args]\n");
     return 1;
   }
 
@@ -365,6 +703,42 @@ int main(int argc, char** argv) {
   }
 
   std::string cmd = argv[argi++];
+  if (cmd == "version") {
+    std::printf("%s\n", kVersion);
+    return 0;
+  }
+  if (cmd == "tunnel") {
+    if (argi >= argc) {
+      std::fprintf(stderr, "tunnel requires a TCP port (0 = auto)\n");
+      return 1;
+    }
+    char* end = nullptr;
+    long port = std::strtol(argv[argi], &end, 10);
+    if (end == argv[argi] || *end != 0 || port < 0 || port > 65535) {
+      std::fprintf(stderr, "tunnel: invalid port %s (need 0..65535)\n",
+                   argv[argi]);
+      return 1;
+    }
+    // The unix socket's file permissions gate the control plane; a
+    // loopback TCP port has no ACL — every local uid can connect. The
+    // tunnel is a dumb pipe (per-request auth stays with the server),
+    // so surface the widened boundary when the server may be tokenless.
+    if (token_file == nullptr &&
+        std::getenv("SENWEAVER_CTL_TOKEN") == nullptr) {
+      std::fprintf(stderr,
+                   "tunnel: warning: no auth token configured here; "
+                   "ensure the control server enforces one, or any "
+                   "local user can reach it via this port\n");
+    }
+    return run_tunnel(socket_path, (int)port, accept_count);
+  }
+  if (cmd == "self-update") {
+    if (argi >= argc) {
+      std::fprintf(stderr, "self-update requires a new-binary path\n");
+      return 1;
+    }
+    return run_self_update(argv[argi], sha256_hex, update_target);
+  }
   std::string method, params = "null";
   bool watch = false;
   if (cmd == "ping" || cmd == "status") {

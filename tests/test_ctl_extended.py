@@ -254,3 +254,86 @@ def test_ctl_watch_until_jobs_done(tmp_path):
         assert "queued" in lines[0] and "stopped" in lines[-1]
     finally:
         server.stop()
+
+
+# ---- tunnel (cli/src/tunnels.rs role) + self-update (self_update.rs) ----
+
+@needs_native
+def test_ctl_tunnel_forwards_control_plane(auth_server):
+    """`tunnel 0` binds a kernel-assigned loopback port and relays TCP
+    bytes to the unix-socket control server, propagating the SHUT_WR
+    request framing both ways; --accept-count 2 exits after 2 conns."""
+    binary = ctl_binary_path()
+    proc = subprocess.Popen(
+        [binary, "--socket", auth_server.socket_path,
+         "--accept-count", "2", "tunnel", "0"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        line = proc.stdout.readline()
+        assert "tunnel listening on 127.0.0.1:" in line
+        port = int(line.split("127.0.0.1:")[1].split(" ")[0])
+
+        def rpc_over_tcp(payload: bytes) -> bytes:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=10) as c:
+                c.sendall(payload)
+                c.shutdown(socket.SHUT_WR)
+                data = b""
+                while (chunk := c.recv(65536)):
+                    data += chunk
+            return data
+
+        ok = json.loads(rpc_over_tcp(
+            b'{"jsonrpc": "2.0", "id": 1, "method": "ping"}\n'))
+        assert ok["result"] == "pong"
+        # auth still enforced through the tunnel; msgpack framing survives
+        req = mp.pack({"jsonrpc": "2.0", "id": 2, "method": "status",
+                       "auth": "sekrit"})
+        resp = mp.unpack(rpc_over_tcp(req))
+        assert resp["result"] == []
+        assert proc.wait(timeout=10) == 0      # accept-count reached
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+@needs_native
+def test_ctl_self_update_verified_atomic_replace(tmp_path):
+    import hashlib
+    import os
+    binary = ctl_binary_path()
+    target = tmp_path / "installed-ctl"
+    target.write_bytes(open(binary, "rb").read())
+    target.chmod(0o755)
+    new = tmp_path / "candidate"
+    new.write_bytes(b"#!/bin/sh\necho next-version\n")
+    digest = hashlib.sha256(new.read_bytes()).hexdigest()
+
+    # checksum mismatch → exit 2, target untouched
+    bad = subprocess.run(
+        [binary, "--sha256", "0" * 64, "--target", str(target),
+         "self-update", str(new)],
+        capture_output=True, text=True, timeout=30)
+    assert bad.returncode == 2
+    assert "checksum mismatch" in bad.stderr
+    assert target.read_bytes() == open(binary, "rb").read()
+
+    # matching checksum (case-insensitive) → atomic replace, executable
+    good = subprocess.run(
+        [binary, "--sha256", digest.upper(), "--target", str(target),
+         "self-update", str(new)],
+        capture_output=True, text=True, timeout=30)
+    assert good.returncode == 0
+    assert digest in good.stdout
+    ran = subprocess.run([str(target)], capture_output=True, text=True,
+                         timeout=10)
+    assert ran.stdout.strip() == "next-version"
+    assert not (tmp_path / "installed-ctl.update.tmp").exists()
+
+
+@needs_native
+def test_ctl_version():
+    proc = subprocess.run([ctl_binary_path(), "version"],
+                          capture_output=True, text=True, timeout=10)
+    assert proc.returncode == 0
+    assert proc.stdout.startswith("senweaver-ctl ")
