@@ -5,12 +5,13 @@ Measures KV-cache autoregressive DECODE on the flagship policy
 is weight-value independent) via the fully-jitted ``generate_scan`` path,
 on whatever accelerator JAX exposes (one TPU v5e chip under the driver).
 
-Timing method: the decode rate is computed from the DIFFERENCE between a
-full prefill+decode run and a prefill-only run of identical shapes — this
-subtracts both the prefill compute (the r1 bench mistakenly timed 3
-8×512-token prefills inside the decode loop) and the per-dispatch
-host↔device round-trip, which costs ~65 ms through the axon tunnel and
-would otherwise understate throughput by ~10%.
+Timing method: SLOPE — the decode rate is computed from two
+prefill+decode runs that differ only in decode length (n_lo vs n_hi
+tokens); rate = extra_tokens / (t_hi − t_lo). Identical prefill work
+cancels exactly. The r1 bench mistakenly timed 3 8×512-token prefills
+inside the decode loop; the r2 interim used (prefill+decode) −
+(prefill-only), which goes singular when prefill dominates — at b32 the
+subtraction landed within timing noise and reported 1e10 tok/s.
 
 Baseline semantics: the reference (senweaver/senweaver-ide) publishes no
 quantitative numbers (BASELINE.json ``published: {}``); its policy tokens
@@ -51,7 +52,7 @@ def _baseline() -> float:
 
 def _measure(model_name: str, batch: int, prompt_len: int,
              decode_tokens: int) -> float:
-    """Decode tokens/sec via (prefill+decode) − (prefill-only)."""
+    """Decode tokens/sec via the slope between two decode lengths."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -59,42 +60,43 @@ def _measure(model_name: str, batch: int, prompt_len: int,
     from senweaver_ide_tpu.models import get_config, init_params
     from senweaver_ide_tpu.models.transformer import init_kv_cache
     from senweaver_ide_tpu.rollout.sampler import (SampleParams,
-                                                   generate_scan, prefill)
+                                                   generate_scan)
 
     config = get_config(model_name)
     params = jax.block_until_ready(init_params(config, jax.random.PRNGKey(0)))
     prompt = jnp.ones((batch, prompt_len), dtype=jnp.int32)
-    max_len = prompt_len + decode_tokens
+    n_lo, n_hi = 16, 16 + decode_tokens
+    max_len = prompt_len + n_hi
     sample = SampleParams(temperature=0.8, top_k=0, top_p=0.0)
 
-    def run_full(key):
+    def run(key, n):
+        # Same max_len cache for both lengths: per-step attention cost
+        # must match so the slope isolates pure per-token decode time.
         cache = init_kv_cache(config, batch, max_len)
         toks, _ = generate_scan(params, config, prompt, cache, key,
-                                max_new_tokens=decode_tokens, sample=sample)
+                                max_new_tokens=n, sample=sample)
         # Materialize on HOST: under remote-device platforms (axon tunnel)
         # block_until_ready alone does not guarantee the computation ran.
         return np.asarray(toks)
 
-    def run_prefill(key):
-        cache = init_kv_cache(config, batch, max_len)
-        logits, _ = prefill(params, config, prompt, cache)
-        return np.asarray(logits)
-
-    out = run_full(jax.random.PRNGKey(1))        # compile prefill+decode
-    assert out.shape == (batch, decode_tokens)
-    run_prefill(jax.random.PRNGKey(1))           # compile prefill-only
+    # Warmup/compile as plain statements: inside `assert` they would be
+    # stripped under python -O, moving compilation into the timed loops.
+    warm_lo = run(jax.random.PRNGKey(1), n_lo)
+    warm_hi = run(jax.random.PRNGKey(1), n_hi)
+    if warm_lo.shape != (batch, n_lo) or warm_hi.shape != (batch, n_hi):
+        raise RuntimeError("generate_scan returned unexpected shapes")
 
     t0 = time.perf_counter()
     for i in range(TIMED_ITERS):
-        run_full(jax.random.PRNGKey(2 + i))
-    t_full = time.perf_counter() - t0
+        run(jax.random.PRNGKey(2 + i), n_lo)
+    t_lo = time.perf_counter() - t0
 
     t0 = time.perf_counter()
     for i in range(TIMED_ITERS):
-        run_prefill(jax.random.PRNGKey(2 + i))
-    t_pre = time.perf_counter() - t0
+        run(jax.random.PRNGKey(2 + i), n_hi)
+    t_hi = time.perf_counter() - t0
 
-    decode_s = max(t_full - t_pre, 1e-6)
+    decode_s = max(t_hi - t_lo, 1e-6)
     return batch * decode_tokens * TIMED_ITERS / decode_s
 
 

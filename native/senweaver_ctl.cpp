@@ -546,17 +546,32 @@ int run_self_update(const char* new_binary, const char* sha256_hex,
   // Stage FIRST, then hash the staged copy: hashing the source and
   // copying it afterwards would verify bytes that a concurrent writer
   // could have swapped between the two reads (TOCTOU) — the checksum
-  // must cover exactly what rename() installs.
-  std::string tmp = target + ".update.tmp";
+  // must cover exactly what rename() installs. The staging file itself
+  // must be unique+exclusive (mkstemp): a fixed predictable name would
+  // let a concurrent writer interleave bytes into the very file being
+  // hashed, reopening the same hole.
+  std::string tmp = target + ".update.XXXXXX";
+  std::vector<char> tmpl(tmp.begin(), tmp.end());
+  tmpl.push_back(0);
+  int dfd = ::mkstemp(tmpl.data());
+  if (dfd < 0) {
+    std::perror("self-update: mkstemp staging");
+    return 1;
+  }
+  tmp.assign(tmpl.data());
   FILE* src = std::fopen(new_binary, "rb");
   if (!src) {
     std::perror("self-update: open source");
+    ::close(dfd);
+    ::unlink(tmp.c_str());
     return 1;
   }
-  FILE* dst = std::fopen(tmp.c_str(), "wb");
+  FILE* dst = ::fdopen(dfd, "wb");
   if (!dst) {
     std::perror("self-update: open staging");
     std::fclose(src);
+    ::close(dfd);
+    ::unlink(tmp.c_str());
     return 1;
   }
   char buf[1 << 16];

@@ -112,6 +112,23 @@ def qwen2_5_coder_7b() -> ModelConfig:
         qkv_bias=True)
 
 
+def mistral_7b() -> ModelConfig:
+    """Mistral-7B-v0.1: the sliding-window-attention family.
+
+    The reference serves Mistral models through its mistral provider
+    (codestral FIM entry in the capability DB; provider registry
+    ``transport/providers.py``); this preset gives that family a local
+    policy architecture: LLaMA-style GQA with a 4096-token sliding
+    window — each token attends only to its trailing 4096 positions
+    (``ops/attention.py causal_mask(window=...)``). HF-layout weights
+    load via ``models.load`` (same q/k/v/gate/up/down key scheme)."""
+    return ModelConfig(
+        name="mistral-7b", vocab_size=32_000, hidden_size=4096,
+        intermediate_size=14_336, num_layers=32, num_heads=32,
+        num_kv_heads=8, head_dim=128, max_seq_len=32_768,
+        rope_theta=10_000.0, rms_norm_eps=1e-5, sliding_window=4096)
+
+
 def deepseek_coder_1_3b() -> ModelConfig:
     return ModelConfig(
         name="deepseek-coder-1.3b", vocab_size=32_256, hidden_size=2048,
@@ -150,6 +167,7 @@ PRESETS = {
     "qwen2.5-coder-0.5b": qwen2_5_coder_0_5b,
     "qwen2.5-coder-1.5b": qwen2_5_coder_1_5b,
     "qwen2.5-coder-7b": qwen2_5_coder_7b,
+    "mistral-7b": mistral_7b,
     "deepseek-coder-1.3b": deepseek_coder_1_3b,
     "deepseek-coder-6.7b": deepseek_coder_6_7b,
     "tiny-test": tiny_test,

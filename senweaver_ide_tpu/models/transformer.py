@@ -148,7 +148,13 @@ def _self_attention(c: ModelConfig, q, k, v, kv_mask, mesh):
     """No-cache attention dispatch per ``c.attn_impl`` (training/scoring
     path). q (B,S,Hq,Dh), k/v (B,S,Hkv,Dh) → (B,S,Hq,Dh)."""
     if c.attn_impl == "einsum":
-        return attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True)
+        return attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True,
+                         window=c.sliding_window)
+    if c.sliding_window is not None:
+        raise NotImplementedError(
+            f"sliding_window is implemented for attn_impl='einsum' only "
+            f"(got {c.attn_impl!r}); the flash/ring kernels would silently "
+            f"attend outside the window")
     if c.attn_impl == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, q_offset=0, kv_mask=kv_mask,
@@ -184,7 +190,7 @@ def _cache_attention(c: ModelConfig, q, k_full, v_full, length, kv_mask,
         # post-write valid count: the current token's k/v is in the cache
         return flash_decode(q, k_full, v_full, length + 1, block_kv=blk)
     return attention(q, k_full, v_full, q_offset=length, kv_mask=kv_mask,
-                     causal=True)
+                     causal=True, window=c.sliding_window)
 
 
 def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
@@ -359,8 +365,11 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
         # and a VMEM-busting block — so it falls back to einsum instead.
         tileable = (max_len % 128 == 0
                     or (max_len % 8 == 0 and max_len <= 512))
+        # Sliding window changes the valid-kv lower bound; flash_decode
+        # only models "pos < length + 1", so SWA configs stay on einsum.
         flash_ok = (c.decode_attn_impl == "flash" and s == 1
-                    and attn_mask is None and tileable)
+                    and attn_mask is None and tileable
+                    and c.sliding_window is None)
 
         if cache.quantized:
             def body_q(carry, inputs):

@@ -26,11 +26,14 @@ def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
     return x.reshape(b, s, h * n_rep, d)
 
 
-def causal_mask(q_len: int, kv_len: int, q_offset) -> jnp.ndarray:
+def causal_mask(q_len: int, kv_len: int, q_offset,
+                window: Optional[int] = None) -> jnp.ndarray:
     """Boolean mask, True = attend. ``q_offset`` is the absolute position of
     the first query — a scalar (traced or static) giving a (q_len, kv_len)
     mask, or a (B,) vector of per-slot offsets (continuous batching) giving
-    (B, q_len, kv_len)."""
+    (B, q_len, kv_len). ``window`` (sliding-window attention, the
+    Mistral-family scheme) additionally bounds each query to its trailing
+    ``window`` positions: kv ∈ (q - window, q]."""
     q_offset = jnp.asarray(q_offset)
     if q_offset.ndim == 1:
         q_pos = q_offset[:, None, None] + jnp.arange(q_len)[None, :, None]
@@ -38,7 +41,10 @@ def causal_mask(q_len: int, kv_len: int, q_offset) -> jnp.ndarray:
     else:
         q_pos = q_offset + jnp.arange(q_len)[:, None]
         k_pos = jnp.arange(kv_len)[None, :]
-    return k_pos <= q_pos
+    mask = k_pos <= q_pos
+    if window is not None:
+        mask = mask & (k_pos > q_pos - window)
+    return mask
 
 
 def attention(
@@ -49,6 +55,7 @@ def attention(
     q_offset=0,
     kv_mask: Optional[jnp.ndarray] = None,   # (B, Skv) True = valid
     causal: bool = True,
+    window: Optional[int] = None,            # sliding-window width
 ) -> jnp.ndarray:
     """Grouped-query causal attention. Returns (B, Sq, Hq, D).
 
@@ -81,8 +88,10 @@ def attention(
                             preferred_element_type=jnp.float32)
     scores = scores * scale                   # (B, Hkv, rep, Sq, Skv) fp32
 
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     if causal:
-        mask = causal_mask(sq, k.shape[1], q_offset)
+        mask = causal_mask(sq, k.shape[1], q_offset, window)
         # (q, kv) → (1, 1, 1, q, kv); (B, q, kv) → (B, 1, 1, q, kv)
         mask = mask[None, None, None] if mask.ndim == 2 \
             else mask[:, None, None]

@@ -36,11 +36,21 @@ from .attention import NEG_INF
 
 
 def _fd_kernel(lengths_ref, q_ref, k_ref, v_ref, out_ref,
-               acc_ref, m_ref, l_ref, *, scale: float, block_kv: int):
-    """One (batch, kv-head) program; innermost grid axis = KV block."""
+               acc_ref, m_ref, l_ref, *, scale: float, block_kv: int,
+               hkv: int, rep_pad: int):
+    """One batch program per KV block; KV heads loop INSIDE the kernel.
+
+    The head axis must stay whole in the K/V block specs: a
+    single-head slice (block dim 1 over an Hkv-sized axis) violates the
+    Mosaic tiling rule that a block's last two dims be 8/128-divisible
+    or equal to the full array dims — observed as a lowering error for
+    GQA caches with Hkv < 8 (Qwen: Hkv=2). Rows of the q tile /
+    softmax state are the hkv·rep_pad flattened (kv-head, group)
+    pairs; each head's (rep_pad, D) q rows hit only its own K/V slab.
+    """
     bi = pl.program_id(0)
-    ki = pl.program_id(2)
-    n_kv = pl.num_programs(2)
+    ki = pl.program_id(1)
+    n_kv = pl.num_programs(1)
 
     @pl.when(ki == 0)
     def _init():
@@ -52,15 +62,19 @@ def _fd_kernel(lengths_ref, q_ref, k_ref, v_ref, out_ref,
     k_start = ki * block_kv
 
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # (rep_pad, D)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)        # (block_kv, D)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)          # (rep_pad, blk)
-        rp = q.shape[0]
+        q = q_ref[0].astype(jnp.float32) * scale   # (hkv*rep_pad, D)
+        # Per-head scores, stacked back to the flattened row layout.
+        s_heads = []
+        for h in range(hkv):
+            qh = q[h * rep_pad:(h + 1) * rep_pad]            # (rep_pad, D)
+            kh = k_ref[0, :, h, :].astype(jnp.float32)       # (blk, D)
+            s_heads.append(jax.lax.dot_general(
+                qh, kh, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))         # (rep_pad, blk)
+        s = jnp.concatenate(s_heads, axis=0)       # (hkv*rep_pad, blk)
+        rows = s.shape[0]
         pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
-                                                 (rp, block_kv), 1)
+                                                 (rows, block_kv), 1)
         s = jnp.where(pos < length, s, NEG_INF)
 
         m_prev = m_ref[:]
@@ -68,9 +82,14 @@ def _fd_kernel(lengths_ref, q_ref, k_ref, v_ref, out_ref,
         p = jnp.where(s > _MASKED, jnp.exp(s - m_new), 0.0)
         corr = jnp.exp(m_prev - m_new)
         l_ref[:] = corr * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = corr * acc_ref[:] + jax.lax.dot_general(
-            p, v, dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        pv_heads = []
+        for h in range(hkv):
+            ph = p[h * rep_pad:(h + 1) * rep_pad]
+            vh = v_ref[0, :, h, :].astype(jnp.float32)       # (blk, D)
+            pv_heads.append(jax.lax.dot_general(
+                ph, vh, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))         # (rep_pad, D)
+        acc_ref[:] = corr * acc_ref[:] + jnp.concatenate(pv_heads, axis=0)
         m_ref[:] = m_new
 
     # Blocks wholly past this slot's fill level contribute nothing — skip
@@ -82,7 +101,7 @@ def _fd_kernel(lengths_ref, q_ref, k_ref, v_ref, out_ref,
     def _finalize():
         l = l_ref[:]
         safe_l = jnp.where(l > 0.0, l, 1.0)
-        out_ref[0, 0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+        out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
 
 
 def flash_decode(
@@ -114,11 +133,12 @@ def flash_decode(
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
-    # (B, 1, Hq, D) → (B, Hkv, rep_pad, D): the GQA group is the sublane
-    # axis of each program's q tile.
+    # (B, 1, Hq, D) → (B, Hkv*rep_pad, D): the flattened (kv-head, group)
+    # pairs are the sublane axis of each program's q tile.
     qg = q[:, 0].reshape(b, hkv, rep, d)
     if rep_pad != rep:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_pad - rep), (0, 0)))
+    qg = qg.reshape(b, hkv * rep_pad, d)
 
     pad_kv = (-smax) % block_kv
     if pad_kv:
@@ -133,32 +153,33 @@ def flash_decode(
     n_kv = k_cache.shape[1] // block_kv
 
     kernel = functools.partial(_fd_kernel, scale=1.0 / (d ** 0.5),
-                               block_kv=block_kv)
+                               block_kv=block_kv, hkv=hkv, rep_pad=rep_pad)
+    rows = hkv * rep_pad
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(b, hkv, n_kv),
+        grid=(b, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, rep_pad, d),
-                         lambda b_, h, ki, _: (b_, h, 0, 0)),
-            pl.BlockSpec((1, block_kv, 1, d),
-                         lambda b_, h, ki, _: (b_, ki, h, 0)),
-            pl.BlockSpec((1, block_kv, 1, d),
-                         lambda b_, h, ki, _: (b_, ki, h, 0)),
+            pl.BlockSpec((1, rows, d), lambda b_, ki, _: (b_, 0, 0)),
+            # Full head axis per block: a 1-wide head slice would break
+            # the Mosaic last-two-dims tiling rule for Hkv < 8.
+            pl.BlockSpec((1, block_kv, hkv, d),
+                         lambda b_, ki, _: (b_, ki, 0, 0)),
+            pl.BlockSpec((1, block_kv, hkv, d),
+                         lambda b_, ki, _: (b_, ki, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, rep_pad, d),
-                               lambda b_, h, ki, _: (b_, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, rows, d), lambda b_, ki, _: (b_, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep_pad, d), jnp.float32),
-            pltpu.VMEM((rep_pad, 1), jnp.float32),
-            pltpu.VMEM((rep_pad, 1), jnp.float32),
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, hkv, rep_pad, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, rows, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * smax * d,
             bytes_accessed=(k_cache.size + v_cache.size) * 2,
@@ -166,5 +187,6 @@ def flash_decode(
         interpret=interpret,
     )(lengths, qg, k_cache, v_cache)
 
-    out = out[:, :, :rep, :].reshape(b, 1, hq, d)
+    out = out.reshape(b, hkv, rep_pad, d)[:, :, :rep, :].reshape(
+        b, 1, hq, d)
     return out[:, 0] if squeeze else out

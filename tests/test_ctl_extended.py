@@ -328,7 +328,7 @@ def test_ctl_self_update_verified_atomic_replace(tmp_path):
     ran = subprocess.run([str(target)], capture_output=True, text=True,
                          timeout=10)
     assert ran.stdout.strip() == "next-version"
-    assert not (tmp_path / "installed-ctl.update.tmp").exists()
+    assert not list(tmp_path.glob("installed-ctl.update.*"))  # no leftovers
 
 
 @needs_native

@@ -1,0 +1,139 @@
+"""Sliding-window attention (Mistral-family; ModelConfig.sliding_window).
+
+Window semantics: each query attends to kv positions in (q - W, q] — the
+trailing W tokens including itself. Covers the op (vs a numpy oracle), the
+model cache/no-cache parity, and the preset/guard surface.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import (forward, get_config, init_kv_cache,
+                                      init_params, tiny_test)
+from senweaver_ide_tpu.ops.attention import attention, causal_mask
+
+
+def _oracle(q, k, v, window, q_offset=0):
+    """Dense numpy attention with an explicit (q, kv) loop mask."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    rep = hq // hkv
+    out = np.zeros_like(np.asarray(q, dtype=np.float64))
+    qn = np.asarray(q, np.float64)
+    kn = np.asarray(k, np.float64)
+    vn = np.asarray(v, np.float64)
+    for bi in range(b):
+        for h in range(hq):
+            kv_h = h // rep
+            for qi in range(sq):
+                qpos = q_offset + qi
+                lo = max(0, qpos - window + 1) if window else 0
+                hi = min(qpos + 1, k.shape[1])
+                scores = kn[bi, lo:hi, kv_h] @ qn[bi, qi, h] / np.sqrt(d)
+                p = np.exp(scores - scores.max())
+                p /= p.sum()
+                out[bi, qi, h] = p @ vn[bi, lo:hi, kv_h]
+    return out
+
+
+def test_window_mask_shape_and_bounds():
+    m = causal_mask(4, 8, 4, window=2)            # queries at pos 4..7
+    assert m.shape == (4, 8)
+    # query 0 (abs pos 4) sees kv 3..4 only
+    assert list(np.where(np.asarray(m[0]))[0]) == [3, 4]
+    # per-slot offsets broadcast to (B, q, kv)
+    mb = causal_mask(1, 8, jnp.array([2, 5]), window=3)
+    assert mb.shape == (2, 1, 8)
+    assert list(np.where(np.asarray(mb[1, 0]))[0]) == [3, 4, 5]
+
+
+@pytest.mark.parametrize("window", [1, 3, 16])
+def test_attention_window_matches_oracle(rng, window):
+    b, s, hq, hkv, d = 2, 12, 4, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, s, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, hkv, d)), jnp.float32)
+    got = attention(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(got),
+                               _oracle(q, k, v, window), atol=1e-5)
+
+
+def test_window_geq_len_equals_full_causal(rng):
+    b, s, h, d = 1, 10, 2, 8
+    q = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, s, h, d)), jnp.float32)
+    full = attention(q, k, v, causal=True)
+    win = attention(q, k, v, causal=True, window=s + 5)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(win), atol=1e-6)
+
+
+def test_swa_model_cache_matches_full_forward(rng):
+    """Incremental decode through the KV cache must equal the no-cache
+    forward under a window smaller than the sequence — the decode path's
+    q_offset-based window mask and the training path's must agree."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 2, 16)
+    outs = []
+    for i in range(toks.shape[1]):
+        lg, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        outs.append(lg)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate(outs, axis=1)),
+                               atol=2e-4)
+
+
+def test_swa_prefill_then_decode(rng):
+    """Chunked prefill (s>1 with cache) + single-token decode under SWA."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=3)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 1, 16)
+    pre, cache = forward(params, cfg, toks[:, :5], cache=cache)
+    np.testing.assert_allclose(np.asarray(full[:, :5]), np.asarray(pre),
+                               atol=2e-4)
+    for i in range(5, 8):
+        lg, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        np.testing.assert_allclose(np.asarray(full[:, i:i + 1]),
+                                   np.asarray(lg), atol=2e-4)
+
+
+def test_swa_actually_limits_attention(rng):
+    """Changing a token OUTSIDE the window must not change the last-token
+    logits; changing one INSIDE must."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=3)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    toks = np.asarray(rng.integers(1, cfg.vocab_size, (1, 10)), np.int32)
+    base, _ = forward(params, cfg, jnp.asarray(toks))
+    last = np.asarray(base[:, -1])
+
+    far = toks.copy()
+    far[0, 2] = (far[0, 2] + 7) % cfg.vocab_size     # outside last window
+    far_lg, _ = forward(params, cfg, jnp.asarray(far))
+    np.testing.assert_allclose(last, np.asarray(far_lg[:, -1]), atol=1e-5)
+
+    near = toks.copy()
+    near[0, 8] = (near[0, 8] + 7) % cfg.vocab_size   # inside last window
+    near_lg, _ = forward(params, cfg, jnp.asarray(near))
+    assert np.abs(last - np.asarray(near_lg[:, -1])).max() > 1e-4
+
+
+def test_mistral_preset_and_guards():
+    cfg = get_config("mistral-7b")
+    assert cfg.sliding_window == 4096
+    assert cfg.num_kv_heads == 8 and cfg.vocab_size == 32_000
+    bad = dataclasses.replace(tiny_test(), sliding_window=4,
+                              attn_impl="flash")
+    params = init_params(bad, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError, match="sliding_window"):
+        forward(params, bad, jnp.ones((1, 8), jnp.int32))
