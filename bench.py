@@ -86,18 +86,29 @@ def _measure(model_name: str, batch: int, prompt_len: int,
     if warm_lo.shape != (batch, n_lo) or warm_hi.shape != (batch, n_hi):
         raise RuntimeError("generate_scan returned unexpected shapes")
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_ITERS):
-        run(jax.random.PRNGKey(2 + i), n_lo)
-    t_lo = time.perf_counter() - t0
+    def timed_pair():
+        t0 = time.perf_counter()
+        for i in range(TIMED_ITERS):
+            run(jax.random.PRNGKey(2 + i), n_lo)
+        t_lo = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(TIMED_ITERS):
+            run(jax.random.PRNGKey(2 + i), n_hi)
+        return t_lo, time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for i in range(TIMED_ITERS):
-        run(jax.random.PRNGKey(2 + i), n_hi)
-    t_hi = time.perf_counter() - t0
-
-    decode_s = max(t_hi - t_lo, 1e-6)
-    return batch * decode_tokens * TIMED_ITERS / decode_s
+    t_lo, t_hi = timed_pair()
+    if t_hi <= t_lo * 1.02:
+        # A GC pause or dispatch hiccup in the n_lo loop makes the slope
+        # non-positive; silently clamping would report an absurd rate
+        # (the 1e10-tok/s failure this method replaced). Retry once,
+        # then fail loudly into the JSON error line.
+        t_lo, t_hi = timed_pair()
+    if t_hi <= t_lo * 1.02:   # same margin as the retry trigger: a
+        # marginal slope would divide by near-noise and inflate the rate
+        raise RuntimeError(
+            f"decode slope not positive (t_lo={t_lo:.3f}s "
+            f"t_hi={t_hi:.3f}s); timing too noisy to report")
+    return batch * decode_tokens * TIMED_ITERS / (t_hi - t_lo)
 
 
 def _measure_steps(model_name: str, batch: int, prompt_len: int,

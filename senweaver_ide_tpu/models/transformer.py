@@ -48,9 +48,36 @@ class KVCache(NamedTuple):
         return self.k_scale is not None
 
 
+def ring_capacity(config: ModelConfig, max_len: int) -> int:
+    """KV capacity actually allocated for ``max_len`` requested positions.
+
+    Sliding-window configs keep only the trailing ``sliding_window``
+    positions (ring buffer, written at pos % capacity) — THE memory
+    benefit of SWA: a mistral-7b 32k-context decode holds 4096 cache
+    slots, not 32768. Rounded up to a multiple of 8 for TPU lane
+    tiling; when the window itself is flash-tileable the capacity
+    equals it exactly, keeping the flash-decode path eligible."""
+    if config.sliding_window is None:
+        return max_len
+    return min(max_len, -(-config.sliding_window // 8) * 8)
+
+
+def _is_ring(c: ModelConfig, cap: int) -> bool:
+    """Ring (modular-write) semantics apply only when the cache can hold
+    the whole window: cap < window would overwrite keys still inside the
+    window on every wrap (write-then-attend is only safe because the slot
+    being overwritten, pos − cap, lies outside the window when
+    cap ≥ window). Short SWA caches (cap < aligned window) therefore use
+    ABSOLUTE positions — plain bounded cache with the positional window
+    mask, never wrapping."""
+    return (c.sliding_window is not None
+            and cap >= -(-c.sliding_window // 8) * 8)
+
+
 def init_kv_cache(config: ModelConfig, batch: int, max_len: int,
                   dtype=None, *, quantized: Optional[bool] = None) -> KVCache:
     quantized = config.kv_quant if quantized is None else quantized
+    max_len = ring_capacity(config, max_len)
     shape = (config.num_layers, batch, max_len, config.num_kv_heads,
              config.head_dim)
     if quantized:
@@ -182,13 +209,24 @@ def _self_attention(c: ModelConfig, q, k, v, kv_mask, mesh):
 def _cache_attention(c: ModelConfig, q, k_full, v_full, length, kv_mask,
                      flash_decode_ok: bool):
     """Cache-path attention dispatch: einsum over the whole cache, or the
-    streamed flash-decode kernel when the step shape allows it."""
+    streamed flash-decode kernel when the step shape allows it.
+
+    Ring caches (SWA): ``kv_mask`` arrives as the full per-query
+    (B, Sq, cap) validity mask — fill, causality, and window are all
+    baked in by ``_forward_impl`` in ring coordinates, so the positional
+    causal/window mask here must be OFF (ring index != absolute
+    position). Flash-decode stays valid on a ring whose capacity equals
+    the window: live entries are exactly indices < min(length+1, cap)
+    and online softmax is order-invariant."""
     if flash_decode_ok:
         from ..ops.flash_decode import flash_decode
         smax = k_full.shape[1]
         blk = 128 if smax % 128 == 0 else smax
         # post-write valid count: the current token's k/v is in the cache
-        return flash_decode(q, k_full, v_full, length + 1, block_kv=blk)
+        valid_count = jnp.minimum(length + 1, smax)
+        return flash_decode(q, k_full, v_full, valid_count, block_kv=blk)
+    if _is_ring(c, k_full.shape[1]):
+        return attention(q, k_full, v_full, kv_mask=kv_mask, causal=False)
     return attention(q, k_full, v_full, q_offset=length, kv_mask=kv_mask,
                      causal=True, window=c.sliding_window)
 
@@ -218,7 +256,25 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
         k_cache, v_cache, length, k_scale, v_scale = cache_kv
         kq, ks = _quantize_kv(k)
         vq, vs = _quantize_kv(v)
-        if length.ndim == 0:
+        cap = k_cache.shape[1]
+        ring = _is_ring(c, cap)
+        out = None
+        if ring and s > 1:
+            # Attend BEFORE writing (see _forward_impl's ring notes): a
+            # wrapping chunk's writes would destroy keys still inside
+            # earlier queries' windows. kv axis = [pre-write cache ‖ chunk]
+            # — unless the mask is chunk-width (fresh cache, nothing old
+            # to read): then skip the concat and its masked-out FLOPs.
+            if kv_mask.shape[-1] == s:
+                out = attention(q, k, v, kv_mask=kv_mask, causal=False)
+            else:
+                k_all = jnp.concatenate(
+                    [_dequantize_kv(k_cache, k_scale, x.dtype), k], axis=1)
+                v_all = jnp.concatenate(
+                    [_dequantize_kv(v_cache, v_scale, x.dtype), v], axis=1)
+                out = attention(q, k_all, v_all, kv_mask=kv_mask,
+                                causal=False)
+        if length.ndim == 0 and not ring:
             k_cache = jax.lax.dynamic_update_slice(k_cache, kq,
                                                    (0, length, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(v_cache, vq,
@@ -227,36 +283,64 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                                                    (0, length, 0))
             v_scale = jax.lax.dynamic_update_slice(v_scale, vs,
                                                    (0, length, 0))
+        elif length.ndim == 0:
+            idx = (length + jnp.arange(s)) % cap               # ring write
+            k_cache = k_cache.at[:, idx].set(kq)
+            v_cache = v_cache.at[:, idx].set(vq)
+            k_scale = k_scale.at[:, idx].set(ks)
+            v_scale = v_scale.at[:, idx].set(vs)
         else:
             slot = jnp.arange(b)[:, None]                      # (B, 1)
             pos = length[:, None] + jnp.arange(s)[None, :]     # (B, s)
+            if ring:
+                pos = pos % cap
             k_cache = k_cache.at[slot, pos].set(kq, mode="drop")
             v_cache = v_cache.at[slot, pos].set(vq, mode="drop")
             k_scale = k_scale.at[slot, pos].set(ks, mode="drop")
             v_scale = v_scale.at[slot, pos].set(vs, mode="drop")
-        out = _cache_attention(c, q,
-                               _dequantize_kv(k_cache, k_scale, x.dtype),
-                               _dequantize_kv(v_cache, v_scale, x.dtype),
-                               length, kv_mask, flash_decode_ok)
+        if out is None:
+            out = _cache_attention(c, q,
+                                   _dequantize_kv(k_cache, k_scale, x.dtype),
+                                   _dequantize_kv(v_cache, v_scale, x.dtype),
+                                   length, kv_mask, flash_decode_ok)
         kv_out = (k_cache, v_cache, k_scale, v_scale)
     elif cache_kv is not None:
         k_cache, v_cache, length = cache_kv
-        if length.ndim == 0:
+        cap = k_cache.shape[1]
+        ring = _is_ring(c, cap)
+        out = None
+        if ring and s > 1:
+            # Attend BEFORE writing — see the quantized branch above.
+            if kv_mask.shape[-1] == s:
+                out = attention(q, k, v, kv_mask=kv_mask, causal=False)
+            else:
+                k_all = jnp.concatenate([k_cache.astype(x.dtype), k], axis=1)
+                v_all = jnp.concatenate([v_cache.astype(x.dtype), v], axis=1)
+                out = attention(q, k_all, v_all, kv_mask=kv_mask,
+                                causal=False)
+        if length.ndim == 0 and not ring:
             k_cache = jax.lax.dynamic_update_slice(
                 k_cache, k.astype(k_cache.dtype), (0, length, 0, 0))
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v.astype(v_cache.dtype), (0, length, 0, 0))
+        elif length.ndim == 0:
+            idx = (length + jnp.arange(s)) % cap               # ring write
+            k_cache = k_cache.at[:, idx].set(k.astype(k_cache.dtype))
+            v_cache = v_cache.at[:, idx].set(v.astype(v_cache.dtype))
         else:
             # Per-slot write offsets (continuous batching): scatter each
             # slot's s new positions at its own length.
             slot = jnp.arange(b)[:, None]                      # (B, 1)
             pos = length[:, None] + jnp.arange(s)[None, :]     # (B, s)
+            if ring:
+                pos = pos % cap
             k_cache = k_cache.at[slot, pos].set(k.astype(k_cache.dtype),
                                                 mode="drop")
             v_cache = v_cache.at[slot, pos].set(v.astype(v_cache.dtype),
                                                 mode="drop")
-        out = _cache_attention(c, q, k_cache, v_cache, length, kv_mask,
-                               flash_decode_ok)
+        if out is None:
+            out = _cache_attention(c, q, k_cache, v_cache, length, kv_mask,
+                                   flash_decode_ok)
         kv_out = (k_cache, v_cache)
     else:
         out = _self_attention(c, q, k, v, kv_mask, mesh)
@@ -294,6 +378,7 @@ def forward(
     attn_mask: Optional[jax.Array] = None,   # (B, S_kv) True = valid
     with_aux: bool = False,
     mesh=None,                               # required for ring/ulysses attn
+    fresh_cache: bool = False,               # static: cache holds nothing yet
 ):
     """Run the model. Without cache: full causal self-attention over ``tokens``.
     With cache: ``tokens`` are appended at ``cache.length`` and attend to
@@ -312,11 +397,11 @@ def forward(
         with jax.default_matmul_precision(c.matmul_precision):
             out = _forward_impl(params, c, tokens, cache=cache,
                                 positions=positions, attn_mask=attn_mask,
-                                mesh=mesh)
+                                mesh=mesh, fresh_cache=fresh_cache)
     else:
         out = _forward_impl(params, c, tokens, cache=cache,
                             positions=positions, attn_mask=attn_mask,
-                            mesh=mesh)
+                            mesh=mesh, fresh_cache=fresh_cache)
     logits, new_cache, aux = out
     if with_aux:
         return logits, new_cache, aux
@@ -324,7 +409,7 @@ def forward(
 
 
 def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
-                  mesh=None):
+                  mesh=None, fresh_cache=False):
     b, s = tokens.shape
     x = params["embed"][tokens]  # gather; sharded vocab → XLA collective
 
@@ -349,27 +434,95 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
         new_cache = None
     else:
         max_len = cache.k.shape[2]
-        # kv validity: only slots < length + s are real.
-        kv_pos = jnp.arange(max_len)[None, :]
         length = cache.length
-        bound = (length[:, None] if length.ndim == 1 else length) + s
-        valid = jnp.broadcast_to(kv_pos < bound, (b, max_len))
-        if attn_mask is not None:
-            valid = valid & attn_mask
+        if _is_ring(c, max_len):
+            # Ring cache: capacity `cap` slots written at pos % cap.
+            #
+            # s == 1 (decode): write-then-attend is safe — the single new
+            # token only overwrites the slot holding pos qp − cap, which
+            # is outside its own window (cap ≥ window). Ring index i then
+            # holds absolute position p(i) = the latest p ≡ i (mod cap);
+            # the query may attend iff 0 ≤ p(i) ≤ qp > qp − window. Fill,
+            # causality and the window all live in this one mask —
+            # attention() runs with causal=False (ring index is NOT
+            # absolute position).
+            #
+            # s > 1 (chunked prefill): write-first would DESTROY keys
+            # still inside earlier queries' windows whenever the chunk
+            # wraps (any wrapping chunk when cap == window), so _layer
+            # attends BEFORE writing, over [pre-write cache ‖ chunk]:
+            # the mask here is (B, s, cap + s) — old slots valid by their
+            # pre-chunk positions, intra-chunk causal+window on the tail.
+            cap = max_len
+            if s > cap:
+                raise ValueError(
+                    f"chunk of {s} tokens exceeds the ring capacity "
+                    f"{cap} (window {c.sliding_window}); prefill in "
+                    f"chunks of at most the window size")
+            base = length[:, None, None] if length.ndim == 1 else length
+            i = jnp.arange(cap)[None, None, :]
+            qp = base + jnp.arange(s)[None, :, None]           # query abs pos
+            if s == 1:
+                if attn_mask is not None:
+                    raise NotImplementedError(
+                        "attn_mask on a ring-cache decode step: ring "
+                        "indices are modular positions — combine masks "
+                        "upstream instead")
+                total = base + 1                               # after write
+                p = (total - 1) - ((total - 1 - i) % cap)      # pos per slot
+                valid = (p >= 0) & (p <= qp) & (p > qp - c.sliding_window)
+                valid = jnp.broadcast_to(valid, (b, 1, cap))
+            else:
+                t = jnp.arange(s)[None, None, :]               # chunk kv idx
+                j = jnp.arange(s)[None, :, None]               # chunk q idx
+                valid_new = (t <= j) & (j - t < c.sliding_window)
+                if attn_mask is not None:
+                    # Contract (serving-engine prefill): only meaningful
+                    # on a FRESH slot (length == 0, nothing old to mask);
+                    # positions then coincide with chunk indices.
+                    valid_new = valid_new & attn_mask[:, None, :s]
+                if fresh_cache:
+                    # Chunk-width mask: _layer skips the [cache ‖ chunk]
+                    # concat and its fully-masked score columns.
+                    valid = jnp.broadcast_to(valid_new, (b, s, s))
+                else:
+                    p_old = (base - 1) - ((base - 1 - i) % cap)  # pre-chunk
+                    valid_old = ((p_old >= 0)
+                                 & (p_old > qp - c.sliding_window))
+                    valid = jnp.concatenate(
+                        [jnp.broadcast_to(valid_old, (b, s, cap)),
+                         jnp.broadcast_to(valid_new, (b, s, s))], axis=-1)
+        else:
+            # kv validity: only slots < length + s are real.
+            kv_pos = jnp.arange(max_len)[None, :]
+            bound = (length[:, None] if length.ndim == 1 else length) + s
+            valid = jnp.broadcast_to(kv_pos < bound, (b, max_len))
+            if attn_mask is not None:
+                valid = valid & attn_mask
         # Flash-decode applies only when the validity mask is exactly
-        # "pos < length + 1" (single new token, no extra mask) and the
+        # "pos < valid_count" (single new token, no extra mask) and the
         # cache splits into proper KV blocks: either 128-aligned (the
         # streamed multi-block grid) or small enough that one whole-cache
         # block still fits VMEM comfortably. An unaligned LARGE cache
         # would degenerate to block_kv = max_len — no per-slot skipping
         # and a VMEM-busting block — so it falls back to einsum instead.
+        # SWA eligibility: a RING cache qualifies exactly when capacity
+        # == window (live entries are indices < min(length+1, cap), all
+        # inside the window, and online softmax is order-invariant; cap >
+        # window would leave stale slots the length model can't mask). An
+        # ABSOLUTE short cache (cap < aligned window) qualifies when cap
+        # ≤ window: every position it can hold is within any query's
+        # window, so the plain "pos < length+1" model is already exact.
         tileable = (max_len % 128 == 0
                     or (max_len % 8 == 0 and max_len <= 512))
-        # Sliding window changes the valid-kv lower bound; flash_decode
-        # only models "pos < length + 1", so SWA configs stay on einsum.
+        if c.sliding_window is None:
+            swa_flash = True
+        elif _is_ring(c, max_len):
+            swa_flash = max_len == c.sliding_window
+        else:
+            swa_flash = max_len <= c.sliding_window
         flash_ok = (c.decode_attn_impl == "flash" and s == 1
-                    and attn_mask is None and tileable
-                    and c.sliding_window is None)
+                    and attn_mask is None and tileable and swa_flash)
 
         if cache.quantized:
             def body_q(carry, inputs):

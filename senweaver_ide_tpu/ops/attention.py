@@ -53,7 +53,8 @@ def attention(
     v: jnp.ndarray,            # (B, Skv, Hkv, D)
     *,
     q_offset=0,
-    kv_mask: Optional[jnp.ndarray] = None,   # (B, Skv) True = valid
+    kv_mask: Optional[jnp.ndarray] = None,   # (B, Skv) or (B, Sq, Skv),
+                                             # True = valid
     causal: bool = True,
     window: Optional[int] = None,            # sliding-window width
 ) -> jnp.ndarray:
@@ -97,7 +98,11 @@ def attention(
             else mask[:, None, None]
         scores = jnp.where(mask, scores, NEG_INF)
     if kv_mask is not None:
-        scores = jnp.where(kv_mask[:, None, None, None, :], scores, NEG_INF)
+        if kv_mask.ndim == 3:     # per-query validity (ring-cache SWA)
+            km = kv_mask[:, None, None, :, :]
+        else:
+            km = kv_mask[:, None, None, None, :]
+        scores = jnp.where(km, scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
     if exact:

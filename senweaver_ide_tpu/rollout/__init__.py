@@ -3,6 +3,7 @@ from .checkpoints import (CheckpointEntry, ConversationCheckpoints,
 from .engine import RolloutEngine
 from .policy_client import EnginePolicyClient, render_chat_template
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
+                      prefill_chunked,
                       prefill)
 from .session import RolloutSession, TurnResult
 from .speculative import SpeculativeDecoder

@@ -73,7 +73,7 @@ def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
     kv_pos = jnp.arange(max_len)[None, :]
     attn_mask = kv_pos < true_len
     logits, sub = forward(params, config, tokens, cache=sub,
-                          attn_mask=attn_mask)
+                          attn_mask=attn_mask, fresh_cache=True)
 
     new_k = jax.lax.dynamic_update_slice(cache.k, sub.k, (0, slot, 0, 0, 0))
     new_v = jax.lax.dynamic_update_slice(cache.v, sub.v, (0, slot, 0, 0, 0))
@@ -128,7 +128,13 @@ class RolloutEngine:
                  mesh=None):
         self.config = config
         self.num_slots = num_slots
-        self.max_len = max_len
+        # Sliding-window configs serve from a ring cache: the pool holds
+        # `ring_capacity` slots per sequence (the SWA memory win), and
+        # prompts must fit one ring chunk — `max_len` is clamped so the
+        # submit() guard reports the real bound. Decode past the window
+        # keeps working indefinitely (modular writes).
+        from ..models.transformer import ring_capacity
+        self.max_len = max_len = ring_capacity(config, max_len)
         self.sample = sample
         self.eos_id = eos_id
         # Optional tensor-parallel serving: params take the Megatron
@@ -252,7 +258,15 @@ class RolloutEngine:
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = req.eos_id is not None and tok == req.eos_id
             out_of_budget = len(req.tokens) >= req.max_new_tokens
-            out_of_cache = int(lengths[slot]) >= self.max_len - 1
+            # Ring caches never run out of slots (modular writes); the
+            # bound there is the model's position budget. A short SWA
+            # pool (cap < window) is ABSOLUTE — it fills like a plain
+            # cache and must stop at capacity.
+            from ..models.transformer import _is_ring
+            ring = _is_ring(self.config, self.max_len)
+            cache_bound = (self.config.max_seq_len if ring
+                           else self.max_len)
+            out_of_cache = int(lengths[slot]) >= cache_bound - 1
             if hit_eos or out_of_budget or out_of_cache:
                 req.done = True
                 req.slot = None

@@ -33,17 +33,43 @@ class SampleParams(NamedTuple):
     top_p: float = 0.95
 
 
-@functools.partial(jax.jit, static_argnames=("config",),
+@functools.partial(jax.jit, static_argnames=("config", "fresh_cache"),
                    donate_argnames=("cache",))
 def prefill(params: Params, config: ModelConfig, tokens: jax.Array,
-            cache: KVCache) -> Tuple[jax.Array, KVCache]:
+            cache: KVCache, *,
+            fresh_cache: bool = False) -> Tuple[jax.Array, KVCache]:
     """Run the prompt through the model; returns (last-token logits, cache).
 
     The cache argument is DONATED (the caller always replaces it): without
     aliasing, in+out cache buffers coexist and a 6.7b b16 serving config
-    that fits in 16 GB HBM with donation ResourceExhausts without it."""
-    logits, cache = forward(params, config, tokens, cache=cache)
+    that fits in 16 GB HBM with donation ResourceExhausts without it.
+
+    ``fresh_cache`` (static) promises the cache holds nothing yet — the
+    ring-cache (SWA) chunk path then skips attending over the empty
+    cache half entirely."""
+    logits, cache = forward(params, config, tokens, cache=cache,
+                            fresh_cache=fresh_cache)
     return logits[:, -1, :], cache
+
+
+def prefill_chunked(params: Params, config: ModelConfig, prompt: jax.Array,
+                    cache: KVCache) -> Tuple[jax.Array, KVCache]:
+    """Prefill a prompt of any length into a FRESH cache.
+
+    Ring (sliding-window) caches bound chunk size by their capacity, so
+    prompts longer than the window stream through in capacity-sized
+    chunks — this is how mistral-7b (window 4096) accepts a 32k prompt
+    while holding 4096 KV slots. Non-SWA configs take the single-shot
+    path unchanged."""
+    cap = cache.k.shape[2]
+    s = prompt.shape[1]
+    if s <= cap:
+        return prefill(params, config, prompt, cache, fresh_cache=True)
+    logits = None
+    for lo in range(0, s, cap):
+        logits, cache = prefill(params, config, prompt[:, lo:lo + cap],
+                                cache, fresh_cache=(lo == 0))
+    return logits, cache
 
 
 @functools.partial(jax.jit, static_argnames=("config", "sample"),
@@ -77,7 +103,7 @@ def generate(
     b, s = prompt.shape
     max_len = max_len or min(config.max_seq_len, s + max_new_tokens)
     cache = init_kv_cache(config, b, max_len)
-    logits, cache = prefill(params, config, prompt, cache)
+    logits, cache = prefill_chunked(params, config, prompt, cache)
 
     tok = sample_token(logits, key, temperature=sample.temperature,
                        top_k=sample.top_k, top_p=sample.top_p)
@@ -114,10 +140,21 @@ def generate_scan(
 ) -> Tuple[jax.Array, KVCache]:
     """Fully-jitted decode: prefill + scan over max_new_tokens steps.
 
-    Device-resident; the benchmark path. eos handling keeps shapes static by
-    overwriting post-eos tokens with eos_id.
+    Device-resident; the benchmark path. ``cache`` must be freshly
+    initialized (nothing prefilled). eos handling keeps shapes static by
+    overwriting post-eos tokens with eos_id; ring (SWA) caches prefill
+    prompts longer than their capacity in capacity-sized chunks.
     """
-    logits, cache = forward(params, config, prompt, cache=cache)
+    cap = cache.k.shape[2]
+    s_prompt = prompt.shape[1]
+    if s_prompt > cap:
+        logits = None
+        for lo in range(0, s_prompt, cap):
+            logits, cache = forward(params, config, prompt[:, lo:lo + cap],
+                                    cache=cache, fresh_cache=(lo == 0))
+    else:
+        logits, cache = forward(params, config, prompt, cache=cache,
+                                fresh_cache=True)
     tok0 = sample_token(logits[:, -1, :], key,
                         temperature=sample.temperature,
                         top_k=sample.top_k, top_p=sample.top_p)

@@ -77,6 +77,16 @@ class SpeculativeDecoder:
             raise ValueError(
                 "draft and target must share a vocabulary "
                 f"({draft_config.vocab_size} vs {target_config.vocab_size})")
+        if (target_config.sliding_window is not None
+                or draft_config.sliding_window is not None):
+            # Rollback (_truncate) relies on stale entries past `length`
+            # being masked, but a ring cache physically OVERWRITES slot
+            # pos % cap: rejected draft writes destroy in-window keys and
+            # cannot be undone by resetting length.
+            raise ValueError(
+                "speculative decoding does not support sliding-window "
+                "(ring-cache) configs: draft rejection cannot roll back "
+                "overwritten ring slots — use sampler.generate instead")
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
         self.tp, self.tc = target_params, target_config

@@ -137,3 +137,284 @@ def test_mistral_preset_and_guards():
     params = init_params(bad, jax.random.PRNGKey(0))
     with pytest.raises(NotImplementedError, match="sliding_window"):
         forward(params, bad, jnp.ones((1, 8), jnp.int32))
+
+
+# ---- ring-buffer KV cache (the memory benefit of SWA) ----
+
+def test_ring_cache_capacity_bounded():
+    from senweaver_ide_tpu.models.transformer import ring_capacity
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    cache = init_kv_cache(cfg, 2, 100)
+    assert cache.k.shape[2] == 8           # window rounded to lane multiple
+    assert ring_capacity(cfg, 100) == 8
+    assert ring_capacity(cfg, 6) == 6      # never larger than requested
+    assert ring_capacity(tiny_test(), 100) == 100
+
+
+def test_ring_decode_long_sequence_matches_full(rng):
+    """Incremental decode through a WRAPPING ring cache (20 tokens, cap 8)
+    must equal the no-cache SWA forward at every step."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 20)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 2, 64)      # cap = 8 regardless
+    assert cache.k.shape[2] == 8
+    for i in range(20):
+        lg, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        np.testing.assert_allclose(np.asarray(full[:, i:i + 1]),
+                                   np.asarray(lg), atol=3e-4,
+                                   err_msg=f"step {i}")
+
+
+def test_ring_chunked_prefill_with_wrap(rng):
+    """Chunked prefill whose chunks wrap the ring (5+4+3 tokens, cap 8)."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 12)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 1, 32)
+    got = []
+    for lo, hi in [(0, 5), (5, 9), (9, 12)]:
+        lg, cache = forward(params, cfg, toks[:, lo:hi], cache=cache)
+        got.append(lg)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate(got, axis=1)),
+                               atol=3e-4)
+
+
+def test_ring_chunk_larger_than_capacity_raises():
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    cache = init_kv_cache(cfg, 1, 32)
+    with pytest.raises(ValueError, match="ring capacity"):
+        forward(params, cfg, jnp.ones((1, 9), jnp.int32), cache=cache)
+
+
+def test_ring_per_slot_lengths_match_scalar(rng):
+    """The per-slot (continuous batching) ring path must agree with the
+    scalar-length path at equal fill."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 11)), jnp.int32)
+
+    scalar_cache = init_kv_cache(cfg, 2, 32)
+    for i in range(10):
+        lg_s, scalar_cache = forward(params, cfg, toks[:, i:i + 1],
+                                     cache=scalar_cache)
+
+    vec_cache = init_kv_cache(cfg, 2, 32)
+    vec_cache = vec_cache._replace(length=jnp.zeros((2,), jnp.int32))
+    for i in range(10):
+        lg_v, vec_cache = forward(params, cfg, toks[:, i:i + 1],
+                                  cache=vec_cache)
+    np.testing.assert_allclose(np.asarray(lg_s), np.asarray(lg_v),
+                               atol=2e-4)
+
+
+def test_ring_flash_decode_matches_einsum(rng):
+    """cap == window makes the ring eligible for flash-decode; both
+    decode impls must agree across a wrap (seq 24, window 16)."""
+    base = dataclasses.replace(tiny_test(), sliding_window=16)
+    flash = dataclasses.replace(base, decode_attn_impl="flash")
+    params = init_params(base, jax.random.PRNGKey(3))
+    toks = jnp.asarray(rng.integers(0, base.vocab_size, (2, 24)), jnp.int32)
+
+    caches = {"einsum": init_kv_cache(base, 2, 64),
+              "flash": init_kv_cache(flash, 2, 64)}
+    assert caches["flash"].k.shape[2] == 16
+    for i in range(24):
+        lg_e, caches["einsum"] = forward(params, base, toks[:, i:i + 1],
+                                         cache=caches["einsum"])
+        lg_f, caches["flash"] = forward(params, flash, toks[:, i:i + 1],
+                                        cache=caches["flash"])
+        np.testing.assert_allclose(np.asarray(lg_e), np.asarray(lg_f),
+                                   atol=3e-4, err_msg=f"step {i}")
+
+
+def test_ring_int8_cache_parity(rng):
+    """Quantized ring writes (values AND scales at modular indices)."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4, kv_quant=True)
+    ref = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(ref, jax.random.PRNGKey(4))
+    toks = jnp.asarray(rng.integers(0, ref.vocab_size, (1, 14)), jnp.int32)
+
+    qc = init_kv_cache(cfg, 1, 32)
+    fc = init_kv_cache(ref, 1, 32)
+    assert qc.quantized and qc.k.dtype == jnp.int8
+    for i in range(14):
+        lq, qc = forward(params, cfg, toks[:, i:i + 1], cache=qc)
+        lf, fc = forward(params, ref, toks[:, i:i + 1], cache=fc)
+        # int8 cache is lossy; logits must stay close, not identical
+        assert float(jnp.max(jnp.abs(lq - lf))) < 0.15, f"step {i}"
+
+
+def test_ring_wrapping_chunks_cap_equals_window(rng):
+    """cap == window (the mistral-7b shape): EVERY wrapping chunk used to
+    overwrite keys still inside earlier queries' windows before attention
+    ran. Window-sized chunks across 3 wraps must match the full forward."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 24)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 2, 64)
+    assert cache.k.shape[2] == 8                     # cap == window
+    got = []
+    for lo in range(0, 24, 8):                       # window-sized chunks
+        lg, cache = forward(params, cfg, toks[:, lo:lo + 8], cache=cache)
+        got.append(lg)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate(got, axis=1)),
+                               atol=3e-4)
+
+
+def test_ring_wrapping_chunks_mixed_sizes(rng):
+    """Chunk sizes straddling the cap−window slack (window 4, cap 8,
+    chunks of 6: s−1 > cap−window) across several wraps."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(6))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 18)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 1, 64)
+    got = []
+    for lo, hi in [(0, 6), (6, 12), (12, 18)]:
+        lg, cache = forward(params, cfg, toks[:, lo:hi], cache=cache)
+        got.append(lg)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate(got, axis=1)),
+                               atol=3e-4)
+
+
+def test_speculative_rejects_ring_configs():
+    from senweaver_ide_tpu.rollout.speculative import SpeculativeDecoder
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    plain = tiny_test()
+    p1 = init_params(cfg, jax.random.PRNGKey(0))
+    p2 = init_params(plain, jax.random.PRNGKey(1))
+    with pytest.raises(ValueError, match="ring-cache"):
+        SpeculativeDecoder(p1, cfg, p2, plain)
+    with pytest.raises(ValueError, match="ring-cache"):
+        SpeculativeDecoder(p2, plain, p1, cfg)
+
+
+def test_engine_serves_sliding_window_config(rng):
+    """RolloutEngine on an SWA config: ring-sized pool, prefill through
+    the padding mask, decode past the window — tokens must match the
+    plain sampler.generate greedy path."""
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams, generate
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    prompt = [int(x) for x in rng.integers(1, 500, 5)]
+
+    eng = RolloutEngine(params, cfg, num_slots=2, max_len=64,
+                        sample=SampleParams(temperature=0.0))
+    assert eng.cache.k.shape[2] == 8                 # ring-sized pool
+    rid = eng.submit(prompt, max_new_tokens=12)      # decodes past window
+    out = eng.run()[rid]
+
+    ref = generate(params, cfg,
+                   jnp.asarray([prompt], jnp.int32), max_new_tokens=12,
+                   sample=SampleParams(temperature=0.0),
+                   key=jax.random.PRNGKey(0), max_len=64)
+    assert out == [int(t) for t in np.asarray(ref[0])]
+
+
+def test_generate_long_prompt_chunks_through_ring(rng):
+    """A prompt LONGER than the ring capacity must stream through in
+    chunks (the mistral-7b 32k-prompt-on-a-4096-ring path) and continue
+    into greedy decode matching a teacher-forced no-cache oracle."""
+    from senweaver_ide_tpu.rollout.sampler import SampleParams, generate
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(8))
+    prompt = jnp.asarray(rng.integers(1, 500, (1, 20)), jnp.int32)
+
+    got = generate(params, cfg, prompt, max_new_tokens=6,
+                   sample=SampleParams(temperature=0.0),
+                   key=jax.random.PRNGKey(0), max_len=64)
+
+    seq = [int(t) for t in np.asarray(prompt[0])]
+    want = []
+    for _ in range(6):                       # teacher-forced argmax oracle
+        logits, _ = forward(params, cfg, jnp.asarray([seq], jnp.int32))
+        tok = int(jnp.argmax(logits[0, -1]))
+        want.append(tok)
+        seq.append(tok)
+    assert [int(t) for t in np.asarray(got[0])] == want
+
+
+def test_generate_scan_long_prompt_chunks(rng):
+    """generate_scan (the jitted bench path) chunk-prefills long prompts
+    identically to the host-loop generate."""
+    from senweaver_ide_tpu.rollout.sampler import (SampleParams, generate,
+                                                   generate_scan)
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(9))
+    prompt = jnp.asarray(rng.integers(1, 500, (2, 19)), jnp.int32)
+    sp = SampleParams(temperature=0.0)
+
+    host = generate(params, cfg, prompt, max_new_tokens=5, sample=sp,
+                    key=jax.random.PRNGKey(1), max_len=32)
+    cache = init_kv_cache(cfg, 2, 32)
+    dev, _ = generate_scan(params, cfg, prompt, cache,
+                           jax.random.PRNGKey(1), max_new_tokens=5,
+                           sample=sp)
+    np.testing.assert_array_equal(np.asarray(host), np.asarray(dev))
+
+
+def test_short_swa_cache_uses_absolute_mode(rng):
+    """cap < window: no wrap can ever occur, writes are contiguous, and
+    the positional window mask applies — decode parity with the full
+    forward, plus the decode bound stops at capacity (engine semantics)."""
+    from senweaver_ide_tpu.models.transformer import _is_ring
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    cache = init_kv_cache(cfg, 1, 6)              # 6 < aligned window 8
+    assert cache.k.shape[2] == 6
+    assert not _is_ring(cfg, 6)
+
+    params = init_params(cfg, jax.random.PRNGKey(10))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 6)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+    for i in range(6):
+        lg, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        np.testing.assert_allclose(np.asarray(full[:, i:i + 1]),
+                                   np.asarray(lg), atol=2e-4)
+
+
+def test_engine_short_swa_pool_stops_at_capacity(rng):
+    """An engine pool smaller than the window must behave as a bounded
+    absolute cache: decode STOPS at capacity instead of silently
+    shrinking the window by wrapping."""
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=64)
+    params = init_params(cfg, jax.random.PRNGKey(11))
+    eng = RolloutEngine(params, cfg, num_slots=1, max_len=16,
+                        sample=SampleParams(temperature=0.0))
+    assert eng.max_len == 16                      # absolute, not ring
+    rid = eng.submit([5, 6, 7], max_new_tokens=100)
+    out = eng.run()[rid]
+    assert len(out) <= 16 - 3                     # bounded by capacity
+
+
+def test_fresh_cache_hint_changes_nothing(rng):
+    """fresh_cache=True on an actually-fresh ring cache is purely an
+    optimization: logits identical to the default path."""
+    cfg = dataclasses.replace(tiny_test(), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(12))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 7)), jnp.int32)
+
+    lg_a, _ = forward(params, cfg, toks, cache=init_kv_cache(cfg, 1, 32),
+                      fresh_cache=True)
+    lg_b, _ = forward(params, cfg, toks, cache=init_kv_cache(cfg, 1, 32))
+    np.testing.assert_allclose(np.asarray(lg_a), np.asarray(lg_b),
+                               atol=1e-5)
