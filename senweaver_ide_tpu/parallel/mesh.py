@@ -59,6 +59,45 @@ def make_mesh(config: Optional[MeshConfig] = None,
     return Mesh(arr, AXES)
 
 
+def make_hybrid_mesh(config: MeshConfig, num_slices: int,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Multi-slice mesh: dp spans slices over DCN, the rest stays on ICI.
+
+    The scaling recipe for going past one pod slice: gradient all-reduce
+    (dp) is the only collective tolerant of DCN latency/bandwidth, so
+    the dp axis is laid out across slices while fsdp/tp/sp — whose
+    collectives sit inside matmuls and attention — stay within a slice
+    on ICI. Requires ``config.dp % num_slices == 0``.
+
+    Uses ``mesh_utils.create_hybrid_device_mesh`` when the devices carry
+    slice topology (``device.slice_index``, real multi-slice TPU jobs);
+    falls back to grouping contiguous device blocks as virtual slices
+    (CPU-simulated meshes, single-slice tests) — the axis ORDER and
+    therefore the lowered collectives are identical either way.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config.num_devices != len(devices):
+        raise ValueError(
+            f"mesh {config} needs {config.num_devices} devices, "
+            f"got {len(devices)}")
+    if config.dp % num_slices != 0:
+        raise ValueError(
+            f"dp={config.dp} must be a multiple of num_slices={num_slices} "
+            f"(dp is the DCN axis)")
+    per_slice = (config.dp // num_slices, config.fsdp, config.tp, config.sp)
+    if all(getattr(d, "slice_index", None) is not None for d in devices) \
+            and len({d.slice_index for d in devices}) == num_slices:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            per_slice, (num_slices, 1, 1, 1), devices=devices)
+        return Mesh(arr, AXES)
+    block = len(devices) // num_slices
+    groups = [devices[i * block:(i + 1) * block] for i in range(num_slices)]
+    arr = np.stack([np.asarray(g).reshape(per_slice) for g in groups])
+    # (slice, dp/slice, fsdp, tp, sp) → fold slice into dp, outermost.
+    return Mesh(arr.reshape(config.axis_sizes()), AXES)
+
+
 def single_device_mesh() -> Mesh:
     return make_mesh(MeshConfig(), devices=jax.devices()[:1])
 

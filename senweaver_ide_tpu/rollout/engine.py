@@ -133,8 +133,13 @@ class RolloutEngine:
         # prompts must fit one ring chunk — `max_len` is clamped so the
         # submit() guard reports the real bound. Decode past the window
         # keeps working indefinitely (modular writes).
-        from ..models.transformer import ring_capacity
+        from ..models.transformer import _is_ring, ring_capacity
         self.max_len = max_len = ring_capacity(config, max_len)
+        # Decode stop bound, fixed for the engine's lifetime: a ring pool
+        # never runs out of slots (modular writes) and is bounded by the
+        # model's position budget; an absolute pool stops at capacity.
+        self._cache_bound = (config.max_seq_len
+                             if _is_ring(config, max_len) else max_len)
         self.sample = sample
         self.eos_id = eos_id
         # Optional tensor-parallel serving: params take the Megatron
@@ -258,15 +263,7 @@ class RolloutEngine:
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = req.eos_id is not None and tok == req.eos_id
             out_of_budget = len(req.tokens) >= req.max_new_tokens
-            # Ring caches never run out of slots (modular writes); the
-            # bound there is the model's position budget. A short SWA
-            # pool (cap < window) is ABSOLUTE — it fills like a plain
-            # cache and must stop at capacity.
-            from ..models.transformer import _is_ring
-            ring = _is_ring(self.config, self.max_len)
-            cache_bound = (self.config.max_seq_len if ring
-                           else self.max_len)
-            out_of_cache = int(lengths[slot]) >= cache_bound - 1
+            out_of_cache = int(lengths[slot]) >= self._cache_bound - 1
             if hit_eos or out_of_budget or out_of_cache:
                 req.done = True
                 req.slot = None

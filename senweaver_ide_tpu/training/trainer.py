@@ -130,6 +130,110 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                       step=state.step + 1), metrics
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("config", "grpo_config", "num_groups",
+                                    "optimizer", "mesh", "accum_steps"))
+def _grpo_step_accum(state: TrainState, config: ModelConfig,
+                     optimizer: optax.GradientTransformation,
+                     tokens: jax.Array, completion_mask: jax.Array,
+                     rewards: jax.Array, group_ids: jax.Array,
+                     old_logp: Optional[jax.Array],
+                     ref_logp: Optional[jax.Array],
+                     grpo_config: GRPOConfig,
+                     num_groups: int,
+                     accum_steps: int,
+                     mesh: Optional[Mesh] = None,
+                     ) -> Tuple[TrainState, Dict[str, jax.Array]]:
+    """Gradient-accumulated GRPO step: the batch splits into
+    ``accum_steps`` microbatches scanned sequentially, holding only one
+    microbatch's activations at a time — how a 7B policy trains on long
+    trajectories that don't fit as one batch (SURVEY.md §7 hard part
+    'long-trajectory memory', alongside remat and ring attention).
+
+    Equivalence to the monolithic step: advantages are group-relative
+    over the FULL batch (computed before the split — group members may
+    land in different microbatches), and each microbatch's gradient is
+    weighted by its share of completion tokens, so the accumulated
+    gradient equals the full-batch token-normalized objective's. The MoE
+    aux loss uses the same weights (token-share weighting of a
+    batch-mean term — exact when microbatches have equal token counts).
+    """
+    b = tokens.shape[0]
+    if b % accum_steps != 0:
+        raise ValueError(f"batch {b} not divisible by accum_steps "
+                         f"{accum_steps}")
+    adv = group_relative_advantages(
+        rewards, group_ids, num_groups,
+        normalize_std=grpo_config.normalize_std,
+        min_std=grpo_config.min_group_std)
+
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    tgt_mask = completion_mask[:, 1:]
+    total_denom = jnp.maximum(jnp.sum(tgt_mask), 1.0)
+
+    def micro(x):
+        return x.reshape(accum_steps, b // accum_steps, *x.shape[1:])
+
+    # lax.scan xs can't carry None leaves: absent ref_logp scans zeros
+    # and the static has_ref closure keeps the KL term genuinely off.
+    has_ref = ref_logp is not None
+    has_old = old_logp is not None
+    zeros_f32 = jnp.zeros_like(micro(targets), dtype=jnp.float32)
+    scan_xs = (micro(inputs), micro(targets), micro(tgt_mask), micro(adv),
+               micro(ref_logp) if has_ref else zeros_f32,
+               micro(old_logp) if has_old else zeros_f32)
+
+    def loss_fn(params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old):
+        logits, _, moe_aux = forward(params, config, m_in, with_aux=True,
+                                     mesh=mesh)
+        logp = token_logprobs(logits, m_tgt)
+        olp = m_old if has_old else jax.lax.stop_gradient(logp)
+        loss, metrics = grpo_objective(logp, olp, m_adv, m_mask, grpo_config,
+                                       ref_logp=m_ref if has_ref else None)
+        if config.num_experts > 0:
+            loss = loss + grpo_config.moe_aux_coef * moe_aux
+        return loss, (metrics, moe_aux)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    zero_grads = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+
+    # Same metrics schema as the monolithic step: every per-token-
+    # normalized metric weight-sums across microbatches exactly like the
+    # loss does.
+    acc_keys = ("pg_loss", "kl", "ratio_mean", "clip_frac")
+
+    def body(carry, m):
+        grads_acc, loss_acc, metr_acc = carry
+        m_in, m_tgt, m_mask, m_adv, m_ref, m_old = m
+        (loss, (metrics, moe_aux)), grads = grad_fn(
+            state.params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old)
+        w = jnp.maximum(jnp.sum(m_mask), 0.0) / total_denom
+        grads_acc = jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32) * w, grads_acc, grads)
+        metr_acc = {k: metr_acc[k] + metrics[k] * w for k in acc_keys}
+        metr_acc["moe_aux"] = metr_acc.get("moe_aux", 0.0) + moe_aux * w
+        return (grads_acc, loss_acc + loss * w, metr_acc), None
+
+    zero_metrics = {k: jnp.zeros(()) for k in acc_keys}
+    zero_metrics["moe_aux"] = jnp.zeros(())
+    (grads, loss, metr), _ = jax.lax.scan(
+        body, (zero_grads, jnp.zeros(()), zero_metrics), scan_xs)
+    grads = jax.tree_util.tree_map(
+        lambda g, p: g.astype(p.dtype), grads, state.params)
+
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    metrics = dict(metr)
+    if config.num_experts == 0:
+        del metrics["moe_aux"]
+    metrics["loss"] = loss
+    metrics["grad_norm"] = optax.global_norm(grads)
+    metrics["adv_mean"] = jnp.mean(adv)
+    return TrainState(params=params, opt_state=opt_state,
+                      step=state.step + 1), metrics
+
+
 # Default optimizer instance reused across steps (hashable for jit statics).
 _DEFAULT_OPT = make_optimizer()
 
@@ -142,16 +246,24 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
                grpo_config: GRPOConfig = GRPOConfig(),
                optimizer: Optional[optax.GradientTransformation] = None,
                num_groups: Optional[int] = None,
+               accum_steps: int = 1,
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """One GRPO update. tokens: (B, S) prompt+completion; completion_mask True
     on completion positions; rewards: (B,) finalReward; group_ids: (B,) prompt
-    group of each trajectory."""
+    group of each trajectory. ``accum_steps > 1`` splits the batch into
+    sequentially-scanned microbatches (one microbatch of activations
+    resident at a time) with token-share-weighted gradient accumulation —
+    equivalent update, fraction of the memory."""
     opt = optimizer or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
+    args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
+            old_logp, ref_logp, grpo_config, n_groups)
+    if accum_steps > 1:
+        step_fn = functools.partial(_grpo_step_accum,
+                                    accum_steps=accum_steps)
+    else:
+        step_fn = _grpo_step
     if mesh is not None:
         with mesh:
-            return _grpo_step(state, config, opt, tokens, completion_mask,
-                              rewards, group_ids, old_logp, ref_logp,
-                              grpo_config, n_groups, mesh)
-    return _grpo_step(state, config, opt, tokens, completion_mask, rewards,
-                      group_ids, old_logp, ref_logp, grpo_config, n_groups)
+            return step_fn(*args, mesh=mesh)
+    return step_fn(*args)
