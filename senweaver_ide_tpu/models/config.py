@@ -62,6 +62,13 @@ class ModelConfig:
     # programs; TPU loop overhead per scan iteration is material at
     # sq=1, and unrolling trades compile time for it. 1 = no unroll.
     scan_unroll: int = 1
+    # Rematerialize layer activations in the no-cache (training) path:
+    # jax.checkpoint around each scanned layer, so backward recomputes
+    # activations instead of saving L layers of them — the HBM-for-FLOPs
+    # trade that fits 7B long-trajectory batches (with ring attention and
+    # train_step(accum_steps=...)). "dots" saves matmul outputs only
+    # (checkpoint_dots); True/"full" saves nothing.
+    remat: object = False    # False | True | "full" | "dots"
     # jax.default_matmul_precision for the forward pass. None = platform
     # default (bf16 MXU passes — the fast path for real models). The fp32
     # test config pins "highest" so cache-vs-full decode parity is exact.

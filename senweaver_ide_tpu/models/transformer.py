@@ -422,10 +422,29 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
     cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
 
     if cache is None:
-        def body(carry, lp):
-            x, aux = carry
+        def one_layer(x, lp, cos, sin):
             x, _, layer_aux = _layer(c, lp, x, cos, sin, None, attn_mask,
                                      mesh=mesh)
+            return x, layer_aux
+
+        if c.remat:
+            # Per-layer rematerialization: backward recomputes this
+            # layer's activations instead of holding all L layers' —
+            # O(1) activation memory in depth for O(L) extra forward
+            # FLOPs. "dots" keeps matmul outputs (cheaper backward,
+            # more memory); True/"full" keeps nothing.
+            policy = (jax.checkpoint_policies.checkpoint_dots
+                      if c.remat == "dots" else None)
+            # prevent_cse=False: under lax.scan the CSE barrier is
+            # unnecessary (per the jax.checkpoint docs) and its
+            # optimization_barrier ops would block fusion across every
+            # layer boundary of the training hot path.
+            one_layer = jax.checkpoint(one_layer, policy=policy,
+                                       prevent_cse=False)
+
+        def body(carry, lp):
+            x, aux = carry
+            x, layer_aux = one_layer(x, lp, cos, sin)
             return (x, aux + layer_aux), None
 
         (x, aux_total), _ = jax.lax.scan(

@@ -70,10 +70,11 @@ def make_hybrid_mesh(config: MeshConfig, num_slices: int,
     on ICI. Requires ``config.dp % num_slices == 0``.
 
     Uses ``mesh_utils.create_hybrid_device_mesh`` when the devices carry
-    slice topology (``device.slice_index``, real multi-slice TPU jobs);
-    falls back to grouping contiguous device blocks as virtual slices
-    (CPU-simulated meshes, single-slice tests) — the axis ORDER and
-    therefore the lowered collectives are identical either way.
+    slice topology (``device.slice_index``, real multi-slice TPU jobs) —
+    and REFUSES a num_slices that contradicts it. Devices without slice
+    topology (CPU-simulated meshes, single-slice tests) group contiguous
+    blocks as virtual slices; the axis order matches the real case, so
+    sharding code developed against the virtual layout transfers.
     """
     devices = list(devices if devices is not None else jax.devices())
     if config.num_devices != len(devices):
@@ -85,8 +86,17 @@ def make_hybrid_mesh(config: MeshConfig, num_slices: int,
             f"dp={config.dp} must be a multiple of num_slices={num_slices} "
             f"(dp is the DCN axis)")
     per_slice = (config.dp // num_slices, config.fsdp, config.tp, config.sp)
-    if all(getattr(d, "slice_index", None) is not None for d in devices) \
-            and len({d.slice_index for d in devices}) == num_slices:
+    if all(getattr(d, "slice_index", None) is not None for d in devices):
+        real_slices = len({d.slice_index for d in devices})
+        if real_slices != num_slices:
+            # Falling back to contiguous blocking here would stripe
+            # fsdp/tp/sp — whose collectives sit inside every matmul —
+            # across DCN: the exact layout this function exists to
+            # prevent. Refuse instead.
+            raise ValueError(
+                f"devices span {real_slices} physical slices but "
+                f"num_slices={num_slices}; align num_slices with the "
+                f"topology (or pass slice-homogeneous devices)")
         from jax.experimental import mesh_utils
         arr = mesh_utils.create_hybrid_device_mesh(
             per_slice, (num_slices, 1, 1, 1), devices=devices)

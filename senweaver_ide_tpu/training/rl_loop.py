@@ -124,6 +124,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                grpo_config: GRPOConfig = GRPOConfig(),
                reward_override=None,
                max_parallel: int = 8,
+               accum_steps: int = 1,
                metrics_service=None,
                perf_monitor=None,
                profile_dir: Optional[str] = None) -> RoundResult:
@@ -143,6 +144,7 @@ def grpo_round(state: TrainState, model_config, mesh,
     with profile_capture(profile_dir):
         return _grpo_round_impl(
             state, model_config, mesh, make_session, tasks,
+            accum_steps=accum_steps,
             group_size=group_size, pad_id=pad_id, max_len=max_len,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
@@ -151,8 +153,9 @@ def grpo_round(state: TrainState, model_config, mesh,
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      group_size, pad_id, max_len, grpo_config,
-                     reward_override, max_parallel, metrics_service,
-                     perf_monitor) -> RoundResult:
+                     reward_override, max_parallel, accum_steps=1,
+                     metrics_service=None,
+                     perf_monitor=None) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
@@ -207,7 +210,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     t1 = _time.monotonic()
     state, metrics = train_step(
         state, model_config, mesh, tokens, mask, rewards, group_ids,
-        grpo_config=grpo_config)
+        grpo_config=grpo_config, accum_steps=accum_steps)
     out_metrics = {k: float(v) for k, v in metrics.items()}
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step",

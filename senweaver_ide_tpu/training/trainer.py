@@ -86,54 +86,8 @@ def _opt_state_shardings(opt, params, mesh):
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "grpo_config", "num_groups",
-                                    "optimizer", "mesh"))
-def _grpo_step(state: TrainState, config: ModelConfig,
-               optimizer: optax.GradientTransformation,
-               tokens: jax.Array, completion_mask: jax.Array,
-               rewards: jax.Array, group_ids: jax.Array,
-               old_logp: Optional[jax.Array],
-               ref_logp: Optional[jax.Array],
-               grpo_config: GRPOConfig,
-               num_groups: int,
-               mesh: Optional[Mesh] = None,
-               ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    adv = group_relative_advantages(
-        rewards, group_ids, num_groups,
-        normalize_std=grpo_config.normalize_std,
-        min_std=grpo_config.min_group_std)
-
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    tgt_mask = completion_mask[:, 1:]
-
-    def loss_fn(params):
-        logits, _, moe_aux = forward(params, config, inputs, with_aux=True,
-                                     mesh=mesh)
-        logp = token_logprobs(logits, targets)
-        olp = old_logp if old_logp is not None else jax.lax.stop_gradient(logp)
-        loss, metrics = grpo_objective(logp, olp, adv, tgt_mask, grpo_config,
-                                       ref_logp=ref_logp)
-        if config.num_experts > 0:
-            loss = loss + grpo_config.moe_aux_coef * moe_aux
-            metrics = dict(metrics)
-            metrics["moe_aux"] = moe_aux
-        return loss, metrics
-
-    (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-        state.params)
-    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
-    params = optax.apply_updates(state.params, updates)
-    metrics = dict(metrics)
-    metrics["loss"] = loss
-    metrics["grad_norm"] = optax.global_norm(grads)
-    metrics["adv_mean"] = jnp.mean(adv)
-    return TrainState(params=params, opt_state=opt_state,
-                      step=state.step + 1), metrics
-
-
-@functools.partial(jax.jit,
-                   static_argnames=("config", "grpo_config", "num_groups",
                                     "optimizer", "mesh", "accum_steps"))
-def _grpo_step_accum(state: TrainState, config: ModelConfig,
+def _grpo_step(state: TrainState, config: ModelConfig,
                      optimizer: optax.GradientTransformation,
                      tokens: jax.Array, completion_mask: jax.Array,
                      rewards: jax.Array, group_ids: jax.Array,
@@ -144,8 +98,10 @@ def _grpo_step_accum(state: TrainState, config: ModelConfig,
                      accum_steps: int,
                      mesh: Optional[Mesh] = None,
                      ) -> Tuple[TrainState, Dict[str, jax.Array]]:
-    """Gradient-accumulated GRPO step: the batch splits into
-    ``accum_steps`` microbatches scanned sequentially, holding only one
+    """The GRPO step — always the accumulated form; ``accum_steps=1``
+    is a length-1 scan and IS the monolithic step (single implementation,
+    no second code path to keep in sync). Larger ``accum_steps`` splits
+    the batch into sequentially-scanned microbatches holding one
     microbatch's activations at a time — how a 7B policy trains on long
     trajectories that don't fit as one batch (SURVEY.md §7 hard part
     'long-trajectory memory', alongside remat and ring attention).
@@ -257,13 +213,8 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
     opt = optimizer or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
-            old_logp, ref_logp, grpo_config, n_groups)
-    if accum_steps > 1:
-        step_fn = functools.partial(_grpo_step_accum,
-                                    accum_steps=accum_steps)
-    else:
-        step_fn = _grpo_step
+            old_logp, ref_logp, grpo_config, n_groups, accum_steps)
     if mesh is not None:
         with mesh:
-            return step_fn(*args, mesh=mesh)
-    return step_fn(*args)
+            return _grpo_step(*args, mesh=mesh)
+    return _grpo_step(*args)
