@@ -27,6 +27,14 @@ def main() -> None:
     ap.add_argument("--beam-rounds", type=int, default=2)
     args = ap.parse_args()
 
+    if not args.model_dir:
+        # Scripted-policy path: the only device work is the tiny jit
+        # reward head — force CPU via the live config (env vars arrive
+        # too late when a platform plugin pre-imports jax, and a wedged
+        # accelerator tunnel would hang backend init forever).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
     from senweaver_ide_tpu.apo import run_uplift_eval
 
     client = None

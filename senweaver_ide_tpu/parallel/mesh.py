@@ -88,19 +88,21 @@ def make_hybrid_mesh(config: MeshConfig, num_slices: int,
     per_slice = (config.dp // num_slices, config.fsdp, config.tp, config.sp)
     if all(getattr(d, "slice_index", None) is not None for d in devices):
         real_slices = len({d.slice_index for d in devices})
-        if real_slices != num_slices:
-            # Falling back to contiguous blocking here would stripe
-            # fsdp/tp/sp — whose collectives sit inside every matmul —
-            # across DCN: the exact layout this function exists to
-            # prevent. Refuse instead.
+        if real_slices == num_slices:
+            from jax.experimental import mesh_utils
+            arr = mesh_utils.create_hybrid_device_mesh(
+                per_slice, (num_slices, 1, 1, 1), devices=devices)
+            return Mesh(arr, AXES)
+        if real_slices > 1:
+            # Contiguous blocking would stripe fsdp/tp/sp — whose
+            # collectives sit inside every matmul — across DCN: the
+            # exact layout this function exists to prevent. Refuse.
+            # (real_slices == 1 has no DCN to mis-stripe: fall through
+            # to virtual blocking so one slice can rehearse the layout.)
             raise ValueError(
                 f"devices span {real_slices} physical slices but "
-                f"num_slices={num_slices}; align num_slices with the "
-                f"topology (or pass slice-homogeneous devices)")
-        from jax.experimental import mesh_utils
-        arr = mesh_utils.create_hybrid_device_mesh(
-            per_slice, (num_slices, 1, 1, 1), devices=devices)
-        return Mesh(arr, AXES)
+                f"num_slices={num_slices}; set num_slices to the real "
+                f"slice count (or restrict devices to whole slices)")
     block = len(devices) // num_slices
     groups = [devices[i * block:(i + 1) * block] for i in range(num_slices)]
     arr = np.stack([np.asarray(g).reshape(per_slice) for g in groups])
