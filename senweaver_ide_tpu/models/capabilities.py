@@ -45,8 +45,10 @@ _CAPABILITIES: Tuple[Tuple[str, ModelCapabilities], ...] = (
     ("codestral", ModelCapabilities(
         context_window=32_768, supports_fim=True)),
     # Mistral-7B (the local SWA policy preset, models/config.py
-    # mistral_7b): 32k context via the 4096-token sliding window.
-    ("mistral", ModelCapabilities(context_window=32_768)),
+    # mistral_7b): 32k context via the 4096-token sliding window. Keyed
+    # on the full preset name — a bare "mistral" key would also match
+    # remote API models (mistral-large: 128k) and cap them wrongly.
+    ("mistral-7b", ModelCapabilities(context_window=32_768)),
     ("claude", ModelCapabilities(context_window=200_000,
                                  reserved_output_token_space=8192,
                                  max_output_tokens=8192)),

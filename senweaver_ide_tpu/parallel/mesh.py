@@ -70,11 +70,13 @@ def make_hybrid_mesh(config: MeshConfig, num_slices: int,
     on ICI. Requires ``config.dp % num_slices == 0``.
 
     Uses ``mesh_utils.create_hybrid_device_mesh`` when the devices carry
-    slice topology (``device.slice_index``, real multi-slice TPU jobs) —
-    and REFUSES a num_slices that contradicts it. Devices without slice
-    topology (CPU-simulated meshes, single-slice tests) group contiguous
-    blocks as virtual slices; the axis order matches the real case, so
-    sharding code developed against the virtual layout transfers.
+    matching multi-slice topology (``device.slice_index``, real
+    multi-slice TPU jobs) and REFUSES a num_slices that contradicts a
+    genuine multi-slice layout (striping ICI axes across DCN). Devices
+    without slice topology (CPU-simulated meshes) — or a SINGLE real
+    slice, where no DCN exists to mis-stripe — group contiguous blocks
+    as virtual slices for rehearsal; the axis order matches the real
+    case, so sharding code developed against it transfers.
     """
     devices = list(devices if devices is not None else jax.devices())
     if config.num_devices != len(devices):
