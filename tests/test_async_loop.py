@@ -1,0 +1,115 @@
+"""Pipelined sampler/trainer overlap (training/async_loop.py)."""
+
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import tiny_test
+from senweaver_ide_tpu.training import (AsyncGRPOTrainer, GRPOConfig,
+                                        make_train_state)
+from senweaver_ide_tpu.training.async_loop import _Collected
+from senweaver_ide_tpu.training.data import Trajectory
+
+
+class _FakeClient:
+    def __init__(self, rng):
+        self._rng = rng
+        self.call_log = []
+
+
+class _FakeSession:
+    """Minimal session contract for collect_group_trajectories."""
+
+    def __init__(self, rng, delay_s=0.0):
+        self.client = _FakeClient(rng)
+        self._delay = delay_s
+
+    def run_turn(self, task):
+        if self._delay:
+            time.sleep(self._delay)
+        rng = self.client._rng
+        # the episode's one LLM call, appended DURING the turn (the
+        # collect loop slices call_log from its pre-turn length)
+        self.client.call_log.append((list(rng.integers(1, 200, 6)),
+                                     list(rng.integers(1, 200, 5))))
+        return types.SimpleNamespace(trace=None,
+                                     loop=types.SimpleNamespace(steps=1))
+
+    def close(self):
+        pass
+
+
+def _reward(task_idx, g, session):
+    return 1.0 if g % 2 == 0 else -1.0
+
+
+def _make_trainer(state, cfg, rng, **kw):
+    return AsyncGRPOTrainer(
+        state, cfg, None, lambda: _FakeSession(rng),
+        ["t1", "t2"], group_size=2, pad_id=0, max_len=64,
+        reward_override=_reward, max_parallel=2, **kw)
+
+
+def test_async_pipeline_runs_rounds(rng):
+    cfg = tiny_test()
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    published = []
+    trainer = _make_trainer(state, cfg, rng, prefetch=2,
+                            publish_params=lambda p: published.append(p))
+    results = trainer.run(3)
+    assert len(results) == 3
+    assert len(published) == 3                      # weight sync per round
+    for r in results:
+        assert r.staleness in (0, 1, 2)
+        assert np.isfinite(r.metrics["loss"])
+        assert len(r.episodes) == 4
+    # params moved across the run
+    before = jax.tree_util.tree_leaves(state.params)[0]
+    after = jax.tree_util.tree_leaves(results[-1].state.params)[0]
+    assert not jnp.allclose(before, after)
+    # collector thread wound down
+    assert not trainer._thread.is_alive()
+
+
+def test_async_importance_correction_on_stale_batch(rng):
+    """A forced stale batch must route through old_logp under the
+    behavior params (exact importance ratios, not the ratio-1 shortcut)."""
+    cfg = tiny_test()
+    state = make_train_state(cfg, jax.random.PRNGKey(1), None,
+                             learning_rate=1e-2)
+    trainer = _make_trainer(state, cfg, rng)
+
+    behavior_params = trainer.state.params          # frozen reference
+    trajs = [Trajectory(list(rng.integers(1, 200, 6)),
+                        list(rng.integers(1, 200, 5)),
+                        reward=1.0 if i % 2 == 0 else -1.0, group_id=i // 2)
+             for i in range(4)]
+    # one real update so current params != behavior params
+    r0 = trainer._train_on(_Collected(trajs, [], 0, behavior_params), 0.0)
+    assert r0.staleness == 0
+    # now version=1; a batch collected at version 0 is stale by 1
+    r1 = trainer._train_on(_Collected(trajs, [], 0, behavior_params), 0.0)
+    assert r1.staleness == 1
+    assert np.isfinite(r1.metrics["loss"])
+    # behavior != current → ratios move off 1 (clip_frac may still be 0)
+    assert abs(r1.metrics["ratio_mean"] - 1.0) > 1e-6
+
+
+def test_async_collector_error_propagates(rng):
+    cfg = tiny_test()
+    state = make_train_state(cfg, jax.random.PRNGKey(2), None)
+
+    def boom():
+        raise OSError("workspace exploded")
+
+    trainer = AsyncGRPOTrainer(state, cfg, None, boom, ["t"], group_size=1,
+                               reward_override=_reward)
+    with pytest.raises(RuntimeError, match="collector failed"):
+        trainer.run(1)
+    assert isinstance(trainer._error, OSError)
