@@ -6,4 +6,4 @@ from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill_chunked,
                       prefill)
 from .session import RolloutSession, TurnResult
-from .speculative import SpeculativeDecoder
+from .speculative import OnlineDraftLearner, SpeculativeDecoder

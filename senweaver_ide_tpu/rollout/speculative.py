@@ -223,3 +223,96 @@ class SpeculativeDecoder:
             return int(np.argmax(logits))
         p = _softmax(logits, temperature)
         return int(rng.choice(len(p), p=p))
+
+
+# ---- online draft learning (FastGRPO, PAPERS.md) ------------------------
+
+@functools.partial(jax.jit, static_argnames=("config", "optimizer"))
+def _distill_step(params: Params, opt_state, config: ModelConfig,
+                  optimizer, tokens: jax.Array, mask: jax.Array):
+    """One cross-entropy step teaching the draft to imitate sequences the
+    TARGET emitted. tokens: (B, S); mask True on positions whose
+    next-token prediction should be trained (the emitted continuation)."""
+    import optax
+
+    from ..training.grpo import token_logprobs
+
+    def loss_fn(p):
+        logits, _ = forward(p, config, tokens[:, :-1])
+        logp = token_logprobs(logits, tokens[:, 1:])
+        m = mask[:, 1:].astype(jnp.float32)
+        return -(logp * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+class OnlineDraftLearner:
+    """Distill the draft toward the target ONLINE from served outputs.
+
+    FastGRPO's observation (PAPERS.md): during RL the target policy
+    drifts, so a frozen draft's acceptance rate — and with it the
+    speculative speedup — decays. The fix is continual distillation on
+    exactly the sequences the target emits while serving: call
+    :meth:`observe` with each finished (prompt, output) pair and
+    :meth:`step` between serving bursts; the decoder's draft params are
+    swapped in place, so the next ``generate`` proposes with the
+    improved draft. Output distributions are untouched — speculative
+    decoding is exact regardless of draft quality; only the ACCEPTANCE
+    RATE (throughput) moves.
+    """
+
+    def __init__(self, decoder: SpeculativeDecoder, *,
+                 learning_rate: float = 1e-3, buffer_size: int = 256,
+                 max_len: int = 512, pad_id: int = 0, seed: int = 0):
+        import optax
+        self.decoder = decoder
+        self.optimizer = optax.adam(learning_rate)
+        self.opt_state = jax.jit(self.optimizer.init)(decoder.dp)
+        self.buffer: List[Tuple[List[int], List[int]]] = []
+        self.buffer_size = buffer_size
+        self.max_len = max_len
+        self.pad_id = pad_id
+        self.steps = 0
+        self._rng = np.random.default_rng(seed)
+
+    def observe(self, prompt: List[int], output: List[int]) -> None:
+        """Record a served sequence (drop-oldest ring buffer)."""
+        self.buffer.append((list(prompt), list(output)))
+        if len(self.buffer) > self.buffer_size:
+            del self.buffer[:len(self.buffer) - self.buffer_size]
+
+    def step(self, batch_size: int = 8) -> float:
+        """One distillation update over the newest ``batch_size`` pairs.
+        Returns the cross-entropy loss (0.0 when the buffer is empty)."""
+        if not self.buffer:
+            return 0.0
+        # Sample uniformly from the whole buffer (newest-only would
+        # overfit the last burst and waste everything else retained).
+        idx = self._rng.choice(len(self.buffer),
+                               size=min(batch_size, len(self.buffer)),
+                               replace=False)
+        pairs = [self.buffer[i] for i in idx]
+        # Bucket the batch width (powers of two) AND pad the batch rows
+        # to a constant batch_size (all-False mask rows): both axes must
+        # be shape-stable or every distinct (B, width) recompiles the
+        # jitted step.
+        width = 16
+        need = min(self.max_len,
+                   max(len(p) + len(o) for p, o in pairs))
+        while width < need:
+            width *= 2
+        toks = np.full((batch_size, width), self.pad_id, np.int32)
+        mask = np.zeros((batch_size, width), bool)
+        for i, (p, o) in enumerate(pairs):
+            seq = (p + o)[-width:]
+            n_out = min(len(o), width)
+            toks[i, :len(seq)] = seq
+            mask[i, len(seq) - n_out:len(seq)] = True
+        self.decoder.dp, self.opt_state, loss = _distill_step(
+            self.decoder.dp, self.opt_state, self.decoder.dc,
+            self.optimizer, jnp.asarray(toks), jnp.asarray(mask))
+        self.steps += 1
+        return float(loss)

@@ -123,3 +123,42 @@ def test_vocab_mismatch_rejected(models):
     bad = dataclasses.replace(dc, vocab_size=dc.vocab_size + 1)
     with pytest.raises(ValueError, match="vocabulary"):
         SpeculativeDecoder(target, tc, draft, bad)
+
+
+# ---- online draft learning ----
+
+def test_online_draft_learning_raises_acceptance(rng):
+    """Distilling the draft on target-emitted sequences must raise the
+    acceptance rate on the same prompt distribution, while greedy
+    outputs stay exactly the target's (speculation is always exact)."""
+    import dataclasses
+
+    from senweaver_ide_tpu.rollout.speculative import OnlineDraftLearner
+
+    tc = tiny_test()
+    dc = dataclasses.replace(tc, num_layers=1, name="tiny-draft")
+    tp = init_params(tc, jax.random.PRNGKey(0))
+    dp = init_params(dc, jax.random.PRNGKey(99))   # unrelated init
+    dec = SpeculativeDecoder(tp, tc, dp, dc, k=4)
+    learner = OnlineDraftLearner(dec, learning_rate=3e-2)
+
+    prompts = [[int(x) for x in rng.integers(1, 400, 6)] for _ in range(4)]
+
+    def serve_all():
+        outs = []
+        for pr in prompts:
+            outs.append(dec.generate(pr, max_new_tokens=12))
+        return outs
+
+    base_out = serve_all()
+    base_acc = dec.acceptance_rate
+    for pr, out in zip(prompts, base_out):
+        learner.observe(pr, out)
+    losses = [learner.step(batch_size=4) for _ in range(60)]
+    assert losses[-1] < losses[0]                  # the draft is learning
+
+    dec.rounds = dec.accepted = dec.proposed = 0   # fresh counters
+    new_out = serve_all()
+    new_acc = dec.acceptance_rate
+    assert new_out == base_out                     # exactness invariant
+    assert new_acc > base_acc + 0.1, (base_acc, new_acc)
