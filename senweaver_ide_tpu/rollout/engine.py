@@ -46,35 +46,27 @@ def _bucket(n: int, minimum: int = 16) -> int:
     return b
 
 
-@functools.partial(jax.jit, static_argnames=("config",),
-                   donate_argnames=("cache",))
-def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
-                  true_len: jax.Array, cache: KVCache,
-                  slot: jax.Array) -> tuple[jax.Array, KVCache]:
-    """Prefill one slot. tokens: (1, S_bucket) right-padded; returns
-    (last-real-token logits (V,), updated pool cache)."""
-    L, _, max_len, hkv, dh = cache.k.shape
+def _slice_slot(cache: KVCache, slot: jax.Array,
+                length: jax.Array) -> KVCache:
+    """View one slot of the pool as a B=1 sub-cache at ``length``."""
+    L, _, cap, hkv, dh = cache.k.shape
     sub_k = jax.lax.dynamic_slice(
-        cache.k, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
+        cache.k, (0, slot, 0, 0, 0), (L, 1, cap, hkv, dh))
     sub_v = jax.lax.dynamic_slice(
-        cache.v, (0, slot, 0, 0, 0), (L, 1, max_len, hkv, dh))
+        cache.v, (0, slot, 0, 0, 0), (L, 1, cap, hkv, dh))
     if cache.quantized:          # int8 pool: slice the scales alongside
-        sub_ks = jax.lax.dynamic_slice(
-            cache.k_scale, (0, slot, 0, 0), (L, 1, max_len, hkv))
-        sub_vs = jax.lax.dynamic_slice(
-            cache.v_scale, (0, slot, 0, 0), (L, 1, max_len, hkv))
-        sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32),
-                      k_scale=sub_ks, v_scale=sub_vs)
-    else:
-        sub = KVCache(k=sub_k, v=sub_v, length=jnp.zeros((), jnp.int32))
+        return KVCache(
+            k=sub_k, v=sub_v, length=length,
+            k_scale=jax.lax.dynamic_slice(
+                cache.k_scale, (0, slot, 0, 0), (L, 1, cap, hkv)),
+            v_scale=jax.lax.dynamic_slice(
+                cache.v_scale, (0, slot, 0, 0), (L, 1, cap, hkv)))
+    return KVCache(k=sub_k, v=sub_v, length=length)
 
-    # Mask padding so it can't be attended during prefill; padded positions
-    # are overwritten by subsequent decode steps before they become visible.
-    kv_pos = jnp.arange(max_len)[None, :]
-    attn_mask = kv_pos < true_len
-    logits, sub = forward(params, config, tokens, cache=sub,
-                          attn_mask=attn_mask, fresh_cache=True)
 
+def _writeback_slot(cache: KVCache, sub: KVCache, slot: jax.Array,
+                    new_len: jax.Array) -> KVCache:
+    """Write a B=1 sub-cache back into the pool; set the slot length."""
     new_k = jax.lax.dynamic_update_slice(cache.k, sub.k, (0, slot, 0, 0, 0))
     new_v = jax.lax.dynamic_update_slice(cache.v, sub.v, (0, slot, 0, 0, 0))
     new_ks = new_vs = None
@@ -83,10 +75,67 @@ def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
                                               (0, slot, 0, 0))
         new_vs = jax.lax.dynamic_update_slice(cache.v_scale, sub.v_scale,
                                               (0, slot, 0, 0))
-    new_len = cache.length.at[slot].set(true_len)
+    return KVCache(k=new_k, v=new_v,
+                   length=cache.length.at[slot].set(new_len),
+                   k_scale=new_ks, v_scale=new_vs)
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
+                  true_len: jax.Array, cache: KVCache,
+                  slot: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Prefill one slot. tokens: (1, S_bucket) right-padded; returns
+    (last-real-token logits (V,), updated pool cache)."""
+    max_len = cache.k.shape[2]
+    sub = _slice_slot(cache, slot, jnp.zeros((), jnp.int32))
+
+    # Mask padding so it can't be attended during prefill; padded positions
+    # are overwritten by subsequent decode steps before they become visible.
+    kv_pos = jnp.arange(max_len)[None, :]
+    attn_mask = kv_pos < true_len
+    logits, sub = forward(params, config, tokens, cache=sub,
+                          attn_mask=attn_mask, fresh_cache=True)
     last = logits[0, true_len - 1, :]
-    return last, KVCache(k=new_k, v=new_v, length=new_len,
-                         k_scale=new_ks, v_scale=new_vs)
+    return last, _writeback_slot(cache, sub, slot, true_len)
+
+
+@functools.partial(jax.jit, static_argnames=("config", "fresh"),
+                   donate_argnames=("cache",))
+def _prefill_slot_chunk(params: Params, config: ModelConfig,
+                        tokens: jax.Array, cache: KVCache,
+                        slot: jax.Array, *,
+                        fresh: bool) -> tuple[jax.Array, KVCache]:
+    """One EXACT-SIZE prefill chunk into a slot at its current length.
+
+    The ring-pool long-prompt path: padded chunks are off the table — a
+    pad token physically written into the ring gets attributed a real
+    position by the modular validity mask (silent corruption), so the
+    prompt is instead decomposed into exact chunks (cap-sized + a
+    powers-of-two remainder ladder, bounding the compile set to
+    log2(cap) shapes). ``fresh`` marks the first chunk of a reset slot.
+    """
+    start = cache.length[slot]
+    sub = _slice_slot(cache, slot, start)
+    logits, sub = forward(params, config, tokens, cache=sub,
+                          fresh_cache=fresh)
+    return (logits[0, -1, :],
+            _writeback_slot(cache, sub, slot, start + tokens.shape[1]))
+
+
+def _chunk_sizes(n: int, cap: int) -> list:
+    """n = (n // cap) full chunks + a descending powers-of-two ladder."""
+    sizes = [cap] * (n // cap)
+    r = n % cap
+    p = 1
+    while p * 2 <= max(r, 1):
+        p *= 2
+    while r > 0:
+        while p > r:
+            p //= 2
+        sizes.append(p)
+        r -= p
+    return sizes
 
 
 @functools.partial(jax.jit, static_argnames=("config", "sample"),
@@ -135,11 +184,12 @@ class RolloutEngine:
         # keeps working indefinitely (modular writes).
         from ..models.transformer import _is_ring, ring_capacity
         self.max_len = max_len = ring_capacity(config, max_len)
+        self._ring = _is_ring(config, max_len)
         # Decode stop bound, fixed for the engine's lifetime: a ring pool
         # never runs out of slots (modular writes) and is bounded by the
         # model's position budget; an absolute pool stops at capacity.
         self._cache_bound = (config.max_seq_len
-                             if _is_ring(config, max_len) else max_len)
+                             if self._ring else max_len)
         self.sample = sample
         self.eos_id = eos_id
         # Optional tensor-parallel serving: params take the Megatron
@@ -213,9 +263,14 @@ class RolloutEngine:
                 eos_id: Optional[int]) -> int:
         if not prompt:
             raise ValueError("empty prompt")
-        if len(prompt) >= self.max_len:
+        # Ring pools accept prompts past the window (chunked prefill
+        # keeps only the trailing window, like the model itself);
+        # absolute pools must hold the whole prompt. _cache_bound is
+        # exactly that distinction (set at construction).
+        if len(prompt) >= self._cache_bound:
             raise ValueError(
-                f"prompt length {len(prompt)} ≥ engine max_len {self.max_len}")
+                f"prompt length {len(prompt)} ≥ engine max_len bound "
+                f"{self._cache_bound}")
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid=rid, prompt=list(prompt),
@@ -298,13 +353,30 @@ class RolloutEngine:
             req.slot = slot
             self._slot_req[slot] = req
             true_len = len(req.prompt)
-            bucket = min(_bucket(true_len), self.max_len)
-            padded = req.prompt + [0] * (bucket - true_len)
-            tokens = jnp.asarray(padded, jnp.int32)[None, :]
-            last_logits, self.cache = _prefill_slot(
-                self.params, self.config, tokens,
-                jnp.asarray(true_len, jnp.int32), self.cache,
-                jnp.asarray(slot, jnp.int32))
+            if true_len >= self.max_len and self._ring:
+                # Long prompt on a ring pool: exact-size chunk chain
+                # (see _prefill_slot_chunk). Reset the slot's stale
+                # length first — the chain reads it as its write cursor.
+                self.cache = self.cache._replace(
+                    length=self.cache.length.at[slot].set(0))
+                pos = 0
+                slot_arr = jnp.asarray(slot, jnp.int32)
+                for i, size in enumerate(_chunk_sizes(true_len,
+                                                      self.max_len)):
+                    tokens = jnp.asarray(req.prompt[pos:pos + size],
+                                         jnp.int32)[None, :]
+                    last_logits, self.cache = _prefill_slot_chunk(
+                        self.params, self.config, tokens, self.cache,
+                        slot_arr, fresh=(i == 0))
+                    pos += size
+            else:
+                bucket = min(_bucket(true_len), self.max_len)
+                padded = req.prompt + [0] * (bucket - true_len)
+                tokens = jnp.asarray(padded, jnp.int32)[None, :]
+                last_logits, self.cache = _prefill_slot(
+                    self.params, self.config, tokens,
+                    jnp.asarray(true_len, jnp.int32), self.cache,
+                    jnp.asarray(slot, jnp.int32))
             self._key, tok_key = jax.random.split(self._key)
             tok0 = sample_token(last_logits[None, :], tok_key,
                                 temperature=self.sample.temperature,

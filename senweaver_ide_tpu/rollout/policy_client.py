@@ -68,10 +68,14 @@ class EnginePolicyClient:
         prompt_text = render_chat_template(messages)
         prompt_ids = self.tokenizer.encode(prompt_text, add_bos=True)
         budget = max_tokens or self.default_max_new_tokens
-        if len(prompt_ids) + budget >= self.engine.max_len:
+        # Ring engines (sliding-window models) accept prompts past the
+        # pool size via chunked prefill; the real bound is the engine's
+        # cache bound (= model position budget on rings).
+        bound = getattr(self.engine, "_cache_bound", self.engine.max_len)
+        if len(prompt_ids) + budget >= bound:
             raise ContextLengthError(
                 f"prompt of {len(prompt_ids)} tokens + {budget} output "
-                f"exceeds engine window {self.engine.max_len}")
+                f"exceeds engine window {bound}")
         rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
                                  eos_id=self.tokenizer.eos_id)
         while not self.engine.is_done(rid):

@@ -418,3 +418,28 @@ def test_fresh_cache_hint_changes_nothing(rng):
     lg_b, _ = forward(params, cfg, toks, cache=init_kv_cache(cfg, 1, 32))
     np.testing.assert_allclose(np.asarray(lg_a), np.asarray(lg_b),
                                atol=1e-5)
+
+
+def test_engine_long_prompt_chunked_prefill(rng):
+    """A prompt LONGER than the ring pool (21 tokens on an 8-slot ring)
+    must serve via exact-size chunked prefill and match generate()."""
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine, _chunk_sizes
+    from senweaver_ide_tpu.rollout.sampler import SampleParams, generate
+
+    assert _chunk_sizes(21, 8) == [8, 8, 4, 1]
+    assert _chunk_sizes(8, 8) == [8]
+    assert _chunk_sizes(3, 8) == [2, 1]
+
+    cfg = dataclasses.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(13))
+    prompt = [int(x) for x in rng.integers(1, 500, 21)]
+
+    eng = RolloutEngine(params, cfg, num_slots=2, max_len=64,
+                        sample=SampleParams(temperature=0.0))
+    rid = eng.submit(prompt, max_new_tokens=8)
+    out = eng.run()[rid]
+
+    ref = generate(params, cfg, jnp.asarray([prompt], jnp.int32),
+                   max_new_tokens=8, sample=SampleParams(temperature=0.0),
+                   key=jax.random.PRNGKey(0), max_len=64)
+    assert out == [int(t) for t in np.asarray(ref[0])]
