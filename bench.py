@@ -182,6 +182,12 @@ def main() -> None:
                 # path directly.
                 ("deepseek-coder-6.7b", 16, 128, 96,
                  "deepseek6.7b_b16_int8kv", True, "steps"),
+                # The SWA family (mistral-7b). At this shape the cache
+                # (193 < window) runs the absolute short-cache SWA path;
+                # a full 4096-slot ring at b4 would be 4.3 GB of cache
+                # next to 14.5 GB of bf16 weights — past one 16 GB chip.
+                ("mistral-7b", 4, 128, 64, "mistral7b_b4_swa",
+                 False, "steps"),
         ):
             if mode == "scan":
                 try:

@@ -188,8 +188,11 @@ class RolloutEngine:
         # Decode stop bound, fixed for the engine's lifetime: a ring pool
         # never runs out of slots (modular writes) and is bounded by the
         # model's position budget; an absolute pool stops at capacity.
-        self._cache_bound = (config.max_seq_len
-                             if self._ring else max_len)
+        # Public contract for clients (EnginePolicyClient): the longest
+        # context this engine can serve — the model's position budget on
+        # ring pools (chunked prefill), the pool size on absolute ones.
+        self.context_bound = (config.max_seq_len
+                              if self._ring else max_len)
         self.sample = sample
         self.eos_id = eos_id
         # Optional tensor-parallel serving: params take the Megatron
@@ -267,10 +270,10 @@ class RolloutEngine:
         # keeps only the trailing window, like the model itself);
         # absolute pools must hold the whole prompt. _cache_bound is
         # exactly that distinction (set at construction).
-        if len(prompt) >= self._cache_bound:
+        if len(prompt) >= self.context_bound:
             raise ValueError(
                 f"prompt length {len(prompt)} ≥ engine max_len bound "
-                f"{self._cache_bound}")
+                f"{self.context_bound}")
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid=rid, prompt=list(prompt),
@@ -318,7 +321,7 @@ class RolloutEngine:
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = req.eos_id is not None and tok == req.eos_id
             out_of_budget = len(req.tokens) >= req.max_new_tokens
-            out_of_cache = int(lengths[slot]) >= self._cache_bound - 1
+            out_of_cache = int(lengths[slot]) >= self.context_bound - 1
             if hit_eos or out_of_budget or out_of_cache:
                 req.done = True
                 req.slot = None

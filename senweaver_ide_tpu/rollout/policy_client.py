@@ -69,9 +69,9 @@ class EnginePolicyClient:
         prompt_ids = self.tokenizer.encode(prompt_text, add_bos=True)
         budget = max_tokens or self.default_max_new_tokens
         # Ring engines (sliding-window models) accept prompts past the
-        # pool size via chunked prefill; the real bound is the engine's
-        # cache bound (= model position budget on rings).
-        bound = getattr(self.engine, "_cache_bound", self.engine.max_len)
+        # pool size via chunked prefill; context_bound is the engine's
+        # public contract for the longest servable context.
+        bound = self.engine.context_bound
         if len(prompt_ids) + budget >= bound:
             raise ContextLengthError(
                 f"prompt of {len(prompt_ids)} tokens + {budget} output "

@@ -1,0 +1,26 @@
+"""The examples/ quickstarts must stay runnable (they are the public
+face of the framework for a reference user switching over)."""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def _run(script, *args):
+    return subprocess.run([sys.executable, str(ROOT / "examples" / script),
+                           *args],
+                          capture_output=True, text=True, timeout=420)
+
+
+def test_serve_example():
+    r = _run("serve.py", "--cpu", "--max-new-tokens", "8")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "[tiny-test] 8 tokens" in r.stdout
+
+
+def test_train_grpo_example():
+    r = _run("train_grpo.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "GRPO ROUND OK" in r.stdout
