@@ -8,6 +8,7 @@ real thing (see eval_uplift.py --model-dir).
 
     python examples/train_grpo.py
 """
+import itertools
 import os
 import sys
 import tempfile
@@ -50,12 +51,13 @@ def main() -> None:
             return r
 
     with tempfile.TemporaryDirectory() as workdir:
-        n = [0]
+        counter = itertools.count()   # thread-safe in CPython: sessions
+                                      # are created from collector threads
 
         def make_session():
-            n[0] += 1
             s = RolloutSession(RecordingPolicy(),
-                               os.path.join(workdir, f"ws{n[0]}"),
+                               os.path.join(workdir,
+                                            f"ws{next(counter)}"),
                                include_tool_definitions=False)
             s.workspace.write_file("app.py", "def run():\n    return 1\n")
             return s

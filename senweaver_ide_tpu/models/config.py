@@ -136,6 +136,22 @@ def mistral_7b() -> ModelConfig:
         rope_theta=10_000.0, rms_norm_eps=1e-5, sliding_window=4096)
 
 
+def mixtral_8x7b() -> ModelConfig:
+    """Mixtral-8x7B-v0.1: the SWA + MoE composition.
+
+    Mistral-family GQA with the 4096 sliding window AND an 8-expert
+    top-2 routed FFN — exercises the ring KV cache and the
+    expert-parallel path (parallel/expert.py, 'ep' mesh axis) in one
+    architecture. Reference serves Mixtral through its mistral/openai
+    providers (capability DB substring families)."""
+    return ModelConfig(
+        name="mixtral-8x7b", vocab_size=32_000, hidden_size=4096,
+        intermediate_size=14_336, num_layers=32, num_heads=32,
+        num_kv_heads=8, head_dim=128, max_seq_len=32_768,
+        rope_theta=1_000_000.0, rms_norm_eps=1e-5, sliding_window=4096,
+        num_experts=8, num_experts_per_tok=2)
+
+
 def deepseek_coder_1_3b() -> ModelConfig:
     return ModelConfig(
         name="deepseek-coder-1.3b", vocab_size=32_256, hidden_size=2048,
@@ -175,6 +191,7 @@ PRESETS = {
     "qwen2.5-coder-1.5b": qwen2_5_coder_1_5b,
     "qwen2.5-coder-7b": qwen2_5_coder_7b,
     "mistral-7b": mistral_7b,
+    "mixtral-8x7b": mixtral_8x7b,
     "deepseek-coder-1.3b": deepseek_coder_1_3b,
     "deepseek-coder-6.7b": deepseek_coder_6_7b,
     "tiny-test": tiny_test,

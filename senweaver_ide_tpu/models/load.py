@@ -16,8 +16,10 @@ Conventions bridged:
   projections need NO row permutation (ops/rotary.py matches HF Qwen2/LLaMA).
 
 Supported families: Qwen2/Qwen2.5 (GQA + QKV bias, optionally tied
-embeddings) and LLaMA-architecture DeepSeek-Coder (MHA, no biases) — the
-same coverage as models/config.py PRESETS.
+embeddings), LLaMA-architecture DeepSeek-Coder (MHA, no biases),
+Mistral (GQA + sliding window), and Mixtral (block-sparse MoE:
+``block_sparse_moe.gate`` router + per-expert w1/w3/w2) — the same
+coverage as models/config.py PRESETS.
 """
 
 from __future__ import annotations
@@ -106,10 +108,30 @@ def load_hf_params(model_dir: str, config: ModelConfig, *,
         "wo": stacked(p + "self_attn.o_proj.weight", (D, c.q_dim), True),
         "mlp_norm": stacked(p + "post_attention_layernorm.weight", (D,),
                             False),
-        "w_gate": stacked(p + "mlp.gate_proj.weight", (F, D), True),
-        "w_up": stacked(p + "mlp.up_proj.weight", (F, D), True),
-        "w_down": stacked(p + "mlp.down_proj.weight", (D, F), True),
     }
+    if c.num_experts > 0:
+        # Mixtral block-sparse layout: gate (router) is (E, D); expert e
+        # carries w1 (gate), w3 (up) as (F, D) and w2 (down) as (D, F).
+        E = c.num_experts
+        layers["router"] = stacked(
+            p + "block_sparse_moe.gate.weight", (E, D), True)
+
+        def experts(sub: str, shape) -> np.ndarray:
+            per_layer = []
+            for i in range(L):
+                per_layer.append(np.stack([
+                    _take(raw, f"model.layers.{i}.block_sparse_moe."
+                               f"experts.{e}.{sub}.weight", shape).T
+                    for e in range(E)]))
+            return np.stack(per_layer)          # (L, E, in, out)
+
+        layers["w_gate"] = experts("w1", (F, D))
+        layers["w_up"] = experts("w3", (F, D))
+        layers["w_down"] = experts("w2", (D, F))
+    else:
+        layers["w_gate"] = stacked(p + "mlp.gate_proj.weight", (F, D), True)
+        layers["w_up"] = stacked(p + "mlp.up_proj.weight", (F, D), True)
+        layers["w_down"] = stacked(p + "mlp.down_proj.weight", (D, F), True)
     if c.qkv_bias:
         layers["bq"] = stacked(p + "self_attn.q_proj.bias", (c.q_dim,), False)
         layers["bk"] = stacked(p + "self_attn.k_proj.bias", (c.kv_dim,),
@@ -175,9 +197,17 @@ def export_hf_params(params: Params, config: ModelConfig,
         out[p + "self_attn.v_proj.weight"] = tt(lp["wv"][i])
         out[p + "self_attn.o_proj.weight"] = tt(lp["wo"][i])
         out[p + "post_attention_layernorm.weight"] = t(lp["mlp_norm"][i])
-        out[p + "mlp.gate_proj.weight"] = tt(lp["w_gate"][i])
-        out[p + "mlp.up_proj.weight"] = tt(lp["w_up"][i])
-        out[p + "mlp.down_proj.weight"] = tt(lp["w_down"][i])
+        if c.num_experts > 0:
+            out[p + "block_sparse_moe.gate.weight"] = tt(lp["router"][i])
+            for e in range(c.num_experts):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                out[ep + "w1.weight"] = tt(lp["w_gate"][i, e])
+                out[ep + "w3.weight"] = tt(lp["w_up"][i, e])
+                out[ep + "w2.weight"] = tt(lp["w_down"][i, e])
+        else:
+            out[p + "mlp.gate_proj.weight"] = tt(lp["w_gate"][i])
+            out[p + "mlp.up_proj.weight"] = tt(lp["w_up"][i])
+            out[p + "mlp.down_proj.weight"] = tt(lp["w_down"][i])
         if c.qkv_bias:
             out[p + "self_attn.q_proj.bias"] = t(lp["bq"][i])
             out[p + "self_attn.k_proj.bias"] = t(lp["bk"][i])

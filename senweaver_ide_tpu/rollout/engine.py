@@ -268,7 +268,7 @@ class RolloutEngine:
             raise ValueError("empty prompt")
         # Ring pools accept prompts past the window (chunked prefill
         # keeps only the trailing window, like the model itself);
-        # absolute pools must hold the whole prompt. _cache_bound is
+        # absolute pools must hold the whole prompt. context_bound is
         # exactly that distinction (set at construction).
         if len(prompt) >= self.context_bound:
             raise ValueError(

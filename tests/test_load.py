@@ -150,3 +150,23 @@ def test_parity_vs_transformers_llama(tmp_path):
         head_dim=16, max_seq_len=128, rope_theta=100000.0, qkv_bias=False,
         dtype=jnp.float32, matmul_precision="highest")
     _hf_parity(tmp_path, model, our_cfg, 512)
+
+
+def test_moe_roundtrip_mixtral_layout(tmp_path, rng):
+    """Export a tiny MoE model to the Mixtral block-sparse HF layout and
+    load it back: forward must match the original exactly."""
+    import jax.numpy as jnp
+
+    from senweaver_ide_tpu.models import (export_hf_params, forward,
+                                          get_config, init_params,
+                                          load_hf_params)
+
+    cfg = get_config("tiny-moe-test")
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    export_hf_params(params, cfg, str(tmp_path))
+    loaded = load_hf_params(str(tmp_path), cfg, dtype=jnp.float32)
+
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 8)), jnp.int32)
+    a, _ = forward(params, cfg, toks)
+    b, _ = forward(loaded, cfg, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)

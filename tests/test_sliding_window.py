@@ -443,3 +443,28 @@ def test_engine_long_prompt_chunked_prefill(rng):
                    max_new_tokens=8, sample=SampleParams(temperature=0.0),
                    key=jax.random.PRNGKey(0), max_len=64)
     assert out == [int(t) for t in np.asarray(ref[0])]
+
+
+def test_swa_composes_with_moe(rng):
+    """Mixtral shape: sliding window + routed experts in one model —
+    ring-cache decode must match the no-cache forward."""
+    cfg = dataclasses.replace(get_config("tiny-moe-test"), sliding_window=4)
+    params = init_params(cfg, jax.random.PRNGKey(14))
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 12)), jnp.int32)
+    full, _ = forward(params, cfg, toks)
+
+    cache = init_kv_cache(cfg, 1, 64)
+    assert cache.k.shape[2] == 8
+    outs = []
+    for i in range(12):
+        lg, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        outs.append(lg)
+    np.testing.assert_allclose(np.asarray(full),
+                               np.asarray(jnp.concatenate(outs, axis=1)),
+                               atol=3e-4)
+
+
+def test_mixtral_preset_registered():
+    cfg = get_config("mixtral-8x7b")
+    assert cfg.sliding_window == 4096 and cfg.num_experts == 8
+    assert cfg.num_experts_per_tok == 2 and cfg.num_kv_heads == 8
