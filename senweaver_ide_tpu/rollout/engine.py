@@ -123,6 +123,14 @@ def _prefill_slot_chunk(params: Params, config: ModelConfig,
             _writeback_slot(cache, sub, slot, start + tokens.shape[1]))
 
 
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def _install_prefix(cache: KVCache, prefix: KVCache,
+                    slot: jax.Array) -> KVCache:
+    """Copy a cached prefix's KV (one pool-slot-shaped buffer) into a
+    slot — HBM copy instead of recomputing the shared prompt prefix."""
+    return _writeback_slot(cache, prefix, slot, prefix.length)
+
+
 def _chunk_sizes(n: int, cap: int) -> list:
     """n = (n // cap) full chunks + a descending powers-of-two ladder."""
     sizes = [cap] * (n // cap)
@@ -165,6 +173,7 @@ class _Request:
     tokens: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     slot: Optional[int] = None
+    prefix_id: Optional[int] = None
 
 
 class RolloutEngine:
@@ -236,6 +245,12 @@ class RolloutEngine:
         self._next_rid = 0
         # Tokens sampled during prefill, to be surfaced by the next step().
         self._pending_emits: Dict[int, List[int]] = {}
+        # Prefix cache: shared prompt prefixes (the agent system prompt)
+        # prefilled ONCE into a pool-slot-shaped KV buffer and HBM-copied
+        # into each slot that reuses them (replacing recompute).
+        self._prefixes: Dict[int, tuple] = {}
+        self._prefix_by_tokens: Dict[tuple, int] = {}   # content dedup
+        self._next_prefix_id = 0
         # Many agent loops (subagent threads) drive one engine: all state
         # mutation is serialized; concurrency = slots, not host threads.
         self._lock = threading.RLock()
@@ -250,20 +265,30 @@ class RolloutEngine:
         """On-policy weight sync: the trainer hands over fresh params
         between rounds (sampler/trainer overlap, SURVEY.md §7). KV cache
         and in-flight requests are untouched — callers should sync at
-        round boundaries when slots are idle."""
+        round boundaries when slots are idle.
+
+        Registered prefixes are DROPPED: their KV was computed by the
+        old policy and would silently mix policies if reused. Clients
+        holding a prefix_id get a KeyError on next use and re-register
+        (EnginePolicyClient does this automatically)."""
         with self._lock:
             self.params = self._place_params(params)
+            self._prefixes.clear()
+            self._prefix_by_tokens.clear()
 
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt: List[int], *, max_new_tokens: int = 128,
+               prefix_id: Optional[int] = None,
                eos_id: Optional[int] = None) -> int:
         with self._lock:
             return self._submit(prompt, max_new_tokens=max_new_tokens,
+                                prefix_id=prefix_id,
                                 eos_id=eos_id)
 
     def _submit(self, prompt: List[int], *, max_new_tokens: int,
-                eos_id: Optional[int]) -> int:
+                eos_id: Optional[int],
+                prefix_id: Optional[int] = None) -> int:
         if not prompt:
             raise ValueError("empty prompt")
         # Ring pools accept prompts past the window (chunked prefill
@@ -274,11 +299,20 @@ class RolloutEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} ≥ engine max_len bound "
                 f"{self.context_bound}")
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            p_tokens = self._prefixes[prefix_id][0]
+            if prompt[:len(p_tokens)] != p_tokens:
+                raise ValueError(
+                    "prompt does not start with the registered prefix "
+                    f"(prefix_id {prefix_id}, {len(p_tokens)} tokens)")
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid=rid, prompt=list(prompt),
                        max_new_tokens=max_new_tokens,
-                       eos_id=self.eos_id if eos_id is None else eos_id)
+                       eos_id=self.eos_id if eos_id is None else eos_id,
+                       prefix_id=prefix_id)
         self._requests[rid] = req
         self._queue.append(req)
         self._schedule()
@@ -343,7 +377,75 @@ class RolloutEngine:
         with self._lock:
             return self._requests[rid].done
 
+    def register_prefix(self, tokens: List[int]) -> int:
+        """Prefill ``tokens`` once; return a prefix_id for submit().
+
+        The prefix KV lives in a one-slot buffer shaped like the pool;
+        submit(prompt, prefix_id=...) requires the prompt to START with
+        exactly these tokens and prefills only the suffix. The big win
+        is the agent system prompt: every rollout episode shares it, and
+        a slot install becomes one HBM copy instead of a prefill pass.
+
+        Cost model: the suffix prefills through the exact-size chunk
+        ladder (each distinct chunk shape compiles once), so the win
+        materializes when the prefix is long relative to the suffix —
+        exactly the agent-loop shape (multi-k-token system prompt,
+        short user turn). Content-identical registrations dedup to one
+        buffer; ``update_params`` invalidates all prefixes (their KV
+        belongs to the old policy) and auto_prefix clients re-register.
+        """
+        with self._lock:
+            if not tokens:
+                raise ValueError("empty prefix")
+            if len(tokens) >= self.max_len:
+                raise ValueError(
+                    f"prefix length {len(tokens)} ≥ pool capacity "
+                    f"{self.max_len}")
+            key = tuple(tokens)
+            if key in self._prefix_by_tokens:   # content dedup: many
+                return self._prefix_by_tokens[key]   # clients, one buffer
+            from ..models.transformer import init_kv_cache
+            from .sampler import prefill        # jitted, donates cache
+            sub = init_kv_cache(self.config, 1, self.max_len)
+            last = None
+            pos = 0
+            for i, size in enumerate(_chunk_sizes(len(tokens),
+                                                  self.max_len)):
+                chunk = jnp.asarray(tokens[pos:pos + size], jnp.int32)
+                last, sub = prefill(self.params, self.config,
+                                    chunk[None, :], sub,
+                                    fresh_cache=(i == 0))
+                pos += size
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            # the B=1 cache IS the pool's slot layout (L, 1, cap, ...)
+            self._prefixes[pid] = (list(tokens), sub,
+                                   jax.device_get(last[0]))
+            self._prefix_by_tokens[key] = pid
+            return pid
+
+    def release_prefix(self, prefix_id: int) -> None:
+        """Free a registered prefix's KV buffer."""
+        with self._lock:
+            entry = self._prefixes.pop(prefix_id, None)
+            if entry is not None:
+                self._prefix_by_tokens.pop(tuple(entry[0]), None)
+
     # -- internals ----------------------------------------------------------
+
+    def _prefill_chunks(self, slot_arr, tokens: List[int],
+                        fresh_first: bool):
+        """Exact-size chunk chain into a slot at its current length;
+        returns the last chunk's final-token logits."""
+        last_logits = None
+        pos = 0
+        for i, size in enumerate(_chunk_sizes(len(tokens), self.max_len)):
+            chunk = jnp.asarray(tokens[pos:pos + size], jnp.int32)[None, :]
+            last_logits, self.cache = _prefill_slot_chunk(
+                self.params, self.config, chunk, self.cache, slot_arr,
+                fresh=(fresh_first and i == 0))
+            pos += size
+        return last_logits
 
     def _schedule(self) -> None:
         """Prefill queued requests into free slots (continuous batching)."""
@@ -356,22 +458,27 @@ class RolloutEngine:
             req.slot = slot
             self._slot_req[slot] = req
             true_len = len(req.prompt)
-            if true_len >= self.max_len and self._ring:
+            if req.prefix_id is not None:
+                # Shared-prefix path: HBM-copy the cached prefix KV into
+                # the slot, then exact-chunk-prefill only the suffix.
+                p_tokens, p_cache, p_last = self._prefixes[req.prefix_id]
+                slot_arr = jnp.asarray(slot, jnp.int32)
+                self.cache = _install_prefix(self.cache, p_cache, slot_arr)
+                suffix = req.prompt[len(p_tokens):]
+                if suffix:
+                    last_logits = self._prefill_chunks(slot_arr, suffix,
+                                                       fresh_first=False)
+                else:
+                    last_logits = jnp.asarray(p_last)
+            elif true_len >= self.max_len and self._ring:
                 # Long prompt on a ring pool: exact-size chunk chain
                 # (see _prefill_slot_chunk). Reset the slot's stale
                 # length first — the chain reads it as its write cursor.
                 self.cache = self.cache._replace(
                     length=self.cache.length.at[slot].set(0))
-                pos = 0
                 slot_arr = jnp.asarray(slot, jnp.int32)
-                for i, size in enumerate(_chunk_sizes(true_len,
-                                                      self.max_len)):
-                    tokens = jnp.asarray(req.prompt[pos:pos + size],
-                                         jnp.int32)[None, :]
-                    last_logits, self.cache = _prefill_slot_chunk(
-                        self.params, self.config, tokens, self.cache,
-                        slot_arr, fresh=(i == 0))
-                    pos += size
+                last_logits = self._prefill_chunks(slot_arr, req.prompt,
+                                                   fresh_first=True)
             else:
                 bucket = min(_bucket(true_len), self.max_len)
                 padded = req.prompt + [0] * (bucket - true_len)

@@ -50,17 +50,53 @@ class EnginePolicyClient:
                  model_name: str = "",
                  default_max_new_tokens: int = 512,
                  tool_names: Optional[Sequence[str]] = None,
-                 record_calls: bool = False):
+                 record_calls: bool = False,
+                 auto_prefix: bool = False):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
         self.default_max_new_tokens = default_max_new_tokens
         self.tool_names = tool_names
+        # Shared-prefix acceleration: register each distinct system
+        # message's rendered/tokenized form with the engine ONCE and
+        # submit later turns with its prefix_id — every agent episode
+        # in a GRPO group repeats the same big system prompt, and the
+        # engine then installs its KV by HBM copy instead of prefill.
+        self.auto_prefix = auto_prefix
+        self._prefix_ids: dict = {}
         # When recording, every chat() appends (prompt_ids, output_ids) —
         # the exact token streams GRPO trains on (no re-tokenization
         # drift between rollout and training).
         self.record_calls = record_calls
         self.call_log: List[tuple[List[int], List[int]]] = []
+
+    def _system_prefix_id(self, system_msg: ChatMessage,
+                          prompt_ids: List[int]) -> Optional[int]:
+        """prefix_id for this system message, registering on first use.
+
+        The cached prefix is the rendered system block alone (the turn
+        boundary "\n" that follows it belongs to the prefix so the
+        suffix split is exact). Returns None when the current prompt
+        does not start with it (e.g. the tokenizer merged across the
+        boundary) or when it doesn't fit the engine pool."""
+        key = system_msg.content
+        if key not in self._prefix_ids:
+            rendered = render_chat_template([system_msg])
+            # drop the trailing assistant-open stub the template appends
+            stub = f"{_ROLE_OPEN}assistant\n"
+            assert rendered.endswith(stub)
+            prefix_text = rendered[:-len(stub)]
+            ids = self.tokenizer.encode(prefix_text, add_bos=True)
+            try:
+                self._prefix_ids[key] = (
+                    self.engine.register_prefix(ids), ids)
+            except ValueError:          # longer than the pool: skip
+                self._prefix_ids[key] = None
+        entry = self._prefix_ids[key]
+        if entry is None:
+            return None
+        pid, ids = entry
+        return pid if prompt_ids[:len(ids)] == ids else None
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
@@ -76,8 +112,22 @@ class EnginePolicyClient:
             raise ContextLengthError(
                 f"prompt of {len(prompt_ids)} tokens + {budget} output "
                 f"exceeds engine window {bound}")
-        rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
-                                 eos_id=self.tokenizer.eos_id)
+        prefix_id = None
+        if self.auto_prefix and messages and messages[0].role == "system":
+            prefix_id = self._system_prefix_id(messages[0], prompt_ids)
+        try:
+            rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
+                                     prefix_id=prefix_id,
+                                     eos_id=self.tokenizer.eos_id)
+        except KeyError:
+            # The engine dropped registered prefixes (weight sync
+            # invalidates their KV — engine.update_params). Forget ours
+            # and re-register against the new policy.
+            self._prefix_ids.clear()
+            prefix_id = self._system_prefix_id(messages[0], prompt_ids)
+            rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
+                                     prefix_id=prefix_id,
+                                     eos_id=self.tokenizer.eos_id)
         while not self.engine.is_done(rid):
             self.engine.step()
         out_ids = self.engine.result(rid)

@@ -208,3 +208,153 @@ def test_engine_int8_kv_cache_serves(model):
     assert len({tuple(out[r]) for r in rids}) == 1
     assert eng.cache.k.dtype == jnp.int8
     assert eng.cache.k_scale is not None
+
+
+# ---- prefix caching (shared system-prompt KV reuse) ----
+
+def _greedy_engine(params, config, **kw):
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+    return RolloutEngine(params, config, num_slots=2, max_len=64,
+                         sample=GREEDY, **kw)
+
+
+def test_prefix_cache_matches_plain_prefill(model, rng):
+    params, config = model
+    prefix = [int(x) for x in rng.integers(1, 400, 9)]
+    suffix = [int(x) for x in rng.integers(1, 400, 5)]
+
+    plain = _greedy_engine(params, config)
+    rid = plain.submit(prefix + suffix, max_new_tokens=8)
+    want = plain.run()[rid]
+
+    cached = _greedy_engine(params, config)
+    pid = cached.register_prefix(prefix)
+    rid = cached.submit(prefix + suffix, max_new_tokens=8, prefix_id=pid)
+    got = cached.run()[rid]
+    assert got == want
+
+    # empty suffix: decode straight from the stored prefix logits
+    rid2 = cached.submit(list(prefix), max_new_tokens=6, prefix_id=pid)
+    plain_rid = plain.submit(list(prefix), max_new_tokens=6)
+    assert cached.run()[rid2] == plain.run()[plain_rid]
+
+
+def test_prefix_cache_reused_across_slots(model, rng):
+    """Two concurrent requests share one registered prefix."""
+    params, config = model
+    prefix = [int(x) for x in rng.integers(1, 400, 7)]
+    eng = _greedy_engine(params, config)
+    pid = eng.register_prefix(prefix)
+    sufs = [[int(x) for x in rng.integers(1, 400, 4)] for _ in range(2)]
+    rids = [eng.submit(prefix + s, max_new_tokens=6, prefix_id=pid)
+            for s in sufs]
+    out = eng.run()
+
+    ref = _greedy_engine(params, config)
+    for s, rid in zip(sufs, rids):
+        r = ref.submit(prefix + s, max_new_tokens=6)
+        assert out[rid] == ref.run()[r]
+
+
+def test_prefix_cache_validation(model, rng):
+    params, config = model
+    eng = _greedy_engine(params, config)
+    pid = eng.register_prefix([5, 6, 7])
+    with pytest.raises(ValueError, match="does not start with"):
+        eng.submit([9, 9, 9, 9], max_new_tokens=4, prefix_id=pid)
+    with pytest.raises(KeyError):
+        eng.submit([5, 6, 7, 8], max_new_tokens=4, prefix_id=999)
+    with pytest.raises(ValueError, match="empty prefix"):
+        eng.register_prefix([])
+
+
+def test_prefix_cache_on_ring_pool(rng):
+    """Prefix install + suffix chunks on a sliding-window ring pool."""
+    import dataclasses as _dc
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    cfg = _dc.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(21))
+    prefix = [int(x) for x in rng.integers(1, 400, 5)]
+    suffix = [int(x) for x in rng.integers(1, 400, 6)]   # wraps the ring
+
+    plain = _greedy_engine(params, cfg)
+    rid_p = plain.submit(prefix + suffix, max_new_tokens=6)
+    want = plain.run()[rid_p]
+
+    cached = _greedy_engine(params, cfg)
+    pid = cached.register_prefix(prefix)
+    rid_c = cached.submit(prefix + suffix, max_new_tokens=6, prefix_id=pid)
+    got = cached.run()[rid_c]
+    assert got == want
+
+
+def test_client_auto_prefix_identical_output(model, rng):
+    """EnginePolicyClient(auto_prefix=True): same responses, one prefix
+    registration shared across calls with the same system message."""
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+
+    params, config = model
+    tok = ByteTokenizer()
+    sysmsg = ChatMessage("system", "You are a careful coding agent. " * 3)
+
+    def make(auto):
+        from senweaver_ide_tpu.rollout.engine import RolloutEngine
+        eng = RolloutEngine(params, config, num_slots=2, max_len=512,
+                            sample=GREEDY, eos_id=tok.eos_id)
+        return EnginePolicyClient(eng, tok, default_max_new_tokens=8,
+                                  auto_prefix=auto)
+
+    plain, cached = make(False), make(True)
+    for user in ("fix the bug", "run the tests"):
+        msgs = [sysmsg, ChatMessage("user", user)]
+        a = plain.chat(msgs, temperature=0.0)
+        b = cached.chat(msgs, temperature=0.0)
+        assert a.text == b.text
+    assert len(cached._prefix_ids) == 1          # registered once
+    assert len(cached.engine._prefixes) == 1
+
+
+def test_prefix_invalidated_by_weight_sync(model, rng):
+    """update_params drops prefix KV (old-policy contamination);
+    auto_prefix clients transparently re-register."""
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+
+    params, config = model
+    tok = ByteTokenizer()
+    eng = RolloutEngine(params, config, num_slots=2, max_len=512,
+                        sample=GREEDY, eos_id=tok.eos_id)
+    client = EnginePolicyClient(eng, tok, default_max_new_tokens=6,
+                                auto_prefix=True)
+    msgs = [ChatMessage("system", "Careful agent rules."),
+            ChatMessage("user", "hello")]
+    a = client.chat(msgs, temperature=0.0)
+    assert len(eng._prefixes) == 1
+
+    new_params = init_params(config, jax.random.PRNGKey(123))
+    eng.update_params(new_params)
+    assert eng._prefixes == {}                     # invalidated
+
+    b = client.chat(msgs, temperature=0.0)         # re-registers, works
+    assert len(eng._prefixes) == 1
+    # fresh-params reference: same messages on a clean engine
+    ref_eng = RolloutEngine(new_params, config, num_slots=2, max_len=512,
+                            sample=GREEDY, eos_id=tok.eos_id)
+    ref = EnginePolicyClient(ref_eng, tok, default_max_new_tokens=6)
+    assert b.text == ref.chat(msgs, temperature=0.0).text
+
+
+def test_prefix_dedup_across_clients(model):
+    """Two clients registering the same system prompt share ONE buffer."""
+    params, config = model
+    eng = _greedy_engine(params, config)
+    pid1 = eng.register_prefix([7, 8, 9])
+    pid2 = eng.register_prefix([7, 8, 9])
+    assert pid1 == pid2 and len(eng._prefixes) == 1
+    eng.release_prefix(pid1)
+    assert eng._prefixes == {}
