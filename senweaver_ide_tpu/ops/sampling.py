@@ -78,3 +78,15 @@ def sample_token(
     if 0.0 < top_p < 1.0:
         x = apply_top_p(x, top_p, cutoff=top_p_cutoff)
     return jax.random.categorical(key, x, axis=-1)
+
+
+def sampled_logprob(logits: jnp.ndarray, token: jnp.ndarray) -> jnp.ndarray:
+    """Model log-prob of ``token`` under the UNMODIFIED distribution.
+
+    logits (..., V) fp-any, token (...) int → (...) fp32. This is the
+    behavior log-prob GRPO's importance ratio needs: the policy
+    network's own log p(token), NOT the temperature/top-k/top-p-shaped
+    sampling distribution — it must match ``token_logprobs`` computed
+    by the trainer over the same network (training/grpo.py)."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logz, token[..., None], axis=-1)[..., 0]

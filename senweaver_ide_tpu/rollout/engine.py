@@ -35,7 +35,7 @@ import numpy as np
 
 from ..models.config import ModelConfig
 from ..models.transformer import KVCache, Params, forward
-from ..ops.sampling import sample_token
+from ..ops.sampling import sample_token, sampled_logprob
 from .sampler import SampleParams
 
 
@@ -152,16 +152,22 @@ def _pool_decode_step(params: Params, config: ModelConfig, cur_tok: jax.Array,
                       active: jax.Array, cache: KVCache, key: jax.Array,
                       sample: SampleParams):
     """One decode step over the whole pool. cur_tok/active: (num_slots,).
-    Inactive slots compute garbage that is discarded; their lengths hold."""
+    Inactive slots compute garbage that is discarded; their lengths hold.
+    Also returns each sampled token's model log-prob (the behavior
+    logp GRPO's importance ratio trains against — ops/sampling.py
+    sampled_logprob), captured here where the logits are already in
+    hand instead of re-running the policy later."""
     logits, new_cache = forward(params, config, cur_tok[:, None], cache=cache)
     logits = logits[:, -1, :]
     next_tok = sample_token(logits, key, temperature=sample.temperature,
                             top_k=sample.top_k, top_p=sample.top_p)
     next_tok = jnp.where(active, next_tok, cur_tok)
+    logp = sampled_logprob(logits, next_tok)
     length = jnp.where(active, new_cache.length, cache.length)
-    return next_tok, KVCache(k=new_cache.k, v=new_cache.v, length=length,
-                             k_scale=new_cache.k_scale,
-                             v_scale=new_cache.v_scale)
+    return next_tok, logp, KVCache(k=new_cache.k, v=new_cache.v,
+                                   length=length,
+                                   k_scale=new_cache.k_scale,
+                                   v_scale=new_cache.v_scale)
 
 
 @dataclasses.dataclass
@@ -171,6 +177,9 @@ class _Request:
     max_new_tokens: int
     eos_id: Optional[int]
     tokens: List[int] = dataclasses.field(default_factory=list)
+    # model log-prob of each emitted token AT SAMPLE TIME (the behavior
+    # policy logp for GRPO importance ratios), parallel to `tokens`
+    logps: List[float] = dataclasses.field(default_factory=list)
     done: bool = False
     slot: Optional[int] = None
     prefix_id: Optional[int] = None
@@ -341,17 +350,19 @@ class RolloutEngine:
             return emitted
         active = jnp.asarray(active_list)
         self._key, step_key = jax.random.split(self._key)
-        next_tok, self.cache = _pool_decode_step(
+        next_tok, logp, self.cache = _pool_decode_step(
             self.params, self.config, self.cur_tok, active, self.cache,
             step_key, self.sample)
         self.cur_tok = next_tok
         toks = np.asarray(next_tok)
+        logps = np.asarray(logp)
         lengths = np.asarray(self.cache.length)
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
             tok = int(toks[slot])
             req.tokens.append(tok)
+            req.logps.append(float(logps[slot]))
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = req.eos_id is not None and tok == req.eos_id
             out_of_budget = len(req.tokens) >= req.max_new_tokens
@@ -372,6 +383,14 @@ class RolloutEngine:
     def result(self, rid: int) -> List[int]:
         with self._lock:
             return list(self._requests[rid].tokens)
+
+    def result_logps(self, rid: int) -> List[float]:
+        """Behavior log-prob of each emitted token (parallel to
+        result()): the model's own log p(token) captured at sample time
+        — what GRPO's importance ratio divides by, with no second
+        forward pass (ops/sampling.py sampled_logprob)."""
+        with self._lock:
+            return list(self._requests[rid].logps)
 
     def is_done(self, rid: int) -> bool:
         with self._lock:
@@ -458,6 +477,13 @@ class RolloutEngine:
             req.slot = slot
             self._slot_req[slot] = req
             true_len = len(req.prompt)
+            if (req.prefix_id is not None
+                    and req.prefix_id not in self._prefixes):
+                # The prefix was invalidated while this request sat in
+                # the queue (update_params drops old-policy KV). Fall
+                # back to a full prefill — raising here would corrupt
+                # an unrelated caller's step().
+                req.prefix_id = None
             if req.prefix_id is not None:
                 # Shared-prefix path: HBM-copy the cached prefix KV into
                 # the slot, then exact-chunk-prefill only the suffix.
@@ -494,6 +520,7 @@ class RolloutEngine:
                                 top_p=self.sample.top_p)
             tok0_i = int(tok0[0])
             req.tokens.append(tok0_i)
+            req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
             self._pending_emits.setdefault(req.rid, []).append(tok0_i)
             self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
             if ((req.eos_id is not None and tok0_i == req.eos_id)

@@ -68,7 +68,8 @@ class EnginePolicyClient:
         # the exact token streams GRPO trains on (no re-tokenization
         # drift between rollout and training).
         self.record_calls = record_calls
-        self.call_log: List[tuple[List[int], List[int]]] = []
+        # (prompt_ids, output_ids, behavior_logps) per call
+        self.call_log: List[tuple] = []
 
     def _system_prefix_id(self, system_msg: ChatMessage,
                           prompt_ids: List[int]) -> Optional[int]:
@@ -84,7 +85,11 @@ class EnginePolicyClient:
             rendered = render_chat_template([system_msg])
             # drop the trailing assistant-open stub the template appends
             stub = f"{_ROLE_OPEN}assistant\n"
-            assert rendered.endswith(stub)
+            if not rendered.endswith(stub):
+                # template drift: disable prefix caching for this
+                # system message rather than mis-splitting the prompt
+                self._prefix_ids[key] = None
+                return None
             prefix_text = rendered[:-len(stub)]
             ids = self.tokenizer.encode(prefix_text, add_bos=True)
             try:
@@ -132,7 +137,8 @@ class EnginePolicyClient:
             self.engine.step()
         out_ids = self.engine.result(rid)
         if self.record_calls:
-            self.call_log.append((list(prompt_ids), list(out_ids)))
+            self.call_log.append((list(prompt_ids), list(out_ids),
+                                  self.engine.result_logps(rid)))
         raw = self.tokenizer.decode(out_ids)
         # Cut at the chat-template end marker if the model emitted one.
         end = raw.find(_ROLE_CLOSE)

@@ -2,7 +2,8 @@ from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 from .trainer import (TrainState, make_optimizer, make_train_state, train_step)
 from .checkpoint import CheckpointManager
-from .data import Trajectory, TrajectoryDataset, make_batch
+from .data import (Trajectory, TrajectoryDataset, make_batch,
+                   make_batch_logps)
 from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
 from .rl_loop import (EpisodeRecord, RoundResult,
                       collect_group_trajectories, grpo_round)

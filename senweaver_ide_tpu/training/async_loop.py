@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from .data import make_batch
+from .data import make_batch, make_batch_logps
 from .grpo import GRPOConfig, token_logprobs
 from .rl_loop import EpisodeRecord, collect_group_trajectories
 from .trainer import TrainState, train_step
@@ -176,11 +176,17 @@ class AsyncGRPOTrainer:
                                     staleness, wait_s)
         tokens, mask, rewards, group_ids = make_batch(
             item.trajectories, pad_id=self.pad_id, max_len=self.max_len)
+        recorded = (make_batch_logps(item.trajectories, tokens, mask)
+                    if self.importance_correction else None)
         tokens, mask, rewards, group_ids = map(
             jnp.asarray, (tokens, mask, rewards, group_ids))
 
         old_logp = None
-        if self.importance_correction and staleness > 0:
+        if recorded is not None:
+            # Sample-time logps: exact importance ratios at any
+            # staleness, no behavior-params recompute or retention.
+            old_logp = jnp.asarray(recorded)
+        elif self.importance_correction and staleness > 0:
             old_logp = _behavior_logp(item.behavior_params,
                                       self.model_config, tokens)
 

@@ -26,6 +26,12 @@ class Trajectory:
     completion_ids: List[int]
     reward: float
     group_id: int
+    # Behavior log-prob per completion token, captured at SAMPLE time by
+    # the engine (result_logps). When every trajectory in a batch has
+    # them, make_batch_logps aligns them into the old_logp array and the
+    # GRPO step trains with exact importance ratios (no second forward,
+    # no retained behavior params).
+    behavior_logp: Optional[List[float]] = None
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -66,6 +72,35 @@ def make_batch(trajectories: Sequence[Trajectory], *, pad_id: int,
         rewards[i] = t.reward
         group_ids[i] = t.group_id
     return tokens, mask, rewards, group_ids
+
+
+def make_batch_logps(trajectories: Sequence[Trajectory],
+                     tokens: np.ndarray,
+                     mask: np.ndarray) -> Optional[np.ndarray]:
+    """Align recorded behavior logps with a make_batch output.
+
+    Returns old_logp shaped (B, S-1) — the trainer's target layout
+    (position j-1 predicts token j) — or None unless EVERY trajectory
+    carries a full logp list (a partial batch would silently mix exact
+    ratios with the ratio-1 approximation). Positions outside the
+    completion mask hold 0.0 (never read by the masked objective)."""
+    if any(t.behavior_logp is None
+           or len(t.behavior_logp) != len(t.completion_ids)
+           for t in trajectories):
+        return None
+    b, s = tokens.shape
+    old = np.zeros((b, s - 1), np.float32)
+    for i, t in enumerate(trajectories):
+        # completion tokens sit at the masked positions of row i, in
+        # order; target index of seq position j is j-1. Position 0 can
+        # never be a target (nothing precedes it) — the trainer's
+        # shifted mask excludes it too.
+        pos = np.nonzero(mask[i])[0]
+        lps = np.asarray(t.behavior_logp[-len(pos):] if len(pos) else [],
+                         np.float32)
+        keep = pos >= 1
+        old[i, pos[keep] - 1] = lps[keep]
+    return old
 
 
 def pad_batch_for_mesh(

@@ -23,7 +23,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 
 from ..rollout.session import RolloutSession
-from .data import Trajectory, make_batch, pad_batch_for_mesh
+from .data import (Trajectory, make_batch, make_batch_logps,
+                   pad_batch_for_mesh)
 from .grpo import GRPOConfig
 from .trainer import TrainState, train_step
 
@@ -57,10 +58,12 @@ def _run_episode(make_session, task_idx: int, task: str, g: int,
             reward = (out.trace.summary.final_reward
                       if out.trace is not None else 0.0)
         calls = list(getattr(client, "call_log", []))[log_start:]
-        trajectories = [Trajectory(prompt_ids=prompt_ids,
-                                   completion_ids=out_ids,
-                                   reward=float(reward), group_id=task_idx)
-                        for prompt_ids, out_ids in calls]
+        trajectories = [
+            Trajectory(prompt_ids=rec[0], completion_ids=rec[1],
+                       reward=float(reward), group_id=task_idx,
+                       behavior_logp=(list(rec[2]) if len(rec) > 2
+                                      else None))
+            for rec in calls]
         episode = EpisodeRecord(task_idx=task_idx, reward=float(reward),
                                 n_calls=len(calls), steps=out.loop.steps)
         return trajectories, episode
@@ -180,6 +183,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                                (_time.monotonic() - t_b) * 1000.0,
                                batch=len(trajectories))
     if mesh is None:
+        old_logp = make_batch_logps(trajectories, tokens, mask)
         tokens, mask, rewards, group_ids = map(
             jnp.asarray, (tokens, mask, rewards, group_ids))
     else:
@@ -203,13 +207,19 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         row_sh = NamedSharding(mesh, restrict_spec(P(("dp", "fsdp")), mesh))
         grid_sh = NamedSharding(mesh,
                                 restrict_spec(P(("dp", "fsdp"), None), mesh))
+        # Align recorded behavior logps AFTER padding (padded rows have
+        # an all-False mask and contribute zeros).
+        old_logp = make_batch_logps(trajectories, tokens, mask)
         tokens = _jax.device_put(tokens, grid_sh)
         mask = _jax.device_put(mask, grid_sh)
         rewards = _jax.device_put(rewards, row_sh)
         group_ids = _jax.device_put(group_ids, row_sh)
+        if old_logp is not None:
+            old_logp = _jax.device_put(old_logp, grid_sh)
     t1 = _time.monotonic()
     state, metrics = train_step(
         state, model_config, mesh, tokens, mask, rewards, group_ids,
+        old_logp=(jnp.asarray(old_logp) if old_logp is not None else None),
         grpo_config=grpo_config, accum_steps=accum_steps)
     out_metrics = {k: float(v) for k, v in metrics.items()}
     if perf_monitor is not None:

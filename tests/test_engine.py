@@ -358,3 +358,16 @@ def test_prefix_dedup_across_clients(model):
     assert pid1 == pid2 and len(eng._prefixes) == 1
     eng.release_prefix(pid1)
     assert eng._prefixes == {}
+
+
+def test_queued_prefix_request_survives_invalidation(model):
+    """A request queued with a prefix_id must fall back to full prefill
+    (not KeyError) if update_params invalidates prefixes first."""
+    params, config = model
+    eng = _greedy_engine(params, config)          # 2 slots
+    pid = eng.register_prefix([5, 6, 7])
+    rids = [eng.submit([5, 6, 7, 8 + i], max_new_tokens=4, prefix_id=pid)
+            for i in range(4)]                    # 2 queued beyond slots
+    eng.update_params(params)                     # drops prefixes
+    out = eng.run()                               # must not raise
+    assert all(len(out[r]) > 0 for r in rids)

@@ -96,3 +96,81 @@ def test_closed_grpo_loop(tmp_path, tiny_stack):
     before = jax.tree_util.tree_leaves(state.params)[0]
     after = jax.tree_util.tree_leaves(out.state.params)[0]
     assert not jnp.allclose(before, after)
+
+
+# ---- sample-time behavior logps ----
+
+def test_engine_logps_match_recompute(tiny_stack):
+    """Recorded sample-time logps must equal a post-hoc forward's
+    token_logprobs over the same sequence (fp32 parity config)."""
+    config, state = tiny_stack
+    from senweaver_ide_tpu.models.transformer import forward
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.training.grpo import token_logprobs
+
+    eng = RolloutEngine(state.params, config, num_slots=1, max_len=64,
+                        sample=SampleParams(temperature=0.8, top_k=0,
+                                            top_p=0.0), seed=3)
+    prompt = [5, 9, 2, 7]
+    rid = eng.submit(prompt, max_new_tokens=6)
+    out = eng.run()[rid]
+    logps = eng.result_logps(rid)
+    assert len(logps) == len(out)
+
+    seq = jnp.asarray([prompt + out], jnp.int32)
+    logits, _ = forward(state.params, config, seq[:, :-1])
+    want = token_logprobs(logits, seq[:, 1:])[0, len(prompt) - 1:]
+    np.testing.assert_allclose(np.asarray(logps), np.asarray(want),
+                               atol=2e-4)
+
+
+def test_make_batch_logps_alignment():
+    from senweaver_ide_tpu.training import Trajectory, make_batch
+    from senweaver_ide_tpu.training.data import make_batch_logps
+
+    trajs = [Trajectory([1, 2, 3], [4, 5], reward=1.0, group_id=0,
+                        behavior_logp=[-0.5, -0.7]),
+             Trajectory([9], [8, 7, 6], reward=0.0, group_id=1,
+                        behavior_logp=[-0.1, -0.2, -0.3])]
+    tokens, mask, _, _ = make_batch(trajs, pad_id=0)
+    old = make_batch_logps(trajs, tokens, mask)
+    # row 0: completion at seq pos 3,4 → target idx 2,3
+    np.testing.assert_allclose(old[0, 2:4], [-0.5, -0.7])
+    assert old[0, :2].sum() == 0 and old[0, 4:].sum() == 0
+    # row 1: completion at pos 1,2,3 → target idx 0,1,2
+    np.testing.assert_allclose(old[1, :3], [-0.1, -0.2, -0.3])
+
+    # any trajectory without logps disables the batch
+    trajs[1].behavior_logp = None
+    assert make_batch_logps(trajs, tokens, mask) is None
+
+
+def test_grpo_round_uses_recorded_logps(tmp_path, tiny_stack):
+    """End-to-end: a round over the engine trains with exact recorded
+    ratios — on-policy, so ratio_mean must sit at 1."""
+    config, state = tiny_stack
+    tok = ByteTokenizer()
+    made = []
+
+    def make_session():
+        engine = RolloutEngine(state.params, config, num_slots=2,
+                               max_len=4096, eos_id=tok.eos_id,
+                               seed=10 + len(made))
+        client = EnginePolicyClient(engine, tok, model_name="tiny-test",
+                                    default_max_new_tokens=6,
+                                    record_calls=True)
+        s = RolloutSession(client, str(tmp_path / f"lp{len(made)}"),
+                           include_tool_definitions=False)
+        made.append(s)
+        return s
+
+    def reward(task_idx, g, session):
+        return 1.0 if g % 2 == 0 else -1.0
+
+    out = grpo_round(state, config, None, make_session, ["task"],
+                     group_size=2, pad_id=tok.pad_id, max_len=2048,
+                     reward_override=reward)
+    assert all(t.behavior_logp is not None for t in out.trajectories)
+    assert np.isfinite(out.metrics["loss"])
+    np.testing.assert_allclose(out.metrics["ratio_mean"], 1.0, atol=1e-3)
