@@ -183,6 +183,13 @@ class _Request:
     done: bool = False
     slot: Optional[int] = None
     prefix_id: Optional[int] = None
+    # hold_slot: keep the slot (and its KV) reserved after finishing so
+    # a follow-up turn can continue from it (submit(continue_from=rid)).
+    hold_slot: bool = False
+    # full token history resident in the slot's cache EXCLUDING the
+    # final sampled token (whose k/v is only written when it is fed) —
+    # set when the request finishes while holding its slot.
+    held_history: Optional[List[int]] = None
 
 
 class RolloutEngine:
@@ -249,6 +256,8 @@ class RolloutEngine:
                              k_scale=ks0, v_scale=vs0)
         self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        # rid holding each slot's KV across turns (hold_slot), or None
+        self._slot_held: List[Optional[int]] = [None] * num_slots
         self._queue: Deque[_Request] = deque()
         self._requests: Dict[int, _Request] = {}
         self._next_rid = 0
@@ -284,22 +293,38 @@ class RolloutEngine:
             self.params = self._place_params(params)
             self._prefixes.clear()
             self._prefix_by_tokens.clear()
+            # Held conversation KV is old-policy state for the same
+            # reason: continuations after a sync must re-prefill.
+            for slot, rid in enumerate(self._slot_held):
+                if rid is not None:
+                    self._requests[rid].held_history = None
+                    self._requests[rid].slot = None
+                    self._slot_held[slot] = None
 
     # -- public API ---------------------------------------------------------
 
     def submit(self, prompt: List[int], *, max_new_tokens: int = 128,
                prefix_id: Optional[int] = None,
-               eos_id: Optional[int] = None) -> int:
+               eos_id: Optional[int] = None,
+               hold_slot: bool = False,
+               continue_from: Optional[int] = None) -> int:
         with self._lock:
             return self._submit(prompt, max_new_tokens=max_new_tokens,
                                 prefix_id=prefix_id,
-                                eos_id=eos_id)
+                                eos_id=eos_id, hold_slot=hold_slot,
+                                continue_from=continue_from)
 
     def _submit(self, prompt: List[int], *, max_new_tokens: int,
                 eos_id: Optional[int],
-                prefix_id: Optional[int] = None) -> int:
+                prefix_id: Optional[int] = None,
+                hold_slot: bool = False,
+                continue_from: Optional[int] = None) -> int:
         if not prompt:
             raise ValueError("empty prompt")
+        if continue_from is not None:
+            return self._submit_continuation(
+                prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
+                hold_slot=hold_slot, continue_from=continue_from)
         # Ring pools accept prompts past the window (chunked prefill
         # keeps only the trailing window, like the model itself);
         # absolute pools must hold the whole prompt. context_bound is
@@ -321,7 +346,7 @@ class RolloutEngine:
         req = _Request(rid=rid, prompt=list(prompt),
                        max_new_tokens=max_new_tokens,
                        eos_id=self.eos_id if eos_id is None else eos_id,
-                       prefix_id=prefix_id)
+                       prefix_id=prefix_id, hold_slot=hold_slot)
         self._requests[rid] = req
         self._queue.append(req)
         self._schedule()
@@ -368,9 +393,7 @@ class RolloutEngine:
             out_of_budget = len(req.tokens) >= req.max_new_tokens
             out_of_cache = int(lengths[slot]) >= self.context_bound - 1
             if hit_eos or out_of_budget or out_of_cache:
-                req.done = True
-                req.slot = None
-                self._slot_req[slot] = None
+                self._finish_request(req, slot)
         self._schedule()
         return emitted
 
@@ -395,6 +418,75 @@ class RolloutEngine:
     def is_done(self, rid: int) -> bool:
         with self._lock:
             return self._requests[rid].done
+
+    def _submit_continuation(self, prompt: List[int], *,
+                             max_new_tokens: int, eos_id: Optional[int],
+                             hold_slot: bool, continue_from: int) -> int:
+        """Multi-turn continuation: append only the NEW tokens to a held
+        slot's resident KV (hold_slot=True on the previous turn), instead
+        of re-prefilling the whole conversation. ``prompt`` is the FULL
+        token stream; the engine verifies it extends the held history
+        byte-exactly and prefills just the delta."""
+        prev = self._requests.get(continue_from)
+        if prev is None or not prev.done or prev.held_history is None:
+            raise ValueError(
+                f"continue_from={continue_from}: request not finished "
+                f"while holding a slot")
+        try:
+            slot = self._slot_held.index(continue_from)
+        except ValueError:
+            raise ValueError(
+                f"continue_from={continue_from}: slot already released")
+        history = prev.held_history
+        if (len(prompt) <= len(history)
+                or prompt[:len(history)] != history):
+            raise ValueError(
+                "prompt does not extend the held conversation "
+                f"({len(history)} resident tokens); release the slot "
+                "and submit a full prefill instead")
+        if len(prompt) >= self.context_bound:
+            raise ValueError(
+                f"prompt length {len(prompt)} ≥ engine max_len bound "
+                f"{self.context_bound}")
+        delta = prompt[len(history):]
+
+        rid = self._next_rid
+        self._next_rid += 1
+        req = _Request(rid=rid, prompt=list(prompt),
+                       max_new_tokens=max_new_tokens,
+                       eos_id=self.eos_id if eos_id is None else eos_id,
+                       hold_slot=hold_slot, slot=slot)
+        self._requests[rid] = req
+        self._slot_held[slot] = None
+        self._slot_req[slot] = req
+        slot_arr = jnp.asarray(slot, jnp.int32)
+        last_logits = self._prefill_chunks(slot_arr, delta,
+                                           fresh_first=False)
+        self._key, tok_key = jax.random.split(self._key)
+        tok0 = sample_token(last_logits[None, :], tok_key,
+                            temperature=self.sample.temperature,
+                            top_k=self.sample.top_k,
+                            top_p=self.sample.top_p)
+        tok0_i = int(tok0[0])
+        req.tokens.append(tok0_i)
+        req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
+        self._pending_emits.setdefault(rid, []).append(tok0_i)
+        self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
+        if ((req.eos_id is not None and tok0_i == req.eos_id)
+                or req.max_new_tokens <= 1):
+            self._finish_request(req, slot)
+        return rid
+
+    def release_slot(self, rid: int) -> None:
+        """Free a slot held by a finished hold_slot request."""
+        with self._lock:
+            try:
+                slot = self._slot_held.index(rid)
+            except ValueError:
+                return
+            self._slot_held[slot] = None
+            self._requests[rid].slot = None
+            self._schedule()
 
     def register_prefix(self, tokens: List[int]) -> int:
         """Prefill ``tokens`` once; return a prefix_id for submit().
@@ -452,6 +544,20 @@ class RolloutEngine:
 
     # -- internals ----------------------------------------------------------
 
+    def _finish_request(self, req: "_Request", slot: int) -> None:
+        """Mark a request done and either hold or free its slot."""
+        req.done = True
+        self._slot_req[slot] = None
+        if req.hold_slot:
+            # The LAST sampled token's k/v is not yet written (tokens
+            # are fed on the step AFTER they are sampled), so the
+            # resident history excludes it — a continuation's delta
+            # naturally begins with that token.
+            req.held_history = list(req.prompt) + req.tokens[:-1]
+            self._slot_held[slot] = req.rid
+        else:
+            req.slot = None
+
     def _prefill_chunks(self, slot_arr, tokens: List[int],
                         fresh_first: bool):
         """Exact-size chunk chain into a slot at its current length;
@@ -471,7 +577,8 @@ class RolloutEngine:
         for slot in range(self.num_slots):
             if not self._queue:
                 return
-            if self._slot_req[slot] is not None:
+            if (self._slot_req[slot] is not None
+                    or self._slot_held[slot] is not None):
                 continue
             req = self._queue.popleft()
             req.slot = slot
@@ -525,6 +632,4 @@ class RolloutEngine:
             self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
             if ((req.eos_id is not None and tok0_i == req.eos_id)
                     or req.max_new_tokens <= 1):
-                req.done = True
-                req.slot = None
-                self._slot_req[slot] = None
+                self._finish_request(req, slot)

@@ -51,7 +51,8 @@ class EnginePolicyClient:
                  default_max_new_tokens: int = 512,
                  tool_names: Optional[Sequence[str]] = None,
                  record_calls: bool = False,
-                 auto_prefix: bool = False):
+                 auto_prefix: bool = False,
+                 continue_turns: bool = False):
         self.engine = engine
         self.tokenizer = tokenizer
         self.model_name = model_name
@@ -64,6 +65,14 @@ class EnginePolicyClient:
         # engine then installs its KV by HBM copy instead of prefill.
         self.auto_prefix = auto_prefix
         self._prefix_ids: dict = {}
+        # Multi-turn KV continuation: hold the decode slot between chat
+        # calls and, when the next rendered prompt byte-exactly EXTENDS
+        # the previous turn's token stream, prefill only the delta
+        # (engine.submit(continue_from=...)). Re-rendering often breaks
+        # exact extension (extraction, eos markers) — then we release
+        # and fall back to a full prefill, which is always correct.
+        self.continue_turns = continue_turns
+        self._held_turn: Optional[tuple] = None   # (rid, full_ids)
         # When recording, every chat() appends (prompt_ids, output_ids) —
         # the exact token streams GRPO trains on (no re-tokenization
         # drift between rollout and training).
@@ -103,6 +112,13 @@ class EnginePolicyClient:
         pid, ids = entry
         return pid if prompt_ids[:len(ids)] == ids else None
 
+    def release_held_slot(self) -> None:
+        """Free the engine slot held for turn continuation (call when
+        the conversation ends — RolloutSession.close does)."""
+        if self._held_turn is not None:
+            self.engine.release_slot(self._held_turn[0])
+            self._held_turn = None
+
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
              max_tokens: Optional[int] = None) -> LLMResponse:
@@ -117,25 +133,46 @@ class EnginePolicyClient:
             raise ContextLengthError(
                 f"prompt of {len(prompt_ids)} tokens + {budget} output "
                 f"exceeds engine window {bound}")
-        prefix_id = None
-        if self.auto_prefix and messages and messages[0].role == "system":
-            prefix_id = self._system_prefix_id(messages[0], prompt_ids)
-        try:
-            rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
-                                     prefix_id=prefix_id,
-                                     eos_id=self.tokenizer.eos_id)
-        except KeyError:
-            # The engine dropped registered prefixes (weight sync
-            # invalidates their KV — engine.update_params). Forget ours
-            # and re-register against the new policy.
-            self._prefix_ids.clear()
-            prefix_id = self._system_prefix_id(messages[0], prompt_ids)
-            rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
-                                     prefix_id=prefix_id,
-                                     eos_id=self.tokenizer.eos_id)
+        rid = None
+        if self.continue_turns and self._held_turn is not None:
+            prev_rid, prev_ids = self._held_turn
+            if (len(prompt_ids) > len(prev_ids)
+                    and prompt_ids[:len(prev_ids)] == prev_ids):
+                try:
+                    rid = self.engine.submit(
+                        prompt_ids, max_new_tokens=budget,
+                        continue_from=prev_rid, hold_slot=True,
+                        eos_id=self.tokenizer.eos_id)
+                except ValueError:
+                    rid = None
+            if rid is None:           # not an extension: free the slot
+                self.engine.release_slot(prev_rid)
+                self._held_turn = None
+        if rid is None:
+            prefix_id = None
+            if (self.auto_prefix and messages
+                    and messages[0].role == "system"):
+                prefix_id = self._system_prefix_id(messages[0], prompt_ids)
+            try:
+                rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
+                                         prefix_id=prefix_id,
+                                         hold_slot=self.continue_turns,
+                                         eos_id=self.tokenizer.eos_id)
+            except KeyError:
+                # The engine dropped registered prefixes (weight sync
+                # invalidates their KV — engine.update_params). Forget
+                # ours and re-register against the new policy.
+                self._prefix_ids.clear()
+                prefix_id = self._system_prefix_id(messages[0], prompt_ids)
+                rid = self.engine.submit(prompt_ids, max_new_tokens=budget,
+                                         prefix_id=prefix_id,
+                                         hold_slot=self.continue_turns,
+                                         eos_id=self.tokenizer.eos_id)
         while not self.engine.is_done(rid):
             self.engine.step()
         out_ids = self.engine.result(rid)
+        if self.continue_turns:
+            self._held_turn = (rid, list(prompt_ids) + list(out_ids))
         if self.record_calls:
             self.call_log.append((list(prompt_ids), list(out_ids),
                                   self.engine.result_logps(rid)))

@@ -205,5 +205,8 @@ class RolloutSession:
         self._message_idx = len(self.history)
 
     def close(self) -> None:
+        release = getattr(self.client, "release_held_slot", None)
+        if release is not None:      # free a turn-continuation slot
+            release()
         self.subagents.close()
         self.tools.close()

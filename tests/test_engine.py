@@ -371,3 +371,136 @@ def test_queued_prefix_request_survives_invalidation(model):
     eng.update_params(params)                     # drops prefixes
     out = eng.run()                               # must not raise
     assert all(len(out[r]) > 0 for r in rids)
+
+
+# ---- multi-turn slot continuation ----
+
+def test_continuation_matches_full_prefill(model, rng):
+    """Turn 2 continues from turn 1's held KV; greedy output must equal
+    a from-scratch prefill of the full conversation."""
+    params, config = model
+    eng = _greedy_engine(params, config)
+    p1 = [int(x) for x in rng.integers(1, 400, 6)]
+    r1 = eng.submit(p1, max_new_tokens=5, hold_slot=True)
+    out1 = eng.run()[r1]
+
+    glue = [int(x) for x in rng.integers(1, 400, 4)]
+    full2 = p1 + out1 + glue
+    r2 = eng.submit(full2, max_new_tokens=5, continue_from=r1)
+    out2 = eng.run()[r2]
+
+    ref = _greedy_engine(params, config)
+    rr = ref.submit(full2, max_new_tokens=5)
+    assert out2 == ref.run()[rr]
+
+
+def test_continuation_on_ring_pool(rng):
+    """Continuation across the sliding window on a ring pool."""
+    import dataclasses as _dc
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    cfg = _dc.replace(tiny_test(), sliding_window=8)
+    params = init_params(cfg, jax.random.PRNGKey(31))
+    eng = _greedy_engine(params, cfg)
+    p1 = [int(x) for x in rng.integers(1, 400, 5)]
+    r1 = eng.submit(p1, max_new_tokens=4, hold_slot=True)
+    out1 = eng.run()[r1]
+
+    glue = [int(x) for x in rng.integers(1, 400, 6)]   # wraps the ring
+    full2 = p1 + out1 + glue
+    r2 = eng.submit(full2, max_new_tokens=4, continue_from=r1)
+    out2 = eng.run()[r2]
+
+    ref = _greedy_engine(params, cfg)
+    rr = ref.submit(full2, max_new_tokens=4)
+    assert out2 == ref.run()[rr]
+
+
+def test_continuation_validation_and_release(model, rng):
+    params, config = model
+    eng = _greedy_engine(params, config)
+    p1 = [5, 6, 7, 8]
+    r1 = eng.submit(p1, max_new_tokens=3, hold_slot=True)
+    out1 = eng.run()[r1]
+
+    with pytest.raises(ValueError, match="does not extend"):
+        eng.submit([9, 9, 9, 9, 9, 9, 9, 9, 9, 9], max_new_tokens=3,
+                   continue_from=r1)
+    # releasing frees the slot; continuation then refuses
+    eng.release_slot(r1)
+    with pytest.raises(ValueError, match="released|not finished"):
+        eng.submit(p1 + out1 + [3], max_new_tokens=3, continue_from=r1)
+    # never-held request
+    r3 = eng.submit([4, 4, 4], max_new_tokens=2)
+    eng.run()
+    with pytest.raises(ValueError, match="holding"):
+        eng.submit([4, 4, 4, 1, 2], max_new_tokens=2, continue_from=r3)
+
+
+def test_held_slot_not_recycled(model, rng):
+    """With one of two slots held, other requests still complete
+    through the remaining slot."""
+    params, config = model
+    eng = _greedy_engine(params, config)          # 2 slots
+    r1 = eng.submit([5, 6, 7], max_new_tokens=3, hold_slot=True)
+    rids = [eng.submit([int(x) for x in rng.integers(1, 400, 4)],
+                       max_new_tokens=3) for _ in range(3)]
+    out = eng.run()
+    assert all(len(out[r]) == 3 for r in [r1] + rids)
+    assert eng._slot_held.count(None) == 1        # r1 still holds one
+
+
+def test_client_continue_turns_parity_and_no_leak(model, rng):
+    """continue_turns client: identical responses to a plain client over
+    a 3-turn conversation (continuation OR fallback, both exact), and
+    release frees the held slot."""
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+
+    params, config = model
+    tok = ByteTokenizer()
+
+    def converse(continue_turns):
+        eng = RolloutEngine(params, config, num_slots=2, max_len=1024,
+                            sample=GREEDY, eos_id=tok.eos_id)
+        client = EnginePolicyClient(eng, tok, default_max_new_tokens=6,
+                                    continue_turns=continue_turns)
+        msgs = [ChatMessage("system", "agent rules")]
+        outs = []
+        for turn in ("first", "second", "third"):
+            msgs.append(ChatMessage("user", turn))
+            r = client.chat(msgs, temperature=0.0)
+            outs.append(r.text)
+            msgs.append(ChatMessage("assistant", r.text))
+        client.release_held_slot()
+        assert eng._slot_held == [None, None]
+        return outs
+
+    assert converse(True) == converse(False)
+
+
+def test_hold_survives_immediate_done_and_sync_invalidates(model, rng):
+    """max_new_tokens=1 with hold_slot must still hold (prefill-time
+    finish path); update_params must invalidate held conversations."""
+    params, config = model
+    eng = _greedy_engine(params, config)
+    p1 = [5, 6, 7, 8]
+    r1 = eng.submit(p1, max_new_tokens=1, hold_slot=True)
+    out1 = eng.run()[r1]
+    assert len(out1) == 1
+    assert eng._slot_held.count(r1) == 1          # held despite 1-token run
+
+    # continuation works and respects ITS budget exactly
+    r2 = eng.submit(p1 + out1 + [3], max_new_tokens=1, continue_from=r1,
+                    hold_slot=True)
+    out2 = eng.run()[r2]
+    assert len(out2) == 1
+
+    # weight sync invalidates the held conversation
+    eng.update_params(params)
+    assert eng._slot_held == [None, None]
+    with pytest.raises(ValueError, match="holding"):
+        eng.submit(p1 + out1 + [3] + out2 + [4], max_new_tokens=2,
+                   continue_from=r2)
