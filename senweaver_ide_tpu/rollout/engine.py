@@ -462,19 +462,7 @@ class RolloutEngine:
         slot_arr = jnp.asarray(slot, jnp.int32)
         last_logits = self._prefill_chunks(slot_arr, delta,
                                            fresh_first=False)
-        self._key, tok_key = jax.random.split(self._key)
-        tok0 = sample_token(last_logits[None, :], tok_key,
-                            temperature=self.sample.temperature,
-                            top_k=self.sample.top_k,
-                            top_p=self.sample.top_p)
-        tok0_i = int(tok0[0])
-        req.tokens.append(tok0_i)
-        req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
-        self._pending_emits.setdefault(rid, []).append(tok0_i)
-        self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
-        if ((req.eos_id is not None and tok0_i == req.eos_id)
-                or req.max_new_tokens <= 1):
-            self._finish_request(req, slot)
+        self._emit_first_token(req, slot, last_logits)
         return rid
 
     def release_slot(self, rid: int) -> None:
@@ -544,6 +532,24 @@ class RolloutEngine:
 
     # -- internals ----------------------------------------------------------
 
+    def _emit_first_token(self, req: "_Request", slot: int,
+                          last_logits) -> None:
+        """Sample and book-keep a request's first token after prefill
+        (used by both fresh prefills and turn continuations)."""
+        self._key, tok_key = jax.random.split(self._key)
+        tok0 = sample_token(last_logits[None, :], tok_key,
+                            temperature=self.sample.temperature,
+                            top_k=self.sample.top_k,
+                            top_p=self.sample.top_p)
+        tok0_i = int(tok0[0])
+        req.tokens.append(tok0_i)
+        req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
+        self._pending_emits.setdefault(req.rid, []).append(tok0_i)
+        self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
+        if ((req.eos_id is not None and tok0_i == req.eos_id)
+                or req.max_new_tokens <= 1):
+            self._finish_request(req, slot)
+
     def _finish_request(self, req: "_Request", slot: int) -> None:
         """Mark a request done and either hold or free its slot."""
         req.done = True
@@ -574,6 +580,21 @@ class RolloutEngine:
 
     def _schedule(self) -> None:
         """Prefill queued requests into free slots (continuous batching)."""
+        if self._queue and all(self._slot_held[s] is not None
+                               for s in range(self.num_slots)):
+            # Every slot held (none active) with work queued: nothing
+            # will ever free a slot, so run()/chat() would LIVELOCK.
+            # Held KV is droppable cache — evict the oldest hold; its
+            # conversation falls back to a full prefill on its next
+            # turn. (A merely ACTIVE slot needs no eviction: it frees
+            # itself when its request finishes.)
+            for s in range(self.num_slots):
+                rid = self._slot_held[s]
+                if rid is not None:
+                    self._requests[rid].held_history = None
+                    self._requests[rid].slot = None
+                    self._slot_held[s] = None
+                    break
         for slot in range(self.num_slots):
             if not self._queue:
                 return
@@ -620,16 +641,4 @@ class RolloutEngine:
                     self.params, self.config, tokens,
                     jnp.asarray(true_len, jnp.int32), self.cache,
                     jnp.asarray(slot, jnp.int32))
-            self._key, tok_key = jax.random.split(self._key)
-            tok0 = sample_token(last_logits[None, :], tok_key,
-                                temperature=self.sample.temperature,
-                                top_k=self.sample.top_k,
-                                top_p=self.sample.top_p)
-            tok0_i = int(tok0[0])
-            req.tokens.append(tok0_i)
-            req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
-            self._pending_emits.setdefault(req.rid, []).append(tok0_i)
-            self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
-            if ((req.eos_id is not None and tok0_i == req.eos_id)
-                    or req.max_new_tokens <= 1):
-                self._finish_request(req, slot)
+            self._emit_first_token(req, slot, last_logits)

@@ -128,6 +128,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                reward_override=None,
                max_parallel: int = 8,
                accum_steps: int = 1,
+               ppo_epochs: int = 1,
                metrics_service=None,
                perf_monitor=None,
                profile_dir: Optional[str] = None) -> RoundResult:
@@ -147,7 +148,7 @@ def grpo_round(state: TrainState, model_config, mesh,
     with profile_capture(profile_dir):
         return _grpo_round_impl(
             state, model_config, mesh, make_session, tasks,
-            accum_steps=accum_steps,
+            accum_steps=accum_steps, ppo_epochs=ppo_epochs,
             group_size=group_size, pad_id=pad_id, max_len=max_len,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
@@ -157,7 +158,7 @@ def grpo_round(state: TrainState, model_config, mesh,
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      group_size, pad_id, max_len, grpo_config,
                      reward_override, max_parallel, accum_steps=1,
-                     metrics_service=None,
+                     ppo_epochs=1, metrics_service=None,
                      perf_monitor=None) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
@@ -216,15 +217,30 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         group_ids = _jax.device_put(group_ids, row_sh)
         if old_logp is not None:
             old_logp = _jax.device_put(old_logp, grid_sh)
+    # Multi-epoch (PPO-style) updates need the BEHAVIOR policy's logps
+    # frozen across epochs — the clipped ratio is what bounds the drift.
+    # Recorded sample-time logps are already exactly that; without them,
+    # one extra forward under the pre-update params captures them
+    # (timed separately so 'train_step' stays a pure update metric).
+    if ppo_epochs > 1 and old_logp is None:
+        from .async_loop import _behavior_logp
+        t_b = _time.monotonic()
+        old_logp = _behavior_logp(state.params, model_config,
+                                  jnp.asarray(tokens))
+        if perf_monitor is not None:
+            perf_monitor.record_ms("behavior_logp",
+                                   (_time.monotonic() - t_b) * 1000.0)
+    old = jnp.asarray(old_logp) if old_logp is not None else None
     t1 = _time.monotonic()
-    state, metrics = train_step(
-        state, model_config, mesh, tokens, mask, rewards, group_ids,
-        old_logp=(jnp.asarray(old_logp) if old_logp is not None else None),
-        grpo_config=grpo_config, accum_steps=accum_steps)
+    for _ in range(ppo_epochs):
+        state, metrics = train_step(
+            state, model_config, mesh, tokens, mask, rewards, group_ids,
+            old_logp=old, grpo_config=grpo_config, accum_steps=accum_steps)
     out_metrics = {k: float(v) for k, v in metrics.items()}
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step",
-                               (_time.monotonic() - t1) * 1000.0)
+                               (_time.monotonic() - t1) * 1000.0,
+                               epochs=ppo_epochs)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
         metrics_service.capture("GRPO Round Done", {

@@ -504,3 +504,29 @@ def test_hold_survives_immediate_done_and_sync_invalidates(model, rng):
     with pytest.raises(ValueError, match="holding"):
         eng.submit(p1 + out1 + [3] + out2 + [4], max_new_tokens=2,
                    continue_from=r2)
+
+
+def test_held_slot_evicted_under_queue_pressure(model, rng):
+    """All slots held + a queued request must NOT livelock: the oldest
+    hold is evicted (its conversation re-prefills next turn)."""
+    params, config = model
+    eng = _greedy_engine(params, config)          # 2 slots
+    held = [eng.submit([5, 6, 7 + i], max_new_tokens=2, hold_slot=True)
+            for i in range(2)]
+    eng.run()
+    assert eng._slot_held.count(None) == 0        # both held
+    r = eng.submit([9, 9, 9, 9], max_new_tokens=3)
+    out = eng.run()
+    assert len(out[r]) == 3                       # progressed
+    # exactly one hold was evicted to make room (the oldest); the new
+    # request's slot freed again after finishing
+    assert eng._slot_held.count(None) == 1
+    evicted = held[0]
+    with pytest.raises(ValueError, match="holding"):
+        eng.submit([5, 6, 7] + out[evicted] + [1], max_new_tokens=2,
+                   continue_from=evicted)
+    # the survivor still continues fine
+    keep = held[1]
+    r2 = eng.submit([5, 6, 8] + out[keep] + [2], max_new_tokens=2,
+                    continue_from=keep)
+    assert len(eng.run()[r2]) == 2

@@ -174,3 +174,31 @@ def test_grpo_round_uses_recorded_logps(tmp_path, tiny_stack):
     assert all(t.behavior_logp is not None for t in out.trajectories)
     assert np.isfinite(out.metrics["loss"])
     np.testing.assert_allclose(out.metrics["ratio_mean"], 1.0, atol=1e-3)
+
+
+def test_grpo_round_multi_epoch(tmp_path, tiny_stack):
+    """ppo_epochs=3 re-steps the same batch against frozen behavior
+    logps: 3 optimizer steps, clipping active, finite metrics."""
+    config, state = tiny_stack
+    tok = ByteTokenizer()
+    made = []
+
+    def make_session():
+        engine = RolloutEngine(state.params, config, num_slots=2,
+                               max_len=4096, eos_id=tok.eos_id,
+                               seed=50 + len(made))
+        client = EnginePolicyClient(engine, tok, default_max_new_tokens=6,
+                                    record_calls=True)
+        s = RolloutSession(client, str(tmp_path / f"ep{len(made)}"),
+                           include_tool_definitions=False)
+        made.append(s)
+        return s
+
+    out = grpo_round(state, config, None, make_session, ["t"],
+                     group_size=2, pad_id=tok.pad_id, max_len=2048,
+                     ppo_epochs=3,
+                     reward_override=lambda ti, g, s: float(g % 2) * 2 - 1)
+    assert int(out.state.step) == int(state.step) + 3
+    assert np.isfinite(out.metrics["loss"])
+    # after ≥1 update the policy moved: epoch-3 ratios are off 1
+    assert abs(out.metrics["ratio_mean"] - 1.0) > 1e-6
