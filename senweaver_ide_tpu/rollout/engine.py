@@ -258,6 +258,9 @@ class RolloutEngine:
         self._slot_req: List[Optional[_Request]] = [None] * num_slots
         # rid holding each slot's KV across turns (hold_slot), or None
         self._slot_held: List[Optional[int]] = [None] * num_slots
+        # monotonic hold sequence per slot: eviction drops the OLDEST
+        self._hold_seq = 0
+        self._slot_hold_seq: List[int] = [0] * num_slots
         self._queue: Deque[_Request] = deque()
         self._requests: Dict[int, _Request] = {}
         self._next_rid = 0
@@ -295,11 +298,8 @@ class RolloutEngine:
             self._prefix_by_tokens.clear()
             # Held conversation KV is old-policy state for the same
             # reason: continuations after a sync must re-prefill.
-            for slot, rid in enumerate(self._slot_held):
-                if rid is not None:
-                    self._requests[rid].held_history = None
-                    self._requests[rid].slot = None
-                    self._slot_held[slot] = None
+            for slot in range(self.num_slots):
+                self._drop_hold(slot)
 
     # -- public API ---------------------------------------------------------
 
@@ -472,8 +472,7 @@ class RolloutEngine:
                 slot = self._slot_held.index(rid)
             except ValueError:
                 return
-            self._slot_held[slot] = None
-            self._requests[rid].slot = None
+            self._drop_hold(slot)
             self._schedule()
 
     def register_prefix(self, tokens: List[int]) -> int:
@@ -561,8 +560,19 @@ class RolloutEngine:
             # naturally begins with that token.
             req.held_history = list(req.prompt) + req.tokens[:-1]
             self._slot_held[slot] = req.rid
+            self._hold_seq += 1
+            self._slot_hold_seq[slot] = self._hold_seq
         else:
             req.slot = None
+
+    def _drop_hold(self, slot: int) -> None:
+        """Invalidate a held conversation and free its slot."""
+        rid = self._slot_held[slot]
+        if rid is None:
+            return
+        self._requests[rid].held_history = None
+        self._requests[rid].slot = None
+        self._slot_held[slot] = None
 
     def _prefill_chunks(self, slot_arr, tokens: List[int],
                         fresh_first: bool):
@@ -588,13 +598,9 @@ class RolloutEngine:
             # conversation falls back to a full prefill on its next
             # turn. (A merely ACTIVE slot needs no eviction: it frees
             # itself when its request finishes.)
-            for s in range(self.num_slots):
-                rid = self._slot_held[s]
-                if rid is not None:
-                    self._requests[rid].held_history = None
-                    self._requests[rid].slot = None
-                    self._slot_held[s] = None
-                    break
+            oldest = min(range(self.num_slots),
+                         key=lambda s: self._slot_hold_seq[s])
+            self._drop_hold(oldest)
         for slot in range(self.num_slots):
             if not self._queue:
                 return

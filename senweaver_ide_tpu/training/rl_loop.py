@@ -132,7 +132,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                metrics_service=None,
                perf_monitor=None,
                profile_dir: Optional[str] = None) -> RoundResult:
-    """One on-policy round: collect → batch → single GRPO step.
+    """One on-policy round: collect → batch → GRPO update(s).
 
     ``metrics_service`` (services.MetricsService) observes the trainer
     itself (SURVEY.md §7 step 8): per-phase wall time, episode rewards,
@@ -143,6 +143,9 @@ def grpo_round(state: TrainState, model_config, mesh,
     ``profile_dir`` wraps the whole round in a ``jax.profiler.trace``
     capture (TensorBoard-loadable device timelines)."""
     import time as _time
+
+    if ppo_epochs < 1:
+        raise ValueError(f"ppo_epochs must be >= 1, got {ppo_epochs}")
 
     from ..services.perf_monitor import profile_capture
     with profile_capture(profile_dir):
@@ -225,8 +228,18 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if ppo_epochs > 1 and old_logp is None:
         from .async_loop import _behavior_logp
         t_b = _time.monotonic()
-        old_logp = _behavior_logp(state.params, model_config,
-                                  jnp.asarray(tokens))
+        toks_arr = jnp.asarray(tokens)
+        if accum_steps > 1 and toks_arr.shape[0] % accum_steps == 0:
+            # Respect the memory budget that made accum_steps necessary:
+            # a whole-batch forward would materialize (B, S-1, V) logits
+            # the microbatched update was sized to avoid.
+            mb = toks_arr.shape[0] // accum_steps
+            old_logp = jnp.concatenate(
+                [_behavior_logp(state.params, model_config,
+                                toks_arr[i * mb:(i + 1) * mb])
+                 for i in range(accum_steps)], axis=0)
+        else:
+            old_logp = _behavior_logp(state.params, model_config, toks_arr)
         if perf_monitor is not None:
             perf_monitor.record_ms("behavior_logp",
                                    (_time.monotonic() - t_b) * 1000.0)

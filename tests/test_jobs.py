@@ -1,0 +1,128 @@
+"""JobRunner: the control plane actually training (runtime/jobs.py)."""
+
+import json
+import os
+import subprocess
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.apo.eval import GOOD_RULESET, RuleSensitivePolicy
+from senweaver_ide_tpu.models import get_config
+from senweaver_ide_tpu.rollout import RolloutSession
+from senweaver_ide_tpu.runtime import ControlServer, JobRunner
+from senweaver_ide_tpu.runtime.native import ctl_binary_path
+from senweaver_ide_tpu.training import make_train_state
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+
+    config = get_config("tiny-test")
+    state = make_train_state(config, jax.random.PRNGKey(0),
+                             None, learning_rate=1e-3)
+    tok = ByteTokenizer()
+    n = [0]
+
+    class RecordingPolicy:
+        """Scripted policy + the (prompt_ids, out_ids) log GRPO needs."""
+
+        def __init__(self):
+            self.inner = RuleSensitivePolicy()
+            self.call_log = []
+
+        def chat(self, messages, **kw):
+            r = self.inner.chat(messages, **kw)
+            ptext = "\n".join(m.content for m in messages)
+            self.call_log.append((tok.encode(ptext)[-128:],
+                                  tok.encode(r.text)[:64]))
+            return r
+
+    def make_session(rules=None):
+        n[0] += 1
+        s = RolloutSession(RecordingPolicy(), str(tmp_path / f"ws{n[0]}"),
+                           apo_rules=list(rules or []),
+                           include_tool_definitions=False)
+        s.workspace.write_file("app.py", "def run():\n    return 1\n")
+        return s
+
+    server = ControlServer(str(tmp_path / "ctl.sock"))
+    runner = JobRunner(server, make_session=make_session,
+                       train_state=state, model_config=config,
+                       reward_override=lambda ti, g, s:
+                           1.0 if g % 2 == 0 else -1.0,
+                       max_len=512)
+    server.start()
+    runner.start()
+    yield server, runner
+    runner.stop()
+    server.stop()
+
+
+def _wait_done(server, job_id, timeout=300):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        st = server.jobs[job_id].status
+        if st in ("done", "failed", "stopped"):
+            return st
+        time.sleep(0.1)
+    raise TimeoutError(server.jobs[job_id].status)
+
+
+def test_grpo_job_trains(stack):
+    server, runner = stack
+    r = server._submit({"type": "grpo", "tasks": ["fix", "test"],
+                        "rounds": 2, "group_size": 2})
+    assert _wait_done(server, r["job_id"]) == "done"
+    res = server.jobs[r["job_id"]].result
+    assert res["rounds_done"] == 2 and res["step"] == 2
+    assert all(np.isfinite(m["loss"]) for m in res["metrics"])
+
+
+def test_eval_rules_job_ranks_rulesets(stack):
+    server, runner = stack
+    r_bad = server._submit({"type": "eval_rules", "rules": []})
+    r_good = server._submit({"type": "eval_rules",
+                             "rules": list(GOOD_RULESET)})
+    assert _wait_done(server, r_bad["job_id"]) == "done"
+    assert _wait_done(server, r_good["job_id"]) == "done"
+    bad = server.jobs[r_bad["job_id"]].result["final_reward"]
+    good = server.jobs[r_good["job_id"]].result["final_reward"]
+    assert good > bad + 0.3
+
+
+def test_bad_job_fails_cleanly(stack):
+    server, runner = stack
+    r = server._submit({"type": "nonsense"})
+    assert _wait_done(server, r["job_id"]) == "failed"
+    assert "unknown job type" in server.jobs[r["job_id"]].result["error"]
+
+
+@pytest.mark.skipif(ctl_binary_path() is None,
+                    reason="senweaver-ctl not built")
+def test_ctl_binary_drives_training(stack):
+    """Full loop: the C++ CLI submits a training job, watches it finish,
+    and fetches its metrics."""
+    server, runner = stack
+    binary = ctl_binary_path()
+
+    def ctl(*args):
+        p = subprocess.run([binary, "--socket", server.socket_path,
+                            "--interval", "1", *args],
+                           capture_output=True, text=True, timeout=300)
+        lines = [ln for ln in p.stdout.strip().split("\n") if ln]
+        return p.returncode, json.loads(lines[-1])
+
+    code, resp = ctl("submit", json.dumps(
+        {"type": "grpo", "tasks": ["fix"], "rounds": 1, "group_size": 2}))
+    assert code == 0
+    job_id = resp["result"]["job_id"]
+    code, resp = ctl("watch")
+    assert code == 0
+    code, resp = ctl("call", "job_result", json.dumps({"job_id": job_id}))
+    assert code == 0
+    assert resp["result"]["status"] == "done"
+    assert resp["result"]["result"]["rounds_done"] == 1
