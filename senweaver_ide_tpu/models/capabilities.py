@@ -49,6 +49,7 @@ _CAPABILITIES: Tuple[Tuple[str, ModelCapabilities], ...] = (
     # on the full preset name — a bare "mistral" key would also match
     # remote API models (mistral-large: 128k) and cap them wrongly.
     ("mistral-7b", ModelCapabilities(context_window=32_768)),
+    ("mixtral-8x7b", ModelCapabilities(context_window=32_768)),
     ("claude", ModelCapabilities(context_window=200_000,
                                  reserved_output_token_space=8192,
                                  max_output_tokens=8192)),

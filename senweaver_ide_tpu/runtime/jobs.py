@@ -99,9 +99,15 @@ class JobRunner:
                     if job.status != "stopped":
                         job.status = "done"
             except Exception as e:
-                job.status = "failed"
+                # result BEFORE status (a poller keying on the terminal
+                # status must find the error populated), and the same
+                # lock discipline as the success path (a stop that
+                # already ACKed must not be overwritten).
                 job.result = {"error": f"{type(e).__name__}: {e}",
                               "traceback": traceback.format_exc()[-2000:]}
+                with self.server._lock:
+                    if job.status != "stopped":
+                        job.status = "failed"
 
     def _run_job(self, job: Job) -> Dict[str, Any]:
         spec = job.params if isinstance(job.params, dict) else {}

@@ -229,10 +229,16 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         from .async_loop import _behavior_logp
         t_b = _time.monotonic()
         toks_arr = jnp.asarray(tokens)
-        if accum_steps > 1 and toks_arr.shape[0] % accum_steps == 0:
+        if accum_steps > 1:
             # Respect the memory budget that made accum_steps necessary:
             # a whole-batch forward would materialize (B, S-1, V) logits
-            # the microbatched update was sized to avoid.
+            # the microbatched update was sized to avoid. Indivisible
+            # batches fail HERE, before that allocation — train_step
+            # would reject them anyway.
+            if toks_arr.shape[0] % accum_steps != 0:
+                raise ValueError(
+                    f"batch {toks_arr.shape[0]} not divisible by "
+                    f"accum_steps {accum_steps}")
             mb = toks_arr.shape[0] // accum_steps
             old_logp = jnp.concatenate(
                 [_behavior_logp(state.params, model_config,

@@ -25,3 +25,13 @@ def test_train_grpo_example():
     r = _run("train_grpo.py")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "GRPO ROUND OK" in r.stdout
+
+
+def test_control_plane_example():
+    from senweaver_ide_tpu.runtime.native import ctl_binary_path
+    if ctl_binary_path() is None:
+        import pytest
+        pytest.skip("senweaver-ctl not built")
+    r = _run("control_plane.py")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "JOBS SESSION OK" in r.stdout
