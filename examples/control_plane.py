@@ -7,7 +7,7 @@ metrics — the operator workflow for a long-running trainer process.
 
     python examples/control_plane.py
 """
-import json, subprocess, sys, tempfile, time
+import json, subprocess, sys, tempfile
 from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import jax
@@ -27,6 +27,10 @@ tmp = tempfile.mkdtemp()
 n = [0]
 
 class RecordingPolicy:
+    """Scripted policy + the (prompt_ids, out_ids) call log that
+    collect_group_trajectories slices into GRPO trajectories — without
+    it a round collects zero training data."""
+
     def __init__(self):
         self.inner = RuleSensitivePolicy(); self.call_log = []
     def chat(self, messages, **kw):

@@ -261,6 +261,14 @@ class RolloutEngine:
         # monotonic hold sequence per slot: eviction drops the OLDEST
         self._hold_seq = 0
         self._slot_hold_seq: List[int] = [0] * num_slots
+        # serving observability (read via stats()): how often the reuse
+        # machinery actually engages — the metricsService-style counters
+        # for the engine plane (SURVEY.md §5 observability).
+        self._stats = {"prefills": 0, "prefill_tokens": 0,
+                       "prefix_installs": 0, "prefix_tokens_reused": 0,
+                       "continuations": 0, "continuation_delta_tokens": 0,
+                       "decode_steps": 0, "tokens_emitted": 0,
+                       "hold_evictions": 0}
         self._queue: Deque[_Request] = deque()
         self._requests: Dict[int, _Request] = {}
         self._next_rid = 0
@@ -379,6 +387,7 @@ class RolloutEngine:
             self.params, self.config, self.cur_tok, active, self.cache,
             step_key, self.sample)
         self.cur_tok = next_tok
+        self._stats["decode_steps"] += 1
         toks = np.asarray(next_tok)
         logps = np.asarray(logp)
         lengths = np.asarray(self.cache.length)
@@ -388,6 +397,7 @@ class RolloutEngine:
             tok = int(toks[slot])
             req.tokens.append(tok)
             req.logps.append(float(logps[slot]))
+            self._stats["tokens_emitted"] += 1
             emitted.setdefault(req.rid, []).append(tok)
             hit_eos = req.eos_id is not None and tok == req.eos_id
             out_of_budget = len(req.tokens) >= req.max_new_tokens
@@ -402,6 +412,12 @@ class RolloutEngine:
         while self.has_work:
             self.step()
         return {rid: r.tokens for rid, r in self._requests.items()}
+
+    def stats(self) -> Dict[str, int]:
+        """Serving counters: prefill volume, prefix/continuation reuse,
+        decode throughput inputs, hold evictions."""
+        with self._lock:
+            return dict(self._stats)
 
     def result(self, rid: int) -> List[int]:
         with self._lock:
@@ -462,6 +478,8 @@ class RolloutEngine:
         slot_arr = jnp.asarray(slot, jnp.int32)
         last_logits = self._prefill_chunks(slot_arr, delta,
                                            fresh_first=False)
+        self._stats["continuations"] += 1
+        self._stats["continuation_delta_tokens"] += len(delta)
         self._emit_first_token(req, slot, last_logits)
         return rid
 
@@ -543,6 +561,7 @@ class RolloutEngine:
         tok0_i = int(tok0[0])
         req.tokens.append(tok0_i)
         req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
+        self._stats["tokens_emitted"] += 1
         self._pending_emits.setdefault(req.rid, []).append(tok0_i)
         self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
         if ((req.eos_id is not None and tok0_i == req.eos_id)
@@ -601,6 +620,7 @@ class RolloutEngine:
             oldest = min(range(self.num_slots),
                          key=lambda s: self._slot_hold_seq[s])
             self._drop_hold(oldest)
+            self._stats["hold_evictions"] += 1
         for slot in range(self.num_slots):
             if not self._queue:
                 return
@@ -611,6 +631,14 @@ class RolloutEngine:
             req.slot = slot
             self._slot_req[slot] = req
             true_len = len(req.prompt)
+            self._stats["prefills"] += 1
+            # prefill_tokens = tokens actually COMPUTED (prefix installs
+            # are HBM copies; their tokens land in prefix_tokens_reused)
+            if req.prefix_id is not None and req.prefix_id in self._prefixes:
+                self._stats["prefill_tokens"] += (
+                    true_len - len(self._prefixes[req.prefix_id][0]))
+            else:
+                self._stats["prefill_tokens"] += true_len
             if (req.prefix_id is not None
                     and req.prefix_id not in self._prefixes):
                 # The prefix was invalidated while this request sat in
@@ -624,6 +652,8 @@ class RolloutEngine:
                 p_tokens, p_cache, p_last = self._prefixes[req.prefix_id]
                 slot_arr = jnp.asarray(slot, jnp.int32)
                 self.cache = _install_prefix(self.cache, p_cache, slot_arr)
+                self._stats["prefix_installs"] += 1
+                self._stats["prefix_tokens_reused"] += len(p_tokens)
                 suffix = req.prompt[len(p_tokens):]
                 if suffix:
                     last_logits = self._prefill_chunks(slot_arr, suffix,

@@ -530,3 +530,22 @@ def test_held_slot_evicted_under_queue_pressure(model, rng):
     r2 = eng.submit([5, 6, 8] + out[keep] + [2], max_new_tokens=2,
                     continue_from=keep)
     assert len(eng.run()[r2]) == 2
+
+
+def test_engine_stats_track_reuse(model, rng):
+    params, config = model
+    eng = _greedy_engine(params, config)
+    pid = eng.register_prefix([5, 6, 7])
+    r1 = eng.submit([5, 6, 7, 8], max_new_tokens=3, prefix_id=pid,
+                    hold_slot=True)
+    out1 = eng.run()[r1]
+    r2 = eng.submit([5, 6, 7, 8] + out1 + [9], max_new_tokens=2,
+                    continue_from=r1)
+    eng.run()
+    s = eng.stats()
+    assert s["prefix_installs"] == 1 and s["prefix_tokens_reused"] == 3
+    assert s["continuations"] == 1
+    assert s["continuation_delta_tokens"] >= 1
+    assert s["tokens_emitted"] == len(out1) + 2
+    assert s["prefills"] == 1          # the continuation is NOT a prefill
+    assert s["decode_steps"] >= 2
