@@ -632,13 +632,6 @@ class RolloutEngine:
             self._slot_req[slot] = req
             true_len = len(req.prompt)
             self._stats["prefills"] += 1
-            # prefill_tokens = tokens actually COMPUTED (prefix installs
-            # are HBM copies; their tokens land in prefix_tokens_reused)
-            if req.prefix_id is not None and req.prefix_id in self._prefixes:
-                self._stats["prefill_tokens"] += (
-                    true_len - len(self._prefixes[req.prefix_id][0]))
-            else:
-                self._stats["prefill_tokens"] += true_len
             if (req.prefix_id is not None
                     and req.prefix_id not in self._prefixes):
                 # The prefix was invalidated while this request sat in
@@ -655,6 +648,9 @@ class RolloutEngine:
                 self._stats["prefix_installs"] += 1
                 self._stats["prefix_tokens_reused"] += len(p_tokens)
                 suffix = req.prompt[len(p_tokens):]
+                # prefill_tokens = tokens actually COMPUTED (the prefix
+                # itself arrived by HBM copy)
+                self._stats["prefill_tokens"] += len(suffix)
                 if suffix:
                     last_logits = self._prefill_chunks(slot_arr, suffix,
                                                        fresh_first=False)
@@ -669,6 +665,7 @@ class RolloutEngine:
                 slot_arr = jnp.asarray(slot, jnp.int32)
                 last_logits = self._prefill_chunks(slot_arr, req.prompt,
                                                    fresh_first=True)
+                self._stats["prefill_tokens"] += true_len
             else:
                 bucket = min(_bucket(true_len), self.max_len)
                 padded = req.prompt + [0] * (bucket - true_len)
@@ -677,4 +674,5 @@ class RolloutEngine:
                     self.params, self.config, tokens,
                     jnp.asarray(true_len, jnp.int32), self.cache,
                     jnp.asarray(slot, jnp.int32))
+                self._stats["prefill_tokens"] += true_len
             self._emit_first_token(req, slot, last_logits)
