@@ -41,7 +41,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from .data import make_batch, make_batch_logps
+from .data import make_batch, make_batch_logps, place_batch_for_mesh
 from .grpo import GRPOConfig, token_logprobs
 from .rl_loop import EpisodeRecord, collect_group_trajectories
 from .trainer import TrainState, train_step
@@ -178,15 +178,16 @@ class AsyncGRPOTrainer:
             item.trajectories, pad_id=self.pad_id, max_len=self.max_len)
         recorded = (make_batch_logps(item.trajectories, tokens, mask)
                     if self.importance_correction else None)
-        tokens, mask, rewards, group_ids = map(
-            jnp.asarray, (tokens, mask, rewards, group_ids))
-
-        old_logp = None
-        if recorded is not None:
-            # Sample-time logps: exact importance ratios at any
-            # staleness, no behavior-params recompute or retention.
-            old_logp = jnp.asarray(recorded)
-        elif self.importance_correction and staleness > 0:
+        # Shared explicit mesh placement (same path as grpo_round —
+        # GSPMD propagation alone broadcasts host batches to all
+        # devices before resharding).
+        tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
+            self.mesh, tokens, mask, rewards, group_ids, recorded,
+            pad_id=self.pad_id, accum_steps=self.accum_steps)
+        if (old_logp is None and self.importance_correction
+                and staleness > 0):
+            # Sample-time logps absent: fall back to a forward under
+            # the kept behavior params.
             old_logp = _behavior_logp(item.behavior_params,
                                       self.model_config, tokens)
 

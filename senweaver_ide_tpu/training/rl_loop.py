@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 from ..rollout.session import RolloutSession
 from .data import (Trajectory, make_batch, make_batch_logps,
-                   pad_batch_for_mesh)
+                   place_batch_for_mesh)
 from .grpo import GRPOConfig
 from .trainer import TrainState, train_step
 
@@ -186,40 +186,12 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         perf_monitor.record_ms("batch_build",
                                (_time.monotonic() - t_b) * 1000.0,
                                batch=len(trajectories))
-    if mesh is None:
-        old_logp = make_batch_logps(trajectories, tokens, mask)
-        tokens, mask, rewards, group_ids = map(
-            jnp.asarray, (tokens, mask, rewards, group_ids))
-    else:
-        # Explicitly place inputs with their batch/sequence sharding —
-        # relying on GSPMD propagation alone broadcasts host arrays to all
-        # devices before resharding (VERDICT r1 weak #5).
-        import jax as _jax
-        import numpy as _np
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..parallel.sharding import restrict_spec
-        axes = dict(zip(mesh.axis_names, _np.asarray(mesh.devices).shape))
-        tokens, mask, rewards, group_ids = pad_batch_for_mesh(
-            tokens, mask, rewards, group_ids,
-            batch_multiple=axes.get("dp", 1) * axes.get("fsdp", 1),
-            seq_multiple=axes.get("sp", 1), pad_id=pad_id)
-        # Batch axis only: S is k·sp+1 here (so the TRAINING length S−1
-        # shards over sp after the next-token shift inside the jit step) —
-        # the full-S array itself is not sp-divisible, so placing it with a
-        # sequence-sharded layout would raise. GSPMD reshards the sliced
-        # activations onto sp in-graph.
-        row_sh = NamedSharding(mesh, restrict_spec(P(("dp", "fsdp")), mesh))
-        grid_sh = NamedSharding(mesh,
-                                restrict_spec(P(("dp", "fsdp"), None), mesh))
-        # Align recorded behavior logps AFTER padding (padded rows have
-        # an all-False mask and contribute zeros).
-        old_logp = make_batch_logps(trajectories, tokens, mask)
-        tokens = _jax.device_put(tokens, grid_sh)
-        mask = _jax.device_put(mask, grid_sh)
-        rewards = _jax.device_put(rewards, row_sh)
-        group_ids = _jax.device_put(group_ids, row_sh)
-        if old_logp is not None:
-            old_logp = _jax.device_put(old_logp, grid_sh)
+    # Recorded behavior logps align on the UNPADDED batch (padding
+    # appends rows/columns, leaving existing positions fixed).
+    old_logp = make_batch_logps(trajectories, tokens, mask)
+    tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
+        mesh, tokens, mask, rewards, group_ids, old_logp, pad_id=pad_id,
+        accum_steps=accum_steps)
     # Multi-epoch (PPO-style) updates need the BEHAVIOR policy's logps
     # frozen across epochs — the clipped ratio is what bounds the drift.
     # Recorded sample-time logps are already exactly that; without them,
@@ -228,7 +200,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if ppo_epochs > 1 and old_logp is None:
         from .async_loop import _behavior_logp
         t_b = _time.monotonic()
-        toks_arr = jnp.asarray(tokens)
+        toks_arr = tokens
         if accum_steps > 1:
             # Respect the memory budget that made accum_steps necessary:
             # a whole-batch forward would materialize (B, S-1, V) logits
@@ -249,7 +221,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         if perf_monitor is not None:
             perf_monitor.record_ms("behavior_logp",
                                    (_time.monotonic() - t_b) * 1000.0)
-    old = jnp.asarray(old_logp) if old_logp is not None else None
+    old = old_logp
     t1 = _time.monotonic()
     for _ in range(ppo_epochs):
         state, metrics = train_step(

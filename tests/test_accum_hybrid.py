@@ -174,3 +174,24 @@ def test_remat_composes_with_accum_and_mesh(rng):
     st, m = train_step(st, cfg, mesh, tokens, mask, rewards, group_ids,
                        num_groups=4, accum_steps=2)
     assert np.isfinite(float(m["loss"])) and float(m["grad_norm"]) > 0
+
+
+def test_place_batch_pads_for_sp_and_accum(rng):
+    """Sequence padding (sp>1) must extend old_logp columns, and the
+    batch axis must land on lcm(dp·fsdp, accum_steps)."""
+    from senweaver_ide_tpu.parallel import make_mesh
+    from senweaver_ide_tpu.training.data import place_batch_for_mesh
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, sp=2),
+                     devices=jax.devices()[:4])
+    b, s = 4, 32                       # bucketed S: S-1=31, not sp-divisible
+    tokens = np.ones((b, s), np.int32)
+    mask = np.ones((b, s), bool)
+    old = np.full((b, s - 1), -0.5, np.float32)
+    t2, m2, r2, g2, o2 = place_batch_for_mesh(
+        mesh, tokens, mask, np.zeros((b,), np.float32),
+        np.zeros((b,), np.int32), old, accum_steps=3)
+    assert (t2.shape[1] - 1) % 2 == 0              # sp-divisible
+    assert t2.shape[0] % 6 == 0                    # lcm(2, 3)
+    assert o2.shape == (t2.shape[0], t2.shape[1] - 1)
+    np.testing.assert_allclose(np.asarray(o2[:b, :s - 1]), -0.5)

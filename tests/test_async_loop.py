@@ -113,3 +113,22 @@ def test_async_collector_error_propagates(rng):
     with pytest.raises(RuntimeError, match="collector failed"):
         trainer.run(1)
     assert isinstance(trainer._error, OSError)
+
+
+def test_async_on_mesh_places_batches(rng):
+    """Async trainer under a dp2/fsdp2 mesh: explicit batch placement
+    (the grpo_round path's semantics) and finite metrics."""
+    from senweaver_ide_tpu.parallel import MeshConfig, make_mesh
+
+    cfg = tiny_test()
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2), devices=jax.devices()[:4])
+    state = make_train_state(cfg, jax.random.PRNGKey(3), mesh,
+                             learning_rate=1e-3)
+    trainer = AsyncGRPOTrainer(
+        state, cfg, mesh, lambda: _FakeSession(rng), ["t1", "t2"],
+        group_size=2, pad_id=0, max_len=64, reward_override=_reward,
+        max_parallel=2)
+    results = trainer.run(2)
+    assert len(results) == 2
+    for r in results:
+        assert np.isfinite(r.metrics["loss"])
