@@ -121,7 +121,8 @@ class EnginePolicyClient:
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
-             max_tokens: Optional[int] = None) -> LLMResponse:
+             max_tokens: Optional[int] = None,
+             on_text=None) -> LLMResponse:
         prompt_text = render_chat_template(messages)
         prompt_ids = self.tokenizer.encode(prompt_text, add_bos=True)
         budget = max_tokens or self.default_max_new_tokens
@@ -168,8 +169,51 @@ class EnginePolicyClient:
                                          prefix_id=prefix_id,
                                          hold_slot=self.continue_turns,
                                          eos_id=self.tokenizer.eos_id)
-        while not self.engine.is_done(rid):
-            self.engine.step()
+        if on_text is None:
+            while not self.engine.is_done(rid):
+                self.engine.step()
+        else:
+            # Streaming (the reference's onText contract,
+            # sendLLMMessageService.ts). Three hazards, all handled by
+            # re-reading the AUTHORITATIVE engine.result(rid) each
+            # iteration and emitting only safe suffixes:
+            # - concurrent chat() loops share the engine, and step()'s
+            #   return drains other requests' emits — result(rid) is
+            #   complete regardless of who stepped;
+            # - a partial UTF-8 tail decodes to U+FFFD and would
+            #   retro-change, so trailing replacement chars are held
+            #   back (up to 3 bytes) until resolved;
+            # - the chat-template end marker arrives one token at a
+            #   time, so a trailing PREFIX of it is held back until it
+            #   completes (cut) or diverges (streamed).
+            sent = ""
+
+            def _safe_text(ids, final):
+                for hold in range(0, min(3, len(ids)) + 1):
+                    view = ids[:len(ids) - hold] if hold else ids
+                    text = self.tokenizer.decode(view)
+                    if final or not text.endswith("\ufffd"):
+                        break
+                end = text.find(_ROLE_CLOSE)
+                if end != -1:
+                    return text[:end]
+                if not final:
+                    for k in range(len(_ROLE_CLOSE) - 1, 0, -1):
+                        if text.endswith(_ROLE_CLOSE[:k]):
+                            return text[:len(text) - k]
+                return text
+
+            def _push(final=False):
+                nonlocal sent
+                text = _safe_text(self.engine.result(rid), final)
+                if text.startswith(sent) and len(text) > len(sent):
+                    on_text(text[len(sent):])
+                    sent = text
+
+            while not self.engine.is_done(rid):
+                self.engine.step()
+                _push()
+            _push(final=True)                 # flush held-back tail
         out_ids = self.engine.result(rid)
         if self.continue_turns:
             self._held_turn = (rid, list(prompt_ids) + list(out_ids))

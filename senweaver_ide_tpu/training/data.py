@@ -188,6 +188,16 @@ def place_batch_for_mesh(mesh, tokens, mask, rewards, group_ids,
 
     if mesh is None:
         import jax.numpy as _jnp
+        if accum_steps > 1 and tokens.shape[0] % accum_steps != 0:
+            # Same contract as the mesh path: the returned batch must
+            # satisfy the microbatch scan's divisibility check.
+            tokens, mask, rewards, group_ids = pad_batch_for_mesh(
+                tokens, mask, rewards, group_ids,
+                batch_multiple=accum_steps, pad_id=pad_id)
+            if old_logp is not None and old_logp.shape[0] < tokens.shape[0]:
+                old_logp = _np.pad(
+                    old_logp, ((0, tokens.shape[0] - old_logp.shape[0]),
+                               (0, 0)))
         out = tuple(map(_jnp.asarray, (tokens, mask, rewards, group_ids)))
         return out + ((_jnp.asarray(old_logp)
                        if old_logp is not None else None),)

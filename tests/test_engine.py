@@ -549,3 +549,77 @@ def test_engine_stats_track_reuse(model, rng):
     assert s["tokens_emitted"] == len(out1) + 2
     assert s["prefills"] == 1          # the continuation is NOT a prefill
     assert s["decode_steps"] >= 2
+
+
+def test_client_streaming_on_text(model, rng):
+    """chat(on_text=...) streams incremental text whose concatenation
+    equals the final response prefix (same tokens either way)."""
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+
+    params, config = model
+    tok = ByteTokenizer()
+    eng = RolloutEngine(params, config, num_slots=2, max_len=512,
+                        sample=GREEDY, eos_id=tok.eos_id)
+    client = EnginePolicyClient(eng, tok, default_max_new_tokens=10,
+                                record_calls=True)
+    msgs = [ChatMessage("user", "stream me")]
+    chunks = []
+    client.chat(msgs, temperature=0.0, on_text=chunks.append)
+    assert chunks and all(c for c in chunks)
+    streamed = "".join(chunks)
+    # the streamed chunks reassemble the RAW decoded stream (up to the
+    # template end marker); grammar extraction happens only at the end
+    _, out_ids, _ = client.call_log[-1]
+    raw = tok.decode(out_ids)
+    end = raw.find("<|im_end|>")
+    if end != -1:
+        raw = raw[:end]
+    assert streamed == raw
+
+
+def test_streaming_holds_back_marker_and_multibyte(model):
+    """Streaming must not leak a partial <|im_end|> marker or a
+    replacement char for a split multi-byte character — simulated
+    against the real ByteTokenizer via a stub engine."""
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+
+    tok = ByteTokenizer()
+    payload = "héllo"                       # é = 2 bytes, split mid-way
+    out_ids = tok.encode(payload) + tok.encode("<|im_end|>junk")
+
+    class StubEngine:
+        context_bound = 10_000
+        max_len = 10_000
+
+        def __init__(self):
+            self._n = 0
+
+        def submit(self, ids, **kw):
+            return 0
+
+        def step(self):
+            self._n = min(self._n + 1, len(out_ids))
+            return {}
+
+        def is_done(self, rid):
+            return self._n >= len(out_ids)
+
+        def result(self, rid):
+            return out_ids[:self._n]
+
+        def result_logps(self, rid):
+            return [0.0] * self._n
+
+    client = EnginePolicyClient(StubEngine(), tok,
+                                default_max_new_tokens=64)
+    chunks = []
+    r = client.chat([ChatMessage("user", "go")], on_text=chunks.append)
+    streamed = "".join(chunks)
+    assert streamed == payload              # no marker, no U+FFFD
+    assert "�" not in streamed
+    assert r.text == payload
