@@ -73,8 +73,11 @@ class RateLimitError(RuntimeError):
 class PolicyClient(Protocol):
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
-             max_tokens: Optional[int] = None) -> LLMResponse:
+             max_tokens: Optional[int] = None,
+             on_text=None) -> LLMResponse:
         """One model call. Must raise ContextLengthError / RateLimitError
         for those failure classes; any other exception is retried
-        generically."""
+        generically. ``on_text`` (optional) streams incremental text
+        (the reference's onText contract); implementations without true
+        streaming call it once with the final text before returning."""
         ...

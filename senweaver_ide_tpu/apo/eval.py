@@ -125,7 +125,7 @@ class RuleSensitivePolicy:
     improved_rules: Sequence[str] = tuple(GOOD_RULESET)
 
     def chat(self, messages: List[ChatMessage], *, temperature=None,
-             max_tokens=None) -> LLMResponse:
+             max_tokens=None, on_text=None) -> LLMResponse:
         sysmsg = messages[0] if messages and messages[0].role == "system" \
             else None
         if sysmsg is None:

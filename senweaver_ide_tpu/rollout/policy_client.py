@@ -210,9 +210,13 @@ class EnginePolicyClient:
                     on_text(text[len(sent):])
                     sent = text
 
+            seen = 0
             while not self.engine.is_done(rid):
                 self.engine.step()
-                _push()
+                n = len(self.engine.result(rid))
+                if n > seen:      # skip re-decoding when queued/no emit
+                    seen = n
+                    _push()
             _push(final=True)                 # flush held-back tail
         out_ids = self.engine.result(rid)
         if self.continue_turns:

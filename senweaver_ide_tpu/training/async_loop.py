@@ -56,6 +56,22 @@ def _behavior_logp(params, config, tokens: jax.Array) -> jax.Array:
     return token_logprobs(logits, tokens[:, 1:])
 
 
+def behavior_logp_batched(params, config, tokens: jax.Array,
+                          accum_steps: int = 1) -> jax.Array:
+    """Behavior logps with the SAME microbatch split as the update:
+    a whole-batch forward materializes (B, S-1, V) logits — the exact
+    allocation accum_steps was sized to avoid. Batch must be
+    accum-divisible (place_batch_for_mesh guarantees it)."""
+    b = tokens.shape[0]
+    if accum_steps <= 1 or b % accum_steps != 0:
+        return _behavior_logp(params, config, tokens)
+    mb = b // accum_steps
+    import jax.numpy as _jnp
+    return _jnp.concatenate(
+        [_behavior_logp(params, config, tokens[i * mb:(i + 1) * mb])
+         for i in range(accum_steps)], axis=0)
+
+
 @dataclass
 class AsyncRoundResult:
     state: TrainState
@@ -87,6 +103,7 @@ class AsyncGRPOTrainer:
                  reward_override=None,
                  max_parallel: int = 8,
                  accum_steps: int = 1,
+                 ppo_epochs: int = 1,
                  prefetch: int = 1,
                  importance_correction: bool = True,
                  publish_params: Optional[Callable[[object], None]] = None,
@@ -103,6 +120,9 @@ class AsyncGRPOTrainer:
         self.reward_override = reward_override
         self.max_parallel = max_parallel
         self.accum_steps = accum_steps
+        if ppo_epochs < 1:
+            raise ValueError(f"ppo_epochs must be >= 1, got {ppo_epochs}")
+        self.ppo_epochs = ppo_epochs
         self.importance_correction = importance_correction
         self.publish_params = publish_params
         self.metrics_service = metrics_service
@@ -184,17 +204,25 @@ class AsyncGRPOTrainer:
         tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
             self.mesh, tokens, mask, rewards, group_ids, recorded,
             pad_id=self.pad_id, accum_steps=self.accum_steps)
-        if (old_logp is None and self.importance_correction
-                and staleness > 0):
-            # Sample-time logps absent: fall back to a forward under
-            # the kept behavior params.
-            old_logp = _behavior_logp(item.behavior_params,
-                                      self.model_config, tokens)
+        if (old_logp is None
+                and (self.ppo_epochs > 1
+                     or (self.importance_correction and staleness > 0))):
+            # Multi-epoch updates REQUIRE frozen behavior logps —
+            # without them epochs 2+ recompute ratio==1 against the
+            # already-updated params and clipping never engages — so
+            # they are computed here regardless of the
+            # importance_correction flag (which governs only the
+            # 1-epoch staleness case). Microbatched like the update.
+            old_logp = behavior_logp_batched(item.behavior_params,
+                                             self.model_config, tokens,
+                                             self.accum_steps)
 
-        self.state, metrics = train_step(
-            self.state, self.model_config, self.mesh, tokens, mask,
-            rewards, group_ids, old_logp=old_logp,
-            grpo_config=self.grpo_config, accum_steps=self.accum_steps)
+        for _ in range(self.ppo_epochs):
+            self.state, metrics = train_step(
+                self.state, self.model_config, self.mesh, tokens, mask,
+                rewards, group_ids, old_logp=old_logp,
+                grpo_config=self.grpo_config,
+                accum_steps=self.accum_steps)
         self._version += 1
         if self.publish_params is not None:
             self.publish_params(self.state.params)

@@ -131,6 +131,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                ppo_epochs: int = 1,
                metrics_service=None,
                perf_monitor=None,
+               engine=None,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -155,14 +156,14 @@ def grpo_round(state: TrainState, model_config, mesh,
             group_size=group_size, pad_id=pad_id, max_len=max_len,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
-            perf_monitor=perf_monitor)
+            perf_monitor=perf_monitor, engine=engine)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      group_size, pad_id, max_len, grpo_config,
                      reward_override, max_parallel, accum_steps=1,
                      ppo_epochs=1, metrics_service=None,
-                     perf_monitor=None) -> RoundResult:
+                     perf_monitor=None, engine=None) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
@@ -198,26 +199,10 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     # one extra forward under the pre-update params captures them
     # (timed separately so 'train_step' stays a pure update metric).
     if ppo_epochs > 1 and old_logp is None:
-        from .async_loop import _behavior_logp
+        from .async_loop import behavior_logp_batched
         t_b = _time.monotonic()
-        toks_arr = tokens
-        if accum_steps > 1:
-            # Respect the memory budget that made accum_steps necessary:
-            # a whole-batch forward would materialize (B, S-1, V) logits
-            # the microbatched update was sized to avoid. Indivisible
-            # batches fail HERE, before that allocation — train_step
-            # would reject them anyway.
-            if toks_arr.shape[0] % accum_steps != 0:
-                raise ValueError(
-                    f"batch {toks_arr.shape[0]} not divisible by "
-                    f"accum_steps {accum_steps}")
-            mb = toks_arr.shape[0] // accum_steps
-            old_logp = jnp.concatenate(
-                [_behavior_logp(state.params, model_config,
-                                toks_arr[i * mb:(i + 1) * mb])
-                 for i in range(accum_steps)], axis=0)
-        else:
-            old_logp = _behavior_logp(state.params, model_config, toks_arr)
+        old_logp = behavior_logp_batched(state.params, model_config,
+                                         tokens, accum_steps)
         if perf_monitor is not None:
             perf_monitor.record_ms("behavior_logp",
                                    (_time.monotonic() - t_b) * 1000.0)
@@ -234,8 +219,14 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                                epochs=ppo_epochs)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
+        # Engine serving counters (reuse efficiency) belong in the round
+        # record when the caller shares its engine for observability.
+        engine_stats = ({f"engine_{k}": v for k, v in engine.stats().items()}
+                        if engine is not None and hasattr(engine, "stats")
+                        else {})
         metrics_service.capture("GRPO Round Done", {
             "tasks": len(tasks), "group_size": group_size,
+            **engine_stats,
             "episodes": len(episodes),
             "trajectories": len(trajectories),
             "batch_tokens": int(tokens.size),

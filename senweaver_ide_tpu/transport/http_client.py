@@ -54,7 +54,8 @@ class OpenAICompatClient:
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
-             max_tokens: Optional[int] = None) -> LLMResponse:
+             max_tokens: Optional[int] = None,
+             on_text=None) -> LLMResponse:
         body = {
             "model": self.model,
             "messages": [{"role": m.role if m.role != "tool" else "user",
@@ -67,12 +68,15 @@ class OpenAICompatClient:
         payload = self._post("/chat/completions", body)
         choice = (payload.get("choices") or [{}])[0]
         usage = payload.get("usage") or {}
-        return LLMResponse(
+        resp = LLMResponse(
             text=(choice.get("message") or {}).get("content") or "",
             usage=LLMUsage(
                 input_tokens=int(usage.get("prompt_tokens", 0)),
                 output_tokens=int(usage.get("completion_tokens", 0))),
             model=payload.get("model", self.model))
+        if on_text is not None and resp.text:
+            on_text(resp.text)      # end-flush: no HTTP streaming here
+        return resp
 
     def fim_complete(self, prefix: str, suffix: str = "", *,
                      max_tokens: int = 64,

@@ -92,7 +92,8 @@ class AnthropicMessagesClient:
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
-             max_tokens: Optional[int] = None) -> LLMResponse:
+             max_tokens: Optional[int] = None,
+             on_text=None) -> LLMResponse:
         system, rest = _split_system(messages)
         body = {
             "model": self.model,
@@ -117,11 +118,14 @@ class AnthropicMessagesClient:
                        for block in payload.get("content", [])
                        if block.get("type") == "text")
         usage = payload.get("usage") or {}
-        return LLMResponse(
+        resp = LLMResponse(
             text=text,
             usage=LLMUsage(input_tokens=int(usage.get("input_tokens", 0)),
                            output_tokens=int(usage.get("output_tokens", 0))),
             model=payload.get("model", self.model))
+        if on_text is not None and resp.text:
+            on_text(resp.text)      # end-flush: non-streaming transport
+        return resp
 
 
 class GeminiClient:
@@ -142,7 +146,8 @@ class GeminiClient:
 
     def chat(self, messages: List[ChatMessage], *,
              temperature: Optional[float] = None,
-             max_tokens: Optional[int] = None) -> LLMResponse:
+             max_tokens: Optional[int] = None,
+             on_text=None) -> LLMResponse:
         system, rest = _split_system(messages)
         contents = []
         for m in rest:
@@ -168,12 +173,15 @@ class GeminiClient:
         parts = ((cands[0].get("content") or {}).get("parts")) or []
         text = "".join(p.get("text", "") for p in parts)
         meta = payload.get("usageMetadata") or {}
-        return LLMResponse(
+        resp = LLMResponse(
             text=text,
             usage=LLMUsage(
                 input_tokens=int(meta.get("promptTokenCount", 0)),
                 output_tokens=int(meta.get("candidatesTokenCount", 0))),
             model=payload.get("modelVersion", self.model))
+        if on_text is not None and resp.text:
+            on_text(resp.text)      # end-flush: non-streaming transport
+        return resp
 
 
 def make_client(provider: str, **kwargs):

@@ -202,3 +202,34 @@ def test_grpo_round_multi_epoch(tmp_path, tiny_stack):
     assert np.isfinite(out.metrics["loss"])
     # after ≥1 update the policy moved: epoch-3 ratios are off 1
     assert abs(out.metrics["ratio_mean"] - 1.0) > 1e-6
+
+
+def test_grpo_round_captures_engine_stats(tmp_path, tiny_stack):
+    """grpo_round(engine=...) surfaces serving counters in the metrics
+    capture; async ppo_epochs multiplies update steps."""
+    from senweaver_ide_tpu.services.metrics import MetricsService
+
+    config, state = tiny_stack
+    tok = ByteTokenizer()
+    shared = RolloutEngine(state.params, config, num_slots=2,
+                           max_len=4096, eos_id=tok.eos_id, seed=77)
+    made = []
+
+    def make_session():
+        client = EnginePolicyClient(shared, tok, default_max_new_tokens=6,
+                                    record_calls=True)
+        s = RolloutSession(client, str(tmp_path / f"st{len(made)}"),
+                           include_tool_definitions=False)
+        made.append(s)
+        return s
+
+    captured = []
+    metrics = MetricsService(jsonl_path=str(tmp_path / "m.jsonl"))
+    metrics.capture = lambda ev, props: captured.append((ev, props))
+    out = grpo_round(state, config, None, make_session, ["t"],
+                     group_size=2, pad_id=tok.pad_id, max_len=2048,
+                     metrics_service=metrics, engine=shared,
+                     reward_override=lambda ti, g, s: float(g) - 0.5)
+    done = [p for ev, p in captured if ev == "GRPO Round Done"]
+    assert done and done[0]["engine_tokens_emitted"] > 0
+    assert done[0]["engine_prefill_tokens"] > 0
