@@ -623,3 +623,50 @@ def test_streaming_holds_back_marker_and_multibyte(model):
     assert streamed == payload              # no marker, no U+FFFD
     assert "�" not in streamed
     assert r.text == payload
+
+
+def test_concurrent_streaming_chats_share_engine(model):
+    """Two threads streaming on ONE engine: each stream must reassemble
+    its own raw output exactly (step() returns drain across threads;
+    the client reads authoritative per-request results instead)."""
+    import threading
+
+    from senweaver_ide_tpu.agents.llm import ChatMessage
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import EnginePolicyClient
+    from senweaver_ide_tpu.rollout.engine import RolloutEngine
+
+    params, config = model
+    tok = ByteTokenizer()
+    eng = RolloutEngine(params, config, num_slots=2, max_len=512,
+                        sample=GREEDY, eos_id=tok.eos_id)
+    results = {}
+
+    def worker(name):
+        try:
+            client = EnginePolicyClient(eng, tok,
+                                        default_max_new_tokens=12,
+                                        record_calls=True)
+            chunks = []
+            client.chat([ChatMessage("user", f"task {name}")],
+                        temperature=0.0, on_text=chunks.append)
+            _, out_ids, _ = client.call_log[-1]
+            raw = tok.decode(out_ids)
+            end = raw.find("<|im_end|>")
+            results[name] = ("".join(chunks),
+                             raw[:end] if end != -1 else raw)
+        except BaseException as e:         # surfaced in the main thread
+            results[name] = e
+
+    threads = [threading.Thread(target=worker, args=(n,), daemon=True)
+               for n in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive(), "streaming chat wedged"
+    assert set(results) == {"a", "b"}
+    for name, val in results.items():
+        assert not isinstance(val, BaseException), (name, val)
+        streamed, raw = val
+        assert streamed and streamed == raw, name
