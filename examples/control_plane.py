@@ -53,6 +53,9 @@ runner = JobRunner(server, make_session=make_session, train_state=state,
                    reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0)
 server.start(); runner.start()
 CTL = ctl_binary_path()
+if CTL is None:
+    sys.exit("senweaver-ctl binary unavailable (native build failed — "
+             "install a C++ toolchain and rebuild native/senweaver_ctl.cpp)")
 
 def ctl(*args):
     p = subprocess.run([CTL, "--socket", server.socket_path, "--interval", "1",

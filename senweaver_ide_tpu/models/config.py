@@ -139,16 +139,21 @@ def mistral_7b() -> ModelConfig:
 def mixtral_8x7b() -> ModelConfig:
     """Mixtral-8x7B-v0.1: the SWA + MoE composition.
 
-    Mistral-family GQA with the 4096 sliding window AND an 8-expert
-    top-2 routed FFN — exercises the ring KV cache and the
-    expert-parallel path (parallel/expert.py, 'ep' mesh axis) in one
-    architecture. Reference serves Mixtral through its mistral/openai
-    providers (capability DB substring families)."""
+    Mistral-family GQA with an 8-expert top-2 routed FFN — exercises
+    the expert-parallel path (parallel/expert.py, 'ep' mesh axis) on a
+    real released architecture. Released Mixtral-8x7B checkpoints use
+    FULL dense attention over 32k (HF config.json: sliding_window null),
+    so this preset does too — serving real weights with a window would
+    silently mask attention past it and corrupt long-context logits.
+    (The SWA+MoE *composition* is still covered: tiny-moe-test + a
+    sliding_window override exercises the ring KV cache with experts.)
+    Reference serves Mixtral through its mistral/openai providers
+    (capability DB substring families)."""
     return ModelConfig(
         name="mixtral-8x7b", vocab_size=32_000, hidden_size=4096,
         intermediate_size=14_336, num_layers=32, num_heads=32,
         num_kv_heads=8, head_dim=128, max_seq_len=32_768,
-        rope_theta=1_000_000.0, rms_norm_eps=1e-5, sliding_window=4096,
+        rope_theta=1_000_000.0, rms_norm_eps=1e-5, sliding_window=None,
         num_experts=8, num_experts_per_tok=2)
 
 

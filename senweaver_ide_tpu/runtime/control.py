@@ -88,6 +88,20 @@ class ControlServer:
             job.status = "stopped"
         return {"job_id": job_id, "status": "stopped"}
 
+    def cas_job_status(self, job: Job, new_status: str, *,
+                       unless: tuple = ("stopped",)) -> bool:
+        """Atomically set ``job.status`` unless it is already in ``unless``.
+
+        The public check-and-set executors need: a worker marking a job
+        running/done/failed must not clobber a concurrent ``stop`` RPC
+        (which writes under the same lock). Returns True when the
+        transition happened."""
+        with self._lock:
+            if job.status in unless:
+                return False
+            job.status = new_status
+            return True
+
     def register(self, method: str, fn: Callable[[Any], Any]) -> None:
         self._handlers[method] = fn
 

@@ -86,28 +86,22 @@ class JobRunner:
                 job = self._queue.get(timeout=0.2)
             except queue.Empty:
                 continue
-            # Status transitions race with the server thread's stop RPC
-            # (which writes under the server lock): take the same lock
-            # for check-and-set so a stop is never clobbered.
-            with self.server._lock:
-                if job.status == "stopped":     # cancelled while queued
-                    continue
-                job.status = "running"
+            # Status transitions race with the server thread's stop RPC;
+            # the server's public check-and-set serializes them so a
+            # stop is never clobbered.
+            if not self.server.cas_job_status(job, "running"):
+                continue                        # cancelled while queued
             try:
                 job.result = self._run_job(job)
-                with self.server._lock:
-                    if job.status != "stopped":
-                        job.status = "done"
+                self.server.cas_job_status(job, "done")
             except Exception as e:
                 # result BEFORE status (a poller keying on the terminal
                 # status must find the error populated), and the same
-                # lock discipline as the success path (a stop that
+                # CAS discipline as the success path (a stop that
                 # already ACKed must not be overwritten).
                 job.result = {"error": f"{type(e).__name__}: {e}",
                               "traceback": traceback.format_exc()[-2000:]}
-                with self.server._lock:
-                    if job.status != "stopped":
-                        job.status = "failed"
+                self.server.cas_job_status(job, "failed")
 
     def _run_job(self, job: Job) -> Dict[str, Any]:
         spec = job.params if isinstance(job.params, dict) else {}

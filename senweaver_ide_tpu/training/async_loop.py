@@ -21,12 +21,17 @@ correction modes:
 - ``importance_correction=False``: ``old_logp = stop_grad(current)``
   (ratio 1), the standard 1-step-stale approximation.
 
-Weight publication: after each update the new params go to
-``publish_params`` (wire it to ``RolloutEngine.update_params``) so the
-collector's next episodes sample from the freshest policy — the
-single-chip analogue of the disaggregated actor/learner weight transfer
-(RLAX; reference semantic: the APO cycle's "apply optimized prompt to
-the live agent", apoService.ts:1219-1264, upgraded to weights).
+Weight publication: each update stages its params for ``publish_params``
+(wire it to ``RolloutEngine.update_params``), and the collector applies
+the latest staged set at its next ROUND BOUNDARY — never mid-round, so
+the retained ``behavior_params`` snapshot is exactly what every episode
+in the round sampled under (a mid-round swap would silently break the
+importance correction for episodes finishing after it). Publications
+coalesce (latest wins); the final update's params are always flushed
+when ``run`` returns — the single-chip analogue of the disaggregated
+actor/learner weight transfer (RLAX; reference semantic: the APO cycle's
+"apply optimized prompt to the live agent", apoService.ts:1219-1264,
+upgraded to weights).
 """
 
 from __future__ import annotations
@@ -129,6 +134,14 @@ class AsyncGRPOTrainer:
 
         self._queue: "queue.Queue[_Collected]" = queue.Queue(
             maxsize=max(1, prefetch))
+        self._publish_lock = threading.Lock()
+        # Staged (version, params) awaiting publication; the collector
+        # applies it at round boundaries. _applied_behavior is the last
+        # APPLIED pair — what the serving engine is actually running —
+        # and is only touched by _flush_pending_publish (collector
+        # thread, or run()'s finally after the collector joined).
+        self._pending_publish: Optional[tuple] = None
+        self._applied_behavior: tuple = (0, state.params)
         self._version = 0
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -136,12 +149,38 @@ class AsyncGRPOTrainer:
         self._thread: Optional[threading.Thread] = None
 
     # -- collector side ---------------------------------------------------
+    def _flush_pending_publish(self) -> None:
+        """Apply the latest staged publication (if any) to the engine and
+        remember it as the live behavior snapshot."""
+        with self._publish_lock:
+            pending = self._pending_publish
+            self._pending_publish = None
+        if pending is not None and self.publish_params is not None:
+            self.publish_params(pending[1])
+            self._applied_behavior = pending
+
     def _collect_loop(self) -> None:
         produced = 0
         try:
             while not self._stop.is_set() and produced < self._rounds_wanted:
-                version = self._version
-                params = self.state.params   # reference, not a copy
+                # Apply any params published since the last round BEFORE
+                # sampling starts: publication is deferred to collection
+                # round boundaries (see _train_on) so every episode in a
+                # round was sampled under exactly the (version, params)
+                # snapshot recorded here — a mid-round engine weight swap
+                # would make the retained behavior_params wrong for the
+                # episodes that finished after it.
+                self._flush_pending_publish()
+                if self.publish_params is not None:
+                    # The engine serves exactly the last APPLIED pair —
+                    # never a racy read of live trainer state (a train
+                    # step may complete between the flush and here).
+                    version, params = self._applied_behavior
+                else:
+                    # No publication channel: sessions read trainer state
+                    # directly, so the live reference IS the behavior.
+                    version = self._version
+                    params = self.state.params   # reference, not a copy
                 t0 = time.monotonic()
                 trajectories, episodes = collect_group_trajectories(
                     self.make_session, self.tasks,
@@ -186,6 +225,14 @@ class AsyncGRPOTrainer:
         finally:
             self._stop.set()
             self._thread.join(timeout=30)
+            # Collector is down — flush the last pending publication so
+            # the serving engine always ends on the final params even
+            # though intermediate publishes coalesce (latest wins). If
+            # the join timed out (a wedged session), the collector still
+            # owns publication: flushing here would race its next round
+            # boundary and reintroduce the mid-round swap.
+            if not self._thread.is_alive():
+                self._flush_pending_publish()
         return results
 
     def _train_on(self, item: _Collected,
@@ -225,7 +272,13 @@ class AsyncGRPOTrainer:
                 accum_steps=self.accum_steps)
         self._version += 1
         if self.publish_params is not None:
-            self.publish_params(self.state.params)
+            # Defer to the collector's next round boundary (latest wins):
+            # swapping engine weights mid-collection would invalidate the
+            # behavior_params snapshot for in-flight episodes. Version
+            # and params are staged TOGETHER so the collector's applied
+            # snapshot is always a coherent pair.
+            with self._publish_lock:
+                self._pending_publish = (self._version, self.state.params)
 
         out = {k: float(v) for k, v in metrics.items()}
         if self.metrics_service is not None:

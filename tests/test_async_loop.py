@@ -64,7 +64,10 @@ def test_async_pipeline_runs_rounds(rng):
                             publish_params=lambda p: published.append(p))
     results = trainer.run(3)
     assert len(results) == 3
-    assert len(published) == 3                      # weight sync per round
+    # Publication is deferred to collector round boundaries and
+    # coalesces (latest wins), but the final params always flush.
+    assert 1 <= len(published) <= 3
+    assert published[-1] is trainer.state.params
     for r in results:
         assert r.staleness in (0, 1, 2)
         assert np.isfinite(r.metrics["loss"])
