@@ -24,7 +24,17 @@ def main() -> None:
     ap.add_argument("--model-dir", default=None,
                     help="local HF-layout checkpoint; default = scripted "
                          "hermetic policy")
-    ap.add_argument("--beam-rounds", type=int, default=2)
+    ap.add_argument("--config", default="qwen2.5-coder-1.5b",
+                    help="ModelConfig preset the checkpoint matches "
+                         "(models/config.py PRESETS; e.g. tiny-test for "
+                         "the fixture checkpoint)")
+    ap.add_argument("--beam-rounds", type=int, default=3)
+    ap.add_argument("--max-new-tokens", type=int, default=256,
+                    help="per-call decode budget for the real policy")
+    ap.add_argument("--tasks", type=int, default=None,
+                    help="run only the first N pattern tasks (smoke runs)")
+    ap.add_argument("--engine-max-len", type=int, default=4096,
+                    help="serving context bound for the real policy")
     args = ap.parse_args()
 
     if not args.model_dir:
@@ -39,20 +49,32 @@ def main() -> None:
 
     client = None
     if args.model_dir:
-        import jax
-
         from senweaver_ide_tpu.models import (get_config, load_hf_params,
                                               load_tokenizer)
         from senweaver_ide_tpu.rollout import (EnginePolicyClient,
                                                RolloutEngine)
-        config = get_config("qwen2.5-coder-1.5b")
+        config = get_config(args.config)
+        if config.name.startswith("tiny"):
+            # Fixture checkpoints are CPU-sized; don't gamble on the
+            # accelerator tunnel for a smoke of the loading path.
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         params = load_hf_params(args.model_dir, config)
-        engine = RolloutEngine(params, config)
-        client = EnginePolicyClient(engine, load_tokenizer(args.model_dir))
+        engine = RolloutEngine(params, config, max_len=args.engine_max_len)
+        client = EnginePolicyClient(engine, load_tokenizer(args.model_dir),
+                                    default_max_new_tokens=args.max_new_tokens,
+                                    record_calls=False)
 
+    from senweaver_ide_tpu.apo.eval import SIX_PATTERN_TASKS
+    tasks = tuple(SIX_PATTERN_TASKS[:args.tasks] if args.tasks
+                  else SIX_PATTERN_TASKS)
     with tempfile.TemporaryDirectory() as workdir:
-        report = run_uplift_eval(workdir, client=client,
+        report = run_uplift_eval(workdir, client=client, tasks=tasks,
                                  beam_rounds=args.beam_rounds)
+    if args.model_dir:
+        report["policy"] = {"model_dir": args.model_dir,
+                            "config": args.config,
+                            "max_new_tokens": args.max_new_tokens}
     print(json.dumps(report))
 
 

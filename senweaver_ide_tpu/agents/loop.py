@@ -126,6 +126,11 @@ class AgentLoop:
                 wait = (e.retry_after_s if e.retry_after_s is not None
                         else retry_delay_s(attempt, is_tpm=True))
                 self.sleep(min(wait, MAX_RETRY_DELAY_S))
+            except PermissionError:
+                # Access gating (e.g. services.config.GatedPolicyClient's
+                # live allowed_models check) is a policy decision, not a
+                # transient fault — retrying cannot change the verdict.
+                raise
             except Exception as e:                      # generic retry path
                 last_err = e
                 if attempt == CHAT_RETRIES:

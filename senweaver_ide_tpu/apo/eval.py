@@ -105,15 +105,41 @@ def make_rollout_score_fn(
     return score
 
 
+def task_pattern(messages: Sequence[ChatMessage]) -> str:
+    """Extract the '(pattern: X)' tag from the episode's user message.
+
+    The 6-pattern task suite tags each task with the failure mode it
+    probes (apoService.ts:643-770's problem taxonomy); the scripted
+    policy keys its sloppy behavior off the tag so every pattern
+    produces ITS OWN failure signature instead of one generic shape."""
+    for m in messages:
+        if m.role == "user" and "(pattern: " in m.content:
+            return m.content.rsplit("(pattern: ", 1)[1].split(")")[0]
+    return ""
+
+
 @dataclasses.dataclass
 class RuleSensitivePolicy:
     """Deterministic scripted PolicyClient for the hermetic APO eval.
 
     Agent-loop calls (a system message is present): reads the
     '# APO Optimized Rules' section; with a careful rule-set it performs
-    one successful read of ``good_file`` then answers; without, it burns
-    ``sloppy_calls`` failing tool calls and heavy token usage first —
-    the 6-pattern failure shape.
+    one successful read of ``good_file`` then answers. Without, it
+    reproduces the task's tagged problem pattern with the SEVERITY the
+    reference's reward thresholds define for agent mode
+    (traceCollectorService.ts:701-762 — fail severe≥5, call count
+    fair>25, tokens poor>30k, LLM-call threshold 3):
+
+    - errors        → 2 failed reads, then the stream crashes (the agent
+                      loop exhausts retries → record_error → hasErrors)
+    - tool failures → 5 failed tool calls (severe band)
+    - token blowup  → 3 calls at 16k tokens each (>30k total)
+    - retries       → 26 blind retries of the same failing read (>25)
+    - churn         → 9 successful re-reads of the same file (pure
+                      repetition: llm_calls ≫ threshold 3, call count
+                      past the agent 'excellent' band — no failures)
+    - slow tools    → 5 failed external lookups
+    - (untagged)    → the generic ``sloppy_calls`` failing-read shape
 
     Optimizer calls (no system message): recognizes the textual-gradient
     and apply-edit prompt shapes (apo/gradient.py) and returns a critique /
@@ -143,14 +169,51 @@ class RuleSensitivePolicy:
                     usage=LLMUsage(300, 40), model="scripted")
             return LLMResponse(text="Done: verified and fixed.",
                                usage=LLMUsage(300, 40), model="scripted")
-        if tool_msgs < self.sloppy_calls:
+        return self._sloppy_call(task_pattern(messages), tool_msgs)
+
+    def _sloppy_call(self, pattern: str, tool_msgs: int) -> LLMResponse:
+        def fail_read(usage=LLMUsage(1500, 400)):
             return LLMResponse(
                 text="Trying something.",
                 tool_call=ToolCallRequest(
                     "read_file", {"uri": f"missing_{tool_msgs}.py"}),
+                usage=usage, model="scripted")
+
+        def done(usage=LLMUsage(1500, 400)):
+            return LLMResponse(text="It might be fixed now, not sure.",
+                               usage=usage, model="scripted")
+
+        if pattern == "errors":
+            if tool_msgs < 2:
+                return fail_read()
+            raise RuntimeError("model stream crashed mid-response")
+        if pattern in ("tool failures", "slow tools"):
+            return fail_read() if tool_msgs < 5 else done()
+        if pattern == "token blowup":
+            heavy = LLMUsage(12_000, 4_000)
+            return fail_read(heavy) if tool_msgs < 3 else done(heavy)
+        if pattern == "retries":
+            return (LLMResponse(
+                text="Retrying the same thing.",
+                tool_call=ToolCallRequest("read_file",
+                                          {"uri": "missing_0.py"}),
                 usage=LLMUsage(1500, 400), model="scripted")
-        return LLMResponse(text="It might be fixed now, not sure.",
-                           usage=LLMUsage(1500, 400), model="scripted")
+                if tool_msgs < 26 else done())
+        if pattern == "churn":
+            # Back-and-forth: re-reading the SAME (existing) file over
+            # and over — every call succeeds, so churn's signature is
+            # pure repetition (llm_calls ≫ threshold 3, call count past
+            # the 'excellent' band), distinct from the tool-failure
+            # patterns. (The loop only continues on tool calls, so churn
+            # manifests as repeated successful lookups.)
+            if tool_msgs < 9:
+                return LLMResponse(
+                    text="Let me reconsider the approach.",
+                    tool_call=ToolCallRequest("read_file",
+                                              {"uri": self.good_file}),
+                    usage=LLMUsage(1500, 400), model="scripted")
+            return done()
+        return fail_read() if tool_msgs < self.sloppy_calls else done()
 
     # -- optimizer-side scripted responses --------------------------------
     def _optimizer_call(self, prompt: str) -> LLMResponse:
@@ -175,9 +238,29 @@ class RuleSensitivePolicy:
         return section[:nxt] if nxt >= 0 else section
 
 
+def outcome_feedback(turn_result) -> Optional[str]:
+    """Deterministic evaluator-in-the-loop: judge an episode good/bad
+    from its OUTCOME (the automatic analogue of the reference's
+    user-feedback signal, the highest-weight reward dim).
+
+    Good = the agent acted (≥1 successful tool call) with zero failures,
+    no stream errors, and no churning (LLM calls within 2x the agent
+    response threshold of 3 — catches the repetition pattern, whose
+    tool calls all succeed); bad otherwise. Applied SYMMETRICALLY to
+    baseline and optimized rollouts (r2's harness fed 'bad' only to the
+    baseline pass, which understated the baseline and left the optimized
+    score without its feedback dim)."""
+    trace = getattr(turn_result, "trace", None) or turn_result
+    s = trace.summary
+    if (s.has_errors or s.tool_calls_failed > 0
+            or s.tool_calls_succeeded == 0 or s.total_llm_calls > 6):
+        return "bad"
+    return "good"
+
+
 def run_uplift_eval(workdir: str, *, client=None,
                     tasks: Sequence[str] = tuple(SIX_PATTERN_TASKS),
-                    beam_rounds: int = 2) -> dict:
+                    beam_rounds: int = 3) -> dict:
     """Baseline-vs-optimized finalReward on the pattern task suite (the
     north-star ≥2× comparison, BASELINE configs 2-3), fully offline.
 
@@ -199,37 +282,36 @@ def run_uplift_eval(workdir: str, *, client=None,
     def make_session(rules, collector=None):
         ws_counter[0] += 1
         root = os.path.join(workdir, f"ws{ws_counter[0]}")
+        # loop_sleep no-op: the 'errors' pattern exhausts the agent
+        # loop's retry ladder by design; hermetic scoring must not serve
+        # its real exponential backoffs.
         s = RolloutSession(client, root, apo_rules=list(rules),
                           collector=collector,
-                          include_tool_definitions=False)
+                          include_tool_definitions=False,
+                          loop_sleep=lambda _s: None)
         s.workspace.write_file("app.py", "def run():\n    return 1\n")
         return s
+
+    # The same outcome evaluator feeds BOTH passes (and the beam's
+    # candidate scoring below) — symmetric feedback, judged from each
+    # episode's own outcome.
+    feedback_fn = lambda _i, out: outcome_feedback(out)
 
     # Baseline pass also populates the APO corpus (with the reference's
     # feedback gate satisfied: gradient needs feedback'd traces).
     corpus = TraceCollector()
-    baseline_traces: List[Trace] = []
-    for task in tasks:
-        s = make_session([], collector=corpus)
-        try:
-            out = s.run_turn(task)
-            s.record_feedback("bad")
-            if out.trace is not None:
-                baseline_traces.append(corpus.get_trace(out.trace.id))
-        finally:
-            s.close()
-    import jax.numpy as jnp
-
-    feats = jnp.asarray(batch_features([t for t in baseline_traces if t]))
-    baseline = float(jnp.mean(reward_head_batch(feats).final_reward))
+    baseline = evaluate_rules([], lambda rules: make_session(rules, corpus),
+                              tasks, feedback_fn=feedback_fn)
 
     apo = make_local_apo(
         corpus, client,
         config=APOConfig(beam_rounds=beam_rounds),
-        score_fn=make_rollout_score_fn(make_session, tasks))
+        score_fn=make_rollout_score_fn(make_session, tasks,
+                                       feedback_fn=feedback_fn))
     state = apo.run_beam_search(seed_prompt="")
     optimized_rules = apo.get_optimized_rules()
-    optimized = evaluate_rules(optimized_rules, make_session, tasks)
+    optimized = evaluate_rules(optimized_rules, make_session, tasks,
+                               feedback_fn=feedback_fn)
 
     delta = optimized - baseline
     return {
@@ -243,4 +325,5 @@ def run_uplift_eval(workdir: str, *, client=None,
         "optimized_rules": list(optimized_rules),
         "beam_rounds": state.current_round,
         "tasks": len(tasks),
+        "evaluator": "outcome_feedback (symmetric)",
     }

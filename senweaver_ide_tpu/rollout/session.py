@@ -48,7 +48,8 @@ class RolloutSession:
                  skills: Optional[SkillService] = None,
                  apo_rules: Optional[List[str]] = None,
                  include_tool_definitions: bool = True,
-                 perf_monitor=None):
+                 perf_monitor=None,
+                 loop_sleep=None):
         self.client = client
         self.chat_mode = chat_mode
         self.thread_id = thread_id
@@ -66,9 +67,13 @@ class RolloutSession:
         self.history: List[ChatMessage] = []
         self._message_idx = 0
         self._wire_agent_tools()
+        # loop_sleep: injectable retry-backoff sleep (AgentLoop's own
+        # test seam). Hermetic eval harnesses pass a no-op so scripted
+        # error-pattern episodes don't serve real exponential backoffs.
+        loop_kw = {} if loop_sleep is None else {"sleep": loop_sleep}
         self.loop = AgentLoop(client, self.tools,
                               collector=self.collector,
-                              thread_id=thread_id)
+                              thread_id=thread_id, **loop_kw)
 
     # -- tool wiring (the DI graph) ---------------------------------------
     def _wire_agent_tools(self) -> None:

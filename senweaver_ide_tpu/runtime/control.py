@@ -64,6 +64,11 @@ class ControlServer:
 
     # -- builtin handlers --------------------------------------------------
     def _status(self, _params: Any) -> List[Dict[str, Any]]:
+        return self.list_jobs()
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        """Public job snapshot (the status RPC's payload) — also consumed
+        in-process by the operator dashboard."""
         with self._lock:
             return [{"job_id": j.job_id, "status": j.status,
                      "submitted_at": j.submitted_at}

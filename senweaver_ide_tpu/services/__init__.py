@@ -6,7 +6,9 @@ tiered config system (product.json / settings / online config).
 """
 
 from .collaboration import CollabCoordinator, CollabSession
-from .config import BUILD_DEFAULTS, RuntimeConfig, install_config_channel
+from .config import (BUILD_DEFAULTS, GatedPolicyClient, ModelAccessError,
+                     RuntimeConfig, install_config_channel)
+from .dashboard import DashboardService
 from .extensions import (ExtensionServer, ExtensionServerError,
                          ExtensionTool, ExtensionToolRegistry)
 from .metrics import MetricsService, load_jsonl_metrics
@@ -19,8 +21,10 @@ from .skills import SkillInfo, SkillService
 
 __all__ = [
     "CollabCoordinator", "CollabSession",
-    "BUILD_DEFAULTS", "RuntimeConfig", "install_config_channel",
+    "BUILD_DEFAULTS", "GatedPolicyClient", "ModelAccessError",
+    "RuntimeConfig", "install_config_channel",
     "ExtensionServer", "ExtensionServerError", "ExtensionTool",
+    "DashboardService",
     "ExtensionToolRegistry", "MetricsService", "load_jsonl_metrics",
     "CustomApiService", "RefreshModelService", "fetch_model_list",
     "GitRepo", "SCMService", "extract_commit_message",

@@ -143,6 +143,42 @@ class _Missing:
 _MISSING = _Missing()
 
 
+class ModelAccessError(PermissionError):
+    """A live-config gate (allowed_models) blocks this model."""
+
+
+class GatedPolicyClient:
+    """PolicyClient wrapper that honors live model-access gating.
+
+    The reference enforces isOwnProviderEnabled at the point of use —
+    a pushed config change affects the NEXT request, not a restart
+    (``senweaverOnlineConfigContribution.ts:53-76``). Wrapping the policy
+    client is the session-layer equivalent: every chat() re-checks the
+    gate against the CURRENT live tier, so a ``config.push`` lands on a
+    running trainer/session mid-rollout. The agent loop's error path
+    turns a gated call into an errored episode (record_error → trace
+    hasErrors) rather than a crash of the surrounding job."""
+
+    def __init__(self, inner, config: "RuntimeConfig", *,
+                 model_name: Optional[str] = None):
+        self.inner = inner
+        self.config = config
+        self.model_name = model_name or getattr(inner, "model_name", "") \
+            or "local-policy"
+
+    def chat(self, messages, **kw):
+        if not self.config.is_model_allowed(self.model_name):
+            raise ModelAccessError(
+                f"model '{self.model_name}' is gated by live config "
+                f"(allowed_models={self.config.snapshot()['model_gating']})")
+        return self.inner.chat(messages, **kw)
+
+    def __getattr__(self, name):
+        # call_log, release_held_slot, tokenizer … pass through so the
+        # RL data pipeline sees the real client underneath.
+        return getattr(self.inner, name)
+
+
 def install_config_channel(server, config: "RuntimeConfig"):
     """Online-config push channel over the trainer's JSON-RPC socket.
 

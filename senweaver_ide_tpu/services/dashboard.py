@@ -1,0 +1,360 @@
+"""Operator dashboard: the L6 surface over the trainer control plane.
+
+The reference ships ~20.3k LoC of React (``browser/react/src/`` — sidebar
+chat, settings panes, trace/APO dashboards). The TPU-first re-design keeps
+the operator surface but not the IDE chrome: one stdlib HTTP server
+rendering a single self-contained page (zero egress — no CDN, no build
+step) over the SAME stats surfaces the services already expose:
+
+- trace statistics        → ``TraceCollector.get_stats()``
+  (``traceCollectorService.ts:577-628`` getTraceStatistics analogue)
+- APO state               → ``APOService.get_stats()`` / latest report /
+  optimized rules (``apoService.ts:1470-1508`` getAPOStatistics)
+- serving counters        → ``RolloutEngine.stats()``
+- job queue               → ``ControlServer.list_jobs()``
+- training curves         → the metrics JSONL sink's "GRPO Round Done" /
+  "Async GRPO Round" events (``services/metrics.py``)
+
+Everything is pluggable and optional: a dashboard over just a metrics
+file is as valid as one over a live ``JobRunner`` stack. ``/api/state``
+serves the JSON the page polls; tests consume it directly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+
+def _training_curves(metrics_path: Optional[str],
+                     limit: int = 200) -> Dict[str, List[Any]]:
+    """Per-round series from the metrics JSONL (newest ``limit`` rounds)."""
+    if not metrics_path:
+        return {"rounds": [], "reward_mean": [], "loss": []}
+    from .metrics import load_jsonl_metrics
+    try:
+        events = load_jsonl_metrics(metrics_path)
+    except Exception:
+        events = []
+    rounds: List[Dict[str, Any]] = [
+        e.get("properties", e) for e in events
+        if e.get("event") in ("GRPO Round Done", "Async GRPO Round")]
+    total = len(rounds)
+    rounds = rounds[-limit:]
+    return {
+        # True round indices survive truncation: a 300-round run shows
+        # rounds 100-299, not a relabeled 0-199.
+        "rounds": list(range(total - len(rounds), total)),
+        "reward_mean": [r.get("reward_mean") for r in rounds],
+        "loss": [r.get("loss") for r in rounds],
+        "collect_s": [r.get("collect_s") for r in rounds],
+        "episodes": [r.get("episodes") for r in rounds],
+    }
+
+
+class DashboardService:
+    """Aggregates live service state and serves the operator page."""
+
+    def __init__(self, *, collector=None, apo=None, engine=None,
+                 control=None, metrics_path: Optional[str] = None,
+                 title: str = "senweaver-tpu trainer"):
+        self.collector = collector
+        self.apo = apo
+        self.engine = engine
+        self.control = control
+        self.metrics_path = metrics_path
+        self.title = title
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state assembly ----------------------------------------------------
+    def state(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"title": self.title}
+        if self.collector is not None:
+            try:
+                out["traces"] = self.collector.get_stats()
+            except Exception as e:
+                out["traces"] = {"error": str(e)}
+        if self.engine is not None:
+            try:
+                out["engine"] = self.engine.stats()
+            except Exception as e:
+                out["engine"] = {"error": str(e)}
+        if self.apo is not None:
+            try:
+                apo_state: Dict[str, Any] = dict(self.apo.get_stats())
+                apo_state["optimized_rules"] = self.apo.get_optimized_rules()
+                report = self.apo.get_latest_report()
+                if report is not None:
+                    apo_state["latest_report"] = {
+                        "good_rate": report.good_rate,
+                        "total_conversations": report.total_conversations,
+                        "patterns": [
+                            {"description": p.description,
+                             "frequency": p.frequency,
+                             "severity": p.severity}
+                            for p in report.patterns],
+                        "suggestions": [
+                            {"description": s.description,
+                             "priority": s.priority, "status": s.status}
+                            for s in report.suggestions],
+                        "avg_reward": report.avg_reward,
+                    }
+                out["apo"] = apo_state
+            except Exception as e:
+                out["apo"] = {"error": str(e)}
+        if self.control is not None:
+            try:
+                out["jobs"] = self.control.list_jobs()
+            except Exception as e:
+                out["jobs"] = [{"error": str(e)}]
+        out["training"] = _training_curves(self.metrics_path)
+        return out
+
+    # -- http --------------------------------------------------------------
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """Serve in a daemon thread; returns the bound port (0 = ephemeral)."""
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                if self.path.startswith("/api/state"):
+                    body = json.dumps(service.state()).encode()
+                    ctype = "application/json"
+                elif self.path == "/" or self.path.startswith("/index"):
+                    body = _PAGE.replace("__TITLE__", service.title).encode()
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet: metrics JSONL is the log
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="senweaver-dashboard",
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# Single-file page. Design per the repo's dataviz conventions: role-based
+# CSS custom properties with selected light AND dark values, one accent
+# series hue, text in text tokens (never series color), thin marks, a
+# recessive grid, hover crosshair + tooltip on the curves, and a table
+# view of the recent rounds under the charts.
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8">
+<title>__TITLE__</title>
+<style>
+:root { color-scheme: light dark; }
+body {
+  margin: 0; font: 14px/1.45 system-ui, sans-serif;
+  background: #fcfcfb; color: #0b0b0b;
+  --surface-2: #f1f0ee; --border: #dddcd8;
+  --text-2: #52514e; --series-1: #2a78d6; --series-3: #1baf7a;
+  --good: #008300; --bad: #e34948; --warn: #eda100;
+}
+@media (prefers-color-scheme: dark) { body {
+  background: #1a1a19; color: #ffffff;
+  --surface-2: #242423; --border: #3a3a38;
+  --text-2: #c3c2b7; --series-1: #3987e5; --series-3: #199e70;
+  --good: #00a300; --bad: #e66767; --warn: #c98500;
+}}
+header { padding: 14px 20px; border-bottom: 1px solid var(--border); }
+header h1 { font-size: 16px; margin: 0; }
+header .sub { color: var(--text-2); font-size: 12px; }
+main { padding: 16px 20px; max-width: 1100px; }
+section { margin-bottom: 22px; }
+h2 { font-size: 13px; text-transform: uppercase; letter-spacing: .04em;
+     color: var(--text-2); margin: 0 0 8px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 10px; }
+.tile { background: var(--surface-2); border: 1px solid var(--border);
+        border-radius: 8px; padding: 10px 14px; min-width: 120px; }
+.tile .v { font-size: 22px; font-weight: 600; }
+.tile .l { font-size: 11px; color: var(--text-2); }
+table { border-collapse: collapse; font-size: 12.5px; }
+td, th { padding: 3px 10px 3px 0; text-align: left; }
+th { color: var(--text-2); font-weight: 500; }
+tr { border-bottom: 1px solid var(--border); }
+.chart-wrap { position: relative; display: inline-block; }
+.tooltip { position: absolute; pointer-events: none; display: none;
+           background: var(--surface-2); border: 1px solid var(--border);
+           border-radius: 6px; padding: 4px 8px; font-size: 12px; }
+.status { font-size: 12px; }
+.status::before { content: "● "; }
+.status.done::before, .status.good::before { color: var(--good); }
+.status.failed::before, .status.stopped::before { color: var(--bad); }
+.status.running::before, .status.queued::before { color: var(--warn); }
+.muted { color: var(--text-2); }
+.rules li { margin-bottom: 2px; }
+</style></head><body>
+<header><h1>__TITLE__</h1>
+<div class="sub">operator dashboard · polls /api/state
+<span id="updated" class="muted"></span></div></header>
+<main>
+<section><h2>Traces</h2><div id="traces" class="tiles"></div></section>
+<section><h2>Training</h2>
+<div id="charts"></div>
+<div id="rounds-table"></div></section>
+<section><h2>Engine serving counters</h2><div id="engine"></div></section>
+<section><h2>APO</h2><div id="apo"></div></section>
+<section><h2>Jobs</h2><div id="jobs"></div></section>
+</main>
+<script>
+"use strict";
+// Everything rendered into innerHTML passes through esc(): APO rules and
+// suggestion text come from an LLM — stored-XSS surface without it.
+const esc = v => String(v).replace(/[&<>"']/g, c => ({
+  "&": "&amp;", "<": "&lt;", ">": "&gt;",
+  '"': "&quot;", "'": "&#39;"}[c]));
+const fmt = v => v == null ? "–"
+  : (typeof v === "number" && !Number.isInteger(v) ? v.toFixed(3) : esc(v));
+
+function tiles(el, pairs) {
+  el.innerHTML = pairs.map(([l, v]) =>
+    `<div class="tile"><div class="v">${fmt(v)}</div>` +
+    `<div class="l">${esc(l)}</div></div>`).join("");
+}
+
+// Rows are escaped per-cell; a cell may opt out via {html: "..."} for
+// markup the PAGE generated itself (status spans) — never raw data.
+function table(rows, headers) {
+  if (!rows.length) return '<span class="muted">no data yet</span>';
+  const cell = c => (c && typeof c === "object" && "html" in c)
+    ? c.html : esc(fmt(c));
+  const h = headers.map(x => `<th>${esc(x)}</th>`).join("");
+  const b = rows.map(r =>
+    `<tr>${r.map(c => `<td>${cell(c)}</td>`).join("")}</tr>`).join("");
+  return `<table><tr>${h}</tr>${b}</table>`;
+}
+
+const statusSpan = s =>
+  ({html: `<span class="status ${esc(s)}">${esc(s)}</span>`});
+
+// Single-series line chart: thin 2px line, recessive grid, hover
+// crosshair + tooltip, no legend (the title names the series).
+function lineChart(xs, ys, label, color) {
+  const W = 420, H = 120, P = 28;
+  const pts = xs.map((x, i) => [x, ys[i]]).filter(p => p[1] != null);
+  if (pts.length < 2)
+    return `<div class="muted">${esc(label)}: need ≥2 rounds</div>`;
+  const yv = pts.map(p => p[1]);
+  const ymin = Math.min(...yv), ymax = Math.max(...yv);
+  const yr = (ymax - ymin) || 1;
+  const sx = i => P + (W - 2 * P) * i / (pts.length - 1);
+  const sy = v => H - P - (H - 2 * P) * (v - ymin) / yr;
+  const path = pts.map((p, i) =>
+    `${i ? "L" : "M"}${sx(i).toFixed(1)},${sy(p[1]).toFixed(1)}`).join("");
+  const grid = [ymin, (ymin + ymax) / 2, ymax].map(v =>
+    `<line x1="${P}" x2="${W - P}" y1="${sy(v)}" y2="${sy(v)}"
+      stroke="var(--border)" stroke-width="1"/>` +
+    `<text x="${P - 4}" y="${sy(v) + 4}" text-anchor="end"
+      font-size="10" fill="var(--text-2)">${v.toFixed(2)}</text>`).join("");
+  const id = "c" + Math.random().toString(36).slice(2, 8);
+  setTimeout(() => hoverLayer(id, pts, sx, sy, label), 0);
+  return `<div class="chart-wrap" id="${id}">
+    <svg width="${W}" height="${H}" role="img" aria-label="${esc(label)}">
+    <text x="${P}" y="14" font-size="11"
+      fill="var(--text-2)">${esc(label)}</text>
+    ${grid}
+    <path d="${path}" fill="none" stroke="${color}" stroke-width="2"/>
+    <line class="xh" y1="${P}" y2="${H - P}" stroke="var(--text-2)"
+      stroke-width="1" style="display:none"/>
+    <circle class="pt" r="4" fill="${color}" stroke="var(--surface-2)"
+      stroke-width="2" style="display:none"/>
+    </svg><div class="tooltip"></div></div>`;
+}
+
+function hoverLayer(id, pts, sx, sy, label) {
+  const wrap = document.getElementById(id);
+  if (!wrap) return;
+  const svg = wrap.querySelector("svg"), tip = wrap.querySelector(".tooltip");
+  const xh = svg.querySelector(".xh"), dot = svg.querySelector(".pt");
+  svg.addEventListener("mousemove", e => {
+    const r = svg.getBoundingClientRect();
+    const x = e.clientX - r.left;
+    let best = 0, bd = 1e9;
+    pts.forEach((p, i) => { const d = Math.abs(sx(i) - x);
+                            if (d < bd) { bd = d; best = i; } });
+    const px = sx(best), py = sy(pts[best][1]);
+    xh.setAttribute("x1", px); xh.setAttribute("x2", px);
+    xh.style.display = ""; dot.style.display = "";
+    dot.setAttribute("cx", px); dot.setAttribute("cy", py);
+    tip.style.display = "block";
+    tip.style.left = (px + 10) + "px"; tip.style.top = (py - 10) + "px";
+    tip.textContent =
+      `round ${pts[best][0]} · ${label} ${fmt(pts[best][1])}`;
+  });
+  svg.addEventListener("mouseleave", () => {
+    xh.style.display = "none"; dot.style.display = "none";
+    tip.style.display = "none";
+  });
+}
+
+async function refresh() {
+  let s;
+  try { s = await (await fetch("/api/state")).json(); }
+  catch (e) { return; }
+  document.getElementById("updated").textContent =
+    " · updated " + new Date().toLocaleTimeString();
+  const t = s.traces || {};
+  tiles(document.getElementById("traces"), [
+    ["traces", t.total_traces], ["spans", t.total_spans],
+    ["good fb", t.good_feedbacks], ["bad fb", t.bad_feedbacks],
+    ["tool success", t.tool_success_rate],
+    ["avg finalReward", t.avg_final_reward]]);
+  const tr = s.training || {rounds: []};
+  document.getElementById("charts").innerHTML =
+    lineChart(tr.rounds, tr.reward_mean || [], "reward_mean",
+              "var(--series-1)") + " " +
+    lineChart(tr.rounds, tr.loss || [], "loss", "var(--series-3)");
+  const last = (tr.rounds || []).slice(-12);
+  document.getElementById("rounds-table").innerHTML = table(
+    last.map(i => [i, fmt((tr.reward_mean || [])[i]),
+                   fmt((tr.loss || [])[i]), fmt((tr.episodes || [])[i]),
+                   fmt((tr.collect_s || [])[i])]),
+    ["round", "reward_mean", "loss", "episodes", "collect_s"]);
+  const eng = s.engine || {};
+  document.getElementById("engine").innerHTML = table(
+    Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);
+  const a = s.apo || {};
+  let apoHtml = table(
+    Object.entries(a).filter(([k, v]) => typeof v !== "object")
+      .map(([k, v]) => [k, fmt(v)]), ["stat", "value"]);
+  if ((a.optimized_rules || []).length)
+    apoHtml += "<ul class='rules'>" + a.optimized_rules.map(r =>
+      `<li>${esc(r)}</li>`).join("") + "</ul>";
+  if (a.latest_report && a.latest_report.suggestions)
+    apoHtml += table(a.latest_report.suggestions.map(x =>
+      [statusSpan(x.status), x.priority, x.description]),
+      ["status", "priority", "suggestion"]);
+  document.getElementById("apo").innerHTML = apoHtml;
+  document.getElementById("jobs").innerHTML = table(
+    (s.jobs || []).map(j =>
+      [j.job_id, statusSpan(j.status),
+       new Date(j.submitted_at * 1000).toLocaleTimeString()]),
+    ["job", "status", "submitted"]);
+}
+refresh();
+setInterval(refresh, 2500);
+</script></body></html>
+"""

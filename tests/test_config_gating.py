@@ -1,0 +1,103 @@
+"""Live model-access gating enforced at the session layer.
+
+senweaverOnlineConfigContribution.ts:53-76 pushes config over a live
+channel and isOwnProviderEnabled gates model use at the POINT OF USE —
+no restart. services.config.GatedPolicyClient is that enforcement for
+the in-tree policy stack: a config.push lands on the very next chat()."""
+
+import pytest
+
+from senweaver_ide_tpu.agents.llm import ChatMessage, LLMResponse, LLMUsage
+from senweaver_ide_tpu.apo.eval import RuleSensitivePolicy
+from senweaver_ide_tpu.rollout.session import RolloutSession
+from senweaver_ide_tpu.runtime.control import ControlServer
+from senweaver_ide_tpu.services.config import (GatedPolicyClient,
+                                               ModelAccessError,
+                                               RuntimeConfig,
+                                               install_config_channel)
+
+
+class EchoPolicy:
+    model_name = "qwen-local"
+    call_log = []
+
+    def chat(self, messages, **kw):
+        return LLMResponse(text="ok", usage=LLMUsage(10, 2), model="echo")
+
+
+def test_gate_blocks_and_unblocks_live():
+    cfg = RuntimeConfig()
+    client = GatedPolicyClient(EchoPolicy(), cfg)
+    assert client.chat([ChatMessage("user", "hi")]).text == "ok"
+    cfg.apply_live_config({"allowed_models": ["other-model"]})
+    with pytest.raises(ModelAccessError):
+        client.chat([ChatMessage("user", "hi")])
+    # substring-match semantics (isOwnProviderEnabled family match)
+    cfg.apply_live_config({"allowed_models": ["qwen"]})
+    assert client.chat([ChatMessage("user", "hi")]).text == "ok"
+    # clearing the live tier removes the gate
+    cfg.apply_live_config({})
+    assert client.chat([ChatMessage("user", "hi")]).text == "ok"
+
+
+def test_gate_passthrough_preserves_inner_surface():
+    inner = EchoPolicy()
+    client = GatedPolicyClient(inner, RuntimeConfig())
+    assert client.model_name == "qwen-local"
+    assert client.call_log is inner.call_log
+
+
+def test_push_gates_running_session_mid_run(tmp_path):
+    """A live session survives a mid-run gate: the next episode becomes
+    an errored trace (record_error -> hasErrors), not a crash."""
+    cfg = RuntimeConfig()
+    client = GatedPolicyClient(RuleSensitivePolicy(), cfg,
+                               model_name="scripted-policy")
+    s = RolloutSession(client, str(tmp_path / "ws"),
+                       include_tool_definitions=False,
+                       loop_sleep=lambda _s: None)
+    s.workspace.write_file("app.py", "x = 1\n")
+    out1 = s.run_turn("Fix the bug")
+    assert not out1.trace.summary.has_errors
+
+    cfg.apply_live_config({"allowed_models": ["some-other"]})
+    out2 = s.run_turn("Fix it again")
+    assert out2.loop.aborted_reason == "llm_error"
+    tr = s.collector.get_trace(out2.trace.id)
+    assert tr.summary.has_errors
+    assert "gated by live config" in out2.loop.final_text
+    s.close()
+
+
+def test_push_through_control_channel_flips_gate(tmp_path):
+    """config.push over the control socket changes what a live client is
+    allowed to do — the full senweaver-ctl → trainer path."""
+    import json as _json
+    import socket
+
+    cfg = RuntimeConfig()
+    server = ControlServer(str(tmp_path / "ctl.sock"))
+    install_config_channel(server, cfg)
+    server.start()
+    try:
+        client = GatedPolicyClient(EchoPolicy(), cfg)
+        assert client.chat([ChatMessage("user", "x")]).text == "ok"
+
+        def rpc(method, params):
+            with socket.socket(socket.AF_UNIX) as c:
+                c.connect(server.socket_path)
+                c.sendall(_json.dumps({"jsonrpc": "2.0", "id": 1,
+                                       "method": method,
+                                       "params": params}).encode())
+                c.shutdown(socket.SHUT_WR)
+                return _json.loads(c.makefile().read())
+
+        resp = rpc("config.push", {"allowed_models": ["nothing-matches"]})
+        assert resp["result"]["ok"]
+        with pytest.raises(ModelAccessError):
+            client.chat([ChatMessage("user", "x")])
+        resp = rpc("config.push", {"allowed_models": ["qwen"]})
+        assert resp["result"]["ok"]
+        assert client.chat([ChatMessage("user", "x")]).text == "ok"
+    finally:
+        server.stop()

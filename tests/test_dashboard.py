@@ -1,0 +1,112 @@
+"""Operator dashboard (services/dashboard.py): the L6 surface.
+
+The reference renders trace/APO statistics in its React sidebar
+(browser/react/src; traceCollectorService.ts:577-628 getTraceStatistics,
+apoService.ts:1470-1508 getAPOStatistics); here one stdlib HTTP server
+exposes the same stats surfaces as JSON + a self-contained page."""
+
+import json
+import urllib.request
+
+import pytest
+
+from senweaver_ide_tpu.apo.service import APOService
+from senweaver_ide_tpu.services.dashboard import (DashboardService,
+                                                  _training_curves)
+from senweaver_ide_tpu.services.metrics import MetricsService
+from senweaver_ide_tpu.traces.collector import TraceCollector
+
+
+class FakeEngine:
+    def stats(self):
+        return {"tokens_emitted": 123, "prefill_tokens": 456}
+
+
+class FakeControl:
+    def list_jobs(self):
+        return [{"job_id": "job-1", "status": "done",
+                 "submitted_at": 1_700_000_000.0}]
+
+
+@pytest.fixture()
+def sources(tmp_path):
+    collector = TraceCollector()
+    tid = collector.start_trace("t1", metadata={"chatMode": "agent"})
+    collector.record_user_message("t1", 0, "fix it")
+    collector.record_llm_call("t1", 1, model="m", input_tokens=100,
+                              output_tokens=20)
+    collector.record_tool_call("t1", 1, tool_name="read_file",
+                               tool_success=True, duration_ms=4.0)
+    collector.end_trace_for_thread("t1")
+    metrics_path = str(tmp_path / "metrics.jsonl")
+    m = MetricsService(jsonl_path=metrics_path)
+    for i in range(3):
+        m.capture("GRPO Round Done", {"reward_mean": -0.5 + 0.2 * i,
+                                      "loss": 0.01 * i, "episodes": 8,
+                                      "collect_s": 1.5})
+    m.capture("Other Event", {"reward_mean": 99.0})   # must be ignored
+    return collector, metrics_path
+
+
+def test_state_aggregates_all_sources(sources):
+    collector, metrics_path = sources
+    dash = DashboardService(collector=collector, apo=APOService(collector),
+                            engine=FakeEngine(), control=FakeControl(),
+                            metrics_path=metrics_path)
+    s = dash.state()
+    assert s["traces"]["total_traces"] == 1
+    assert s["traces"]["total_tool_calls"] == 1
+    assert s["engine"]["tokens_emitted"] == 123
+    assert s["jobs"][0]["job_id"] == "job-1"
+    assert s["training"]["reward_mean"] == pytest.approx([-0.5, -0.3, -0.1])
+    assert "optimized_rules" in s["apo"]
+    json.dumps(s)    # the whole state must be JSON-serializable
+
+
+def test_training_curves_filters_round_events(sources):
+    _, metrics_path = sources
+    curves = _training_curves(metrics_path)
+    assert curves["rounds"] == [0, 1, 2]
+    assert 99.0 not in curves["reward_mean"]
+    # absent file → empty series, no raise
+    assert _training_curves("/nonexistent/x.jsonl")["rounds"] == []
+    assert _training_curves(None)["rounds"] == []
+
+
+def test_http_serves_page_and_state(sources):
+    collector, metrics_path = sources
+    dash = DashboardService(collector=collector,
+                            metrics_path=metrics_path,
+                            title="test-dash")
+    port = dash.start(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10) as r:
+            page = r.read().decode()
+        assert "test-dash" in page and "reward_mean" in page
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/api/state", timeout=10) as r:
+            state = json.loads(r.read())
+        assert state["traces"]["total_traces"] == 1
+        assert state["training"]["rounds"] == [0, 1, 2]
+        # unknown path → 404, server stays up
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope",
+                                   timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        dash.stop()
+
+
+def test_sources_are_optional_and_errors_contained(tmp_path):
+    class Broken:
+        def get_stats(self):
+            raise RuntimeError("boom")
+
+    dash = DashboardService(collector=Broken())
+    s = dash.state()
+    assert s["traces"]["error"] == "boom"
+    assert s["training"]["rounds"] == []
+    json.dumps(s)
