@@ -1,0 +1,131 @@
+"""North-star learning proof: GRPO weight updates raise episode reward.
+
+The reference's whole premise is an optimizer loop that makes the agent
+measurably better (``apoService.ts:992-1215`` scores candidate prompts
+and applies the winners); the TPU build upgrades that loop to WEIGHT
+updates. This eval is the existence proof the r2 verdict demanded: N
+rounds of ``grpo_round`` on the tiny policy, each episode driven through
+the REAL stack — RolloutSession over the continuous-batching engine,
+real sampled tokens, recorded sample-time behavior logps — against a
+hermetic reward with learnable ground truth (emit printable ASCII:
+reward = 2·frac(bytes < 128) − 1, base rate ~25% at random init, a
+RuleSensitivePolicy-style "better policy exists" structure expressed in
+token space). Prints ONE JSON line with the per-round reward curve:
+
+    python eval_learning.py [--rounds 12] [--lr 0.02] [--group-size 16]
+
+Success criterion (asserted by tests/test_learning.py): the final-window
+mean reward exceeds the initial-window mean by a wide margin.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+
+
+def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
+                      group_size: int = 16, max_new_tokens: int = 16,
+                      ppo_epochs: int = 2, seed: int = 0,
+                      window: int = 2) -> dict:
+    import jax
+
+    from senweaver_ide_tpu.models import get_config
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import (EnginePolicyClient, RolloutEngine,
+                                           RolloutSession)
+    from senweaver_ide_tpu.training import grpo_round, make_train_state
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+
+    config = get_config("tiny-test")
+    state = make_train_state(config, jax.random.PRNGKey(seed), None,
+                             learning_rate=lr)
+    tok = ByteTokenizer()
+    workdir = tempfile.mkdtemp(prefix="learn_")
+
+    # eos_id=None: fixed-length completions — reward reflects token
+    # CONTENT only, not length noise.
+    engine = RolloutEngine(state.params, config, num_slots=8, max_len=4096,
+                           eos_id=None, seed=seed)
+
+    def make_session():
+        client = EnginePolicyClient(engine, tok,
+                                    default_max_new_tokens=max_new_tokens,
+                                    record_calls=True, auto_prefix=True)
+        return RolloutSession(client, f"{workdir}/ws",
+                              include_tool_definitions=False)
+
+    def reward(task_idx, g, session):
+        out_ids = session.client.call_log[-1][1]
+        if not out_ids:
+            return -1.0
+        frac = sum(1 for t in out_ids if t < 128) / len(out_ids)
+        return 2.0 * frac - 1.0
+
+    curve = []
+    t0 = time.monotonic()
+    for r in range(rounds):
+        out = grpo_round(state, config, None, make_session,
+                         ["write plain ascii text"], group_size=group_size,
+                         pad_id=tok.pad_id, max_len=2048,
+                         grpo_config=GRPOConfig(kl_coef=0.0),
+                         ppo_epochs=ppo_epochs, max_parallel=8,
+                         reward_override=reward)
+        state = out.state
+        # Publish the updated weights to the serving engine — the same
+        # actor/learner weight sync the async trainer does at round
+        # boundaries; without it every round samples the initial policy.
+        engine.update_params(state.params)
+        curve.append(round(sum(e.reward for e in out.episodes)
+                           / max(len(out.episodes), 1), 4))
+
+    w = max(1, min(window, len(curve) // 2))
+    initial = sum(curve[:w]) / w
+    final = sum(curve[-w:]) / w
+    return {
+        "metric": "grpo_reward_curve[tiny-test,ascii-task]",
+        "rounds": rounds,
+        "curve": curve,
+        "reward_initial": round(initial, 4),
+        "reward_final": round(final, 4),
+        "uplift": round(final - initial, 4),
+        "learned": bool(final > initial + 0.5),
+        "config": {"lr": lr, "group_size": group_size,
+                   "max_new_tokens": max_new_tokens,
+                   "ppo_epochs": ppo_epochs, "seed": seed},
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--group-size", type=int, default=16)
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--ppo-epochs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    # Tiny-model rounds are CPU-sized; force CPU via the live config so a
+    # wedged accelerator tunnel can't hang backend init (same posture as
+    # eval_uplift.py's scripted path).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    report = run_learning_eval(rounds=args.rounds, lr=args.lr,
+                               group_size=args.group_size,
+                               max_new_tokens=args.max_new_tokens,
+                               ppo_epochs=args.ppo_epochs, seed=args.seed)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:   # always leave a JSON line for the driver
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
-from ..models.transformer import KVCache, Params, forward
+from ..models.transformer import KVCache, Params, forward, init_kv_cache
 from ..ops.sampling import sample_token, sampled_logprob
 from .sampler import SampleParams
 
@@ -98,6 +98,44 @@ def _prefill_slot(params: Params, config: ModelConfig, tokens: jax.Array,
                           attn_mask=attn_mask, fresh_cache=True)
     last = logits[0, true_len - 1, :]
     return last, _writeback_slot(cache, sub, slot, true_len)
+
+
+@functools.partial(jax.jit, static_argnames=("config",),
+                   donate_argnames=("cache",))
+def _prefill_slots_batched(params: Params, config: ModelConfig,
+                           tokens: jax.Array, true_lens: jax.Array,
+                           cache: KVCache,
+                           slots: jax.Array) -> tuple[jax.Array, KVCache]:
+    """Prefill N fresh slots in ONE forward. tokens: (N, S_bucket)
+    right-padded; true_lens/slots: (N,). Returns ((N, V) last-real-token
+    logits, updated pool cache).
+
+    The serial-prefill fix (r2 weak item: queued requests prefilled one
+    at a time, draining decode while the pool idled): same-bucket queued
+    requests batch into one MXU-friendly pass. Fresh slots need no
+    gather — their sub-cache starts as zeros — and the writeback is one
+    scatter per tensor over the slot axis. Duplicate slot indices are
+    legal ONLY with identical rows (the scheduler pads the batch by
+    repeating row 0)."""
+    L = cache.k.shape[0]
+    cap = cache.k.shape[2]
+    n = tokens.shape[0]
+    sub = init_kv_cache(config, n, cap, quantized=cache.quantized)
+    kv_pos = jnp.arange(cap)[None, :]
+    attn_mask = kv_pos < true_lens[:, None]            # (N, cap)
+    logits, sub = forward(params, config, tokens, cache=sub,
+                          attn_mask=attn_mask, fresh_cache=True)
+    last = jnp.take_along_axis(
+        logits, (true_lens - 1)[:, None, None], axis=1)[:, 0, :]
+    new_k = cache.k.at[:, slots].set(sub.k)
+    new_v = cache.v.at[:, slots].set(sub.v)
+    new_ks = new_vs = None
+    if cache.quantized:
+        new_ks = cache.k_scale.at[:, slots].set(sub.k_scale)
+        new_vs = cache.v_scale.at[:, slots].set(sub.v_scale)
+    return last, KVCache(k=new_k, v=new_v,
+                         length=cache.length.at[slots].set(true_lens),
+                         k_scale=new_ks, v_scale=new_vs)
 
 
 @functools.partial(jax.jit, static_argnames=("config", "fresh"),
@@ -199,7 +237,7 @@ class RolloutEngine:
                  num_slots: int = 8, max_len: int = 2048,
                  sample: SampleParams = SampleParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 mesh=None):
+                 mesh=None, max_prefixes: int = 8):
         self.config = config
         self.num_slots = num_slots
         # Sliding-window configs serve from a ring cache: the pool holds
@@ -265,7 +303,9 @@ class RolloutEngine:
         # machinery actually engages — the metricsService-style counters
         # for the engine plane (SURVEY.md §5 observability).
         self._stats = {"prefills": 0, "prefill_tokens": 0,
+                       "batched_prefills": 0, "batched_prefill_slots": 0,
                        "prefix_installs": 0, "prefix_tokens_reused": 0,
+                       "prefix_evictions": 0,
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
                        "hold_evictions": 0}
@@ -280,6 +320,14 @@ class RolloutEngine:
         self._prefixes: Dict[int, tuple] = {}
         self._prefix_by_tokens: Dict[tuple, int] = {}   # content dedup
         self._next_prefix_id = 0
+        # HBM budget for registered prefixes: each holds one pool-slot-
+        # shaped KV buffer, so COUNT is the natural budget unit. LRU
+        # eviction mirrors hold eviction — dropped prefixes silently
+        # fall back to a full prefill (and auto_prefix clients
+        # re-register on the KeyError).
+        self.max_prefixes = max(1, int(max_prefixes))
+        self._prefix_last_use: Dict[int, int] = {}
+        self._prefix_use_seq = 0
         # Many agent loops (subagent threads) drive one engine: all state
         # mutation is serialized; concurrency = slots, not host threads.
         self._lock = threading.RLock()
@@ -304,6 +352,7 @@ class RolloutEngine:
             self.params = self._place_params(params)
             self._prefixes.clear()
             self._prefix_by_tokens.clear()
+            self._prefix_last_use.clear()
             # Held conversation KV is old-policy state for the same
             # reason: continuations after a sync must re-prefill.
             for slot in range(self.num_slots):
@@ -356,8 +405,12 @@ class RolloutEngine:
                        eos_id=self.eos_id if eos_id is None else eos_id,
                        prefix_id=prefix_id, hold_slot=hold_slot)
         self._requests[rid] = req
+        # Enqueue only — scheduling happens at the next step() boundary,
+        # so a BURST of submissions (concurrent agent threads, a GRPO
+        # group) lands in the queue together and same-bucket prefills
+        # batch into one forward instead of each submit eagerly grabbing
+        # a slot solo.
         self._queue.append(req)
-        self._schedule()
         return rid
 
     @property
@@ -519,8 +572,16 @@ class RolloutEngine:
                     f"{self.max_len}")
             key = tuple(tokens)
             if key in self._prefix_by_tokens:   # content dedup: many
-                return self._prefix_by_tokens[key]   # clients, one buffer
-            from ..models.transformer import init_kv_cache
+                pid = self._prefix_by_tokens[key]    # clients, one buffer
+                self._touch_prefix(pid)
+                return pid
+            # HBM budget: evict the least-recently-used prefix before
+            # allocating another slot-shaped buffer.
+            while len(self._prefixes) >= self.max_prefixes:
+                lru = min(self._prefix_last_use,
+                          key=self._prefix_last_use.get)
+                self.release_prefix(lru)
+                self._stats["prefix_evictions"] += 1
             from .sampler import prefill        # jitted, donates cache
             sub = init_kv_cache(self.config, 1, self.max_len)
             last = None
@@ -538,12 +599,18 @@ class RolloutEngine:
             self._prefixes[pid] = (list(tokens), sub,
                                    jax.device_get(last[0]))
             self._prefix_by_tokens[key] = pid
+            self._touch_prefix(pid)
             return pid
+
+    def _touch_prefix(self, pid: int) -> None:
+        self._prefix_use_seq += 1
+        self._prefix_last_use[pid] = self._prefix_use_seq
 
     def release_prefix(self, prefix_id: int) -> None:
         """Free a registered prefix's KV buffer."""
         with self._lock:
             entry = self._prefixes.pop(prefix_id, None)
+            self._prefix_last_use.pop(prefix_id, None)
             if entry is not None:
                 self._prefix_by_tokens.pop(tuple(entry[0]), None)
 
@@ -607,8 +674,18 @@ class RolloutEngine:
             pos += size
         return last_logits
 
+    def _free_slots(self) -> List[int]:
+        return [s for s in range(self.num_slots)
+                if self._slot_req[s] is None and self._slot_held[s] is None]
+
     def _schedule(self) -> None:
-        """Prefill queued requests into free slots (continuous batching)."""
+        """Prefill queued requests into free slots (continuous batching).
+
+        Same-bucket fresh prefills at the queue front batch into ONE
+        forward (``_prefill_slots_batched``); prefix installs, ring
+        long-prompt chains, and odd-bucket singles take the single-slot
+        paths. FIFO order is preserved — batching only groups a
+        CONSECUTIVE run of compatible requests."""
         if self._queue and all(self._slot_held[s] is not None
                                for s in range(self.num_slots)):
             # Every slot held (none active) with work queued: nothing
@@ -621,58 +698,116 @@ class RolloutEngine:
                          key=lambda s: self._slot_hold_seq[s])
             self._drop_hold(oldest)
             self._stats["hold_evictions"] += 1
-        for slot in range(self.num_slots):
-            if not self._queue:
+        while self._queue:
+            free = self._free_slots()
+            if not free:
                 return
-            if (self._slot_req[slot] is not None
-                    or self._slot_held[slot] is not None):
-                continue
-            req = self._queue.popleft()
-            req.slot = slot
-            self._slot_req[slot] = req
-            true_len = len(req.prompt)
-            self._stats["prefills"] += 1
+            req = self._queue[0]
             if (req.prefix_id is not None
                     and req.prefix_id not in self._prefixes):
                 # The prefix was invalidated while this request sat in
-                # the queue (update_params drops old-policy KV). Fall
-                # back to a full prefill — raising here would corrupt
-                # an unrelated caller's step().
+                # the queue (update_params drops old-policy KV, the LRU
+                # budget evicts). Fall back to a full prefill — raising
+                # here would corrupt an unrelated caller's step().
                 req.prefix_id = None
-            if req.prefix_id is not None:
-                # Shared-prefix path: HBM-copy the cached prefix KV into
-                # the slot, then exact-chunk-prefill only the suffix.
-                p_tokens, p_cache, p_last = self._prefixes[req.prefix_id]
-                slot_arr = jnp.asarray(slot, jnp.int32)
-                self.cache = _install_prefix(self.cache, p_cache, slot_arr)
-                self._stats["prefix_installs"] += 1
-                self._stats["prefix_tokens_reused"] += len(p_tokens)
-                suffix = req.prompt[len(p_tokens):]
-                # prefill_tokens = tokens actually COMPUTED (the prefix
-                # itself arrived by HBM copy)
-                self._stats["prefill_tokens"] += len(suffix)
-                if suffix:
-                    last_logits = self._prefill_chunks(slot_arr, suffix,
-                                                       fresh_first=False)
+            if req.prefix_id is not None or (
+                    len(req.prompt) >= self.max_len and self._ring):
+                self._queue.popleft()
+                self._schedule_single(req, free[0])
+                continue
+            # Gather the batchable run: consecutive fresh prefills
+            # sharing this request's bucket, one per free slot.
+            bucket = min(_bucket(len(req.prompt)), self.max_len)
+            group = [req]
+            for r in list(self._queue)[1:len(free)]:
+                if (r.prefix_id is None
+                        and not (len(r.prompt) >= self.max_len
+                                 and self._ring)
+                        and min(_bucket(len(r.prompt)), self.max_len)
+                        == bucket):
+                    group.append(r)
                 else:
-                    last_logits = jnp.asarray(p_last)
-            elif true_len >= self.max_len and self._ring:
-                # Long prompt on a ring pool: exact-size chunk chain
-                # (see _prefill_slot_chunk). Reset the slot's stale
-                # length first — the chain reads it as its write cursor.
-                self.cache = self.cache._replace(
-                    length=self.cache.length.at[slot].set(0))
-                slot_arr = jnp.asarray(slot, jnp.int32)
-                last_logits = self._prefill_chunks(slot_arr, req.prompt,
-                                                   fresh_first=True)
-                self._stats["prefill_tokens"] += true_len
+                    break
+            for _ in group:
+                self._queue.popleft()
+            if len(group) == 1:
+                self._schedule_single(group[0], free[0])
             else:
-                bucket = min(_bucket(true_len), self.max_len)
-                padded = req.prompt + [0] * (bucket - true_len)
-                tokens = jnp.asarray(padded, jnp.int32)[None, :]
-                last_logits, self.cache = _prefill_slot(
-                    self.params, self.config, tokens,
-                    jnp.asarray(true_len, jnp.int32), self.cache,
-                    jnp.asarray(slot, jnp.int32))
-                self._stats["prefill_tokens"] += true_len
-            self._emit_first_token(req, slot, last_logits)
+                self._schedule_batch(group, free[:len(group)], bucket)
+
+    def _schedule_single(self, req: "_Request", slot: int) -> None:
+        req.slot = slot
+        self._slot_req[slot] = req
+        true_len = len(req.prompt)
+        self._stats["prefills"] += 1
+        if req.prefix_id is not None:
+            # Shared-prefix path: HBM-copy the cached prefix KV into
+            # the slot, then exact-chunk-prefill only the suffix.
+            p_tokens, p_cache, p_last = self._prefixes[req.prefix_id]
+            self._touch_prefix(req.prefix_id)
+            slot_arr = jnp.asarray(slot, jnp.int32)
+            self.cache = _install_prefix(self.cache, p_cache, slot_arr)
+            self._stats["prefix_installs"] += 1
+            self._stats["prefix_tokens_reused"] += len(p_tokens)
+            suffix = req.prompt[len(p_tokens):]
+            # prefill_tokens = tokens actually COMPUTED (the prefix
+            # itself arrived by HBM copy)
+            self._stats["prefill_tokens"] += len(suffix)
+            if suffix:
+                last_logits = self._prefill_chunks(slot_arr, suffix,
+                                                   fresh_first=False)
+            else:
+                last_logits = jnp.asarray(p_last)
+        elif true_len >= self.max_len and self._ring:
+            # Long prompt on a ring pool: exact-size chunk chain
+            # (see _prefill_slot_chunk). Reset the slot's stale
+            # length first — the chain reads it as its write cursor.
+            self.cache = self.cache._replace(
+                length=self.cache.length.at[slot].set(0))
+            slot_arr = jnp.asarray(slot, jnp.int32)
+            last_logits = self._prefill_chunks(slot_arr, req.prompt,
+                                               fresh_first=True)
+            self._stats["prefill_tokens"] += true_len
+        else:
+            bucket = min(_bucket(true_len), self.max_len)
+            padded = req.prompt + [0] * (bucket - true_len)
+            tokens = jnp.asarray(padded, jnp.int32)[None, :]
+            last_logits, self.cache = _prefill_slot(
+                self.params, self.config, tokens,
+                jnp.asarray(true_len, jnp.int32), self.cache,
+                jnp.asarray(slot, jnp.int32))
+            self._stats["prefill_tokens"] += true_len
+        self._emit_first_token(req, slot, last_logits)
+
+    def _schedule_batch(self, group: List["_Request"], slots: List[int],
+                        bucket: int) -> None:
+        """One batched forward prefills the whole group. The batch is
+        padded to a power of two by REPEATING row 0 (duplicate slot +
+        identical data = benign scatter), bounding the compile set to
+        (log2 slots × bucket ladder) shapes."""
+        n = len(group)
+        n_pad = 1
+        while n_pad < n:
+            n_pad *= 2
+        rows, lens, slot_ids = [], [], []
+        for req, slot in zip(group, slots):
+            req.slot = slot
+            self._slot_req[slot] = req
+            rows.append(req.prompt + [0] * (bucket - len(req.prompt)))
+            lens.append(len(req.prompt))
+            slot_ids.append(slot)
+            self._stats["prefills"] += 1
+            self._stats["prefill_tokens"] += len(req.prompt)
+        for _ in range(n_pad - n):
+            rows.append(rows[0])
+            lens.append(lens[0])
+            slot_ids.append(slot_ids[0])
+        last, self.cache = _prefill_slots_batched(
+            self.params, self.config,
+            jnp.asarray(rows, jnp.int32),
+            jnp.asarray(lens, jnp.int32), self.cache,
+            jnp.asarray(slot_ids, jnp.int32))
+        self._stats["batched_prefills"] += 1
+        self._stats["batched_prefill_slots"] += n
+        for i, (req, slot) in enumerate(zip(group, slots)):
+            self._emit_first_token(req, slot, last[i])

@@ -327,11 +327,15 @@ async function refresh() {
     lineChart(tr.rounds, tr.reward_mean || [], "reward_mean",
               "var(--series-1)") + " " +
     lineChart(tr.rounds, tr.loss || [], "loss", "var(--series-3)");
-  const last = (tr.rounds || []).slice(-12);
+  // rounds holds TRUE indices (they survive truncation); the series are
+  // positional — iterate positions and use rounds[pos] as the label.
+  const nR = (tr.rounds || []).length;
+  const positions = [...Array(nR).keys()].slice(-12);
   document.getElementById("rounds-table").innerHTML = table(
-    last.map(i => [i, fmt((tr.reward_mean || [])[i]),
-                   fmt((tr.loss || [])[i]), fmt((tr.episodes || [])[i]),
-                   fmt((tr.collect_s || [])[i])]),
+    positions.map(p => [tr.rounds[p], fmt((tr.reward_mean || [])[p]),
+                        fmt((tr.loss || [])[p]),
+                        fmt((tr.episodes || [])[p]),
+                        fmt((tr.collect_s || [])[p])]),
     ["round", "reward_mean", "loss", "episodes", "collect_s"]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(

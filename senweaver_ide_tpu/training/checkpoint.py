@@ -113,7 +113,9 @@ class CheckpointManager:
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.join(step_dir, "state"),
                                  item=jax.device_get(template._asdict()))
-        return TrainState(**restored)
+        # The optimizer is code, not checkpoint state — re-attach the
+        # template's so restored states step with the right transform.
+        return TrainState(**restored, opt=template.opt)
 
     # -- npz fallback ------------------------------------------------------
     @staticmethod

@@ -9,6 +9,7 @@ unchanged — only the Mesh differs.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
@@ -26,15 +27,38 @@ from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 
 
-class TrainState(NamedTuple):
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=("params", "opt_state", "step"),
+                   meta_fields=("opt",))
+@dataclasses.dataclass
+class TrainState:
     params: Params
     opt_state: Any
     step: jax.Array
+    # The transformation whose .init built opt_state — carried as STATIC
+    # pytree metadata so every train_step applies updates with the same
+    # optimizer. (r2 latent bug: train_step silently fell back to a
+    # module-level lr-1e-5 default whenever the caller didn't re-pass
+    # the optimizer, so make_train_state(learning_rate=X) built X-scaled
+    # opt_state that was then stepped at 1e-5 — the GRPO loops trained
+    # ~1000x slower than configured and no pytree error surfaced because
+    # both chains have identical state structure.)
+    opt: Optional[optax.GradientTransformation] = None
+
+    def _asdict(self) -> Dict[str, Any]:
+        """Array fields only (checkpoint serialization surface — the
+        optimizer is code, not state; restore re-attaches it)."""
+        return {"params": self.params, "opt_state": self.opt_state,
+                "step": self.step}
 
 
+@functools.lru_cache(maxsize=64)
 def make_optimizer(learning_rate: float = 1e-5, *, weight_decay: float = 0.0,
                    max_grad_norm: float = 1.0,
                    warmup_steps: int = 0) -> optax.GradientTransformation:
+    """Cached by config: equal arguments return the SAME transformation
+    instance, so jit caches keyed on the (static) optimizer are shared
+    across TrainStates instead of recompiling per state."""
     if warmup_steps > 0:
         schedule = optax.linear_schedule(0.0, learning_rate, warmup_steps)
     else:
@@ -62,7 +86,7 @@ def make_train_state(config: ModelConfig, key: jax.Array,
         jax.jit(opt.init,
                 out_shardings=_opt_state_shardings(opt, params, mesh))(params)
     return TrainState(params=params, opt_state=opt_state,
-                      step=jnp.zeros((), jnp.int32))
+                      step=jnp.zeros((), jnp.int32), opt=opt)
 
 
 def _opt_state_shardings(opt, params, mesh):
@@ -186,8 +210,11 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     metrics["loss"] = loss
     metrics["grad_norm"] = optax.global_norm(grads)
     metrics["adv_mean"] = jnp.mean(adv)
+    # Carry the optimizer that ACTUALLY produced this opt_state — if the
+    # caller passed one explicitly into a state built without, the next
+    # step must keep using it, not fall back to the module default.
     return TrainState(params=params, opt_state=opt_state,
-                      step=state.step + 1), metrics
+                      step=state.step + 1, opt=optimizer), metrics
 
 
 # Default optimizer instance reused across steps (hashable for jit statics).
@@ -209,8 +236,12 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
     group of each trajectory. ``accum_steps > 1`` splits the batch into
     sequentially-scanned microbatches (one microbatch of activations
     resident at a time) with token-share-weighted gradient accumulation —
-    equivalent update, fraction of the memory."""
-    opt = optimizer or _DEFAULT_OPT
+    equivalent update, fraction of the memory.
+
+    Optimizer resolution: an explicit ``optimizer`` wins, else the
+    transformation the state was BUILT with (``state.opt``), else the
+    module default — never a silent mismatch with the opt_state."""
+    opt = optimizer or state.opt or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
             old_logp, ref_logp, grpo_config, n_groups, accum_steps)

@@ -670,3 +670,86 @@ def test_concurrent_streaming_chats_share_engine(model):
         assert not isinstance(val, BaseException), (name, val)
         streamed, raw = val
         assert streamed and streamed == raw, name
+
+
+# ---- batched multi-slot prefill (r3: serial-prefill fix) ----
+
+def test_batched_prefill_matches_serial(model):
+    """A burst of same-bucket submissions prefills as ONE batched
+    forward and produces exactly the solo-run outputs (greedy)."""
+    params, config = model
+    prompts = [[i + 1, i + 2, i + 3, i + 4, i + 5] for i in range(4)]
+    refs = []
+    for p in prompts:
+        solo = RolloutEngine(params, config, num_slots=1, max_len=64,
+                             sample=GREEDY)
+        rid = solo.submit(p, max_new_tokens=8)
+        refs.append(solo.run()[rid])
+
+    eng = RolloutEngine(params, config, num_slots=4, max_len=64,
+                        sample=GREEDY)
+    rids = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    out = eng.run()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(np.asarray(out[rid]),
+                                      np.asarray(ref))
+    stats = eng.stats()
+    assert stats["batched_prefills"] >= 1
+    assert stats["batched_prefill_slots"] >= 2
+    assert stats["prefills"] == 4
+
+
+def test_batched_prefill_mixed_buckets_preserves_fifo(model):
+    """Different-bucket prompts don't batch together, but everything
+    still completes correctly in submission order."""
+    params, config = model
+    prompts = [[1, 2, 3],                       # bucket A
+               [4, 5, 6],                       # bucket A
+               list(range(1, 40)),              # bucket B (longer)
+               [7, 8, 9]]                       # bucket A (after B)
+    eng = RolloutEngine(params, config, num_slots=2, max_len=64,
+                        sample=GREEDY)
+    rids = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    out = eng.run()
+    for p, rid in zip(prompts, rids):
+        solo = RolloutEngine(params, config, num_slots=1, max_len=64,
+                             sample=GREEDY)
+        srid = solo.submit(p, max_new_tokens=4)
+        np.testing.assert_array_equal(np.asarray(out[rid]),
+                                      np.asarray(solo.run()[srid]))
+
+
+# ---- prefix-cache HBM budget (r3: LRU eviction) ----
+
+def test_prefix_lru_eviction_bounds_buffers(model):
+    params, config = model
+    eng = RolloutEngine(params, config, num_slots=2, max_len=64,
+                        sample=GREEDY, max_prefixes=2)
+    p1 = eng.register_prefix([1, 2, 3])
+    p2 = eng.register_prefix([4, 5, 6])
+    # touch p1 so p2 is the LRU victim
+    assert eng.register_prefix([1, 2, 3]) == p1
+    p3 = eng.register_prefix([7, 8, 9])
+    assert len(eng._prefixes) == 2
+    assert p2 not in eng._prefixes and p1 in eng._prefixes
+    assert eng.stats()["prefix_evictions"] == 1
+    assert p3 in eng._prefixes
+
+
+def test_prefix_eviction_fallback_to_full_prefill(model):
+    """A request carrying an evicted prefix_id that was VALID at submit
+    time completes via full prefill (scheduler fallback)."""
+    params, config = model
+    eng = RolloutEngine(params, config, num_slots=1, max_len=64,
+                        sample=GREEDY, max_prefixes=1)
+    pid = eng.register_prefix([1, 2, 3])
+    rid = eng.submit([1, 2, 3, 4], max_new_tokens=4, prefix_id=pid)
+    # queue a second request so the first sits while we evict
+    eng.register_prefix([9, 8, 7])        # evicts pid (LRU, budget=1)
+    assert pid not in eng._prefixes
+    out = eng.run()
+    solo = RolloutEngine(params, config, num_slots=1, max_len=64,
+                         sample=GREEDY)
+    srid = solo.submit([1, 2, 3, 4], max_new_tokens=4)
+    np.testing.assert_array_equal(np.asarray(out[rid]),
+                                  np.asarray(solo.run()[srid]))

@@ -1,0 +1,24 @@
+"""The north-star existence proof as a test: GRPO weight updates through
+the REAL stack (sessions → engine → sampled tokens → grouped advantages
+→ clipped update → weight publish) must RAISE reward round over round.
+
+r2 verdict item 1: no artifact anywhere demonstrated learning; r3 found
+why — train_step silently applied a module-level lr-1e-5 default instead
+of the state's optimizer (see test_rl_loop.test_train_step_uses_state_
+optimizer), so every loop trained ~1000x slower than configured. With
+the optimizer attached, the ascii-task policy converges in a handful of
+rounds; this test runs a shortened eval and asserts a decisive rise."""
+
+from eval_learning import run_learning_eval
+
+
+def test_grpo_learning_curve_rises():
+    report = run_learning_eval(rounds=6, lr=0.02, group_size=12,
+                               max_new_tokens=12, ppo_epochs=2, seed=0,
+                               window=2)
+    assert len(report["curve"]) == 6
+    # Decisive: from ~-0.5 (random ~25% base rate) to near the +1 cap.
+    assert report["reward_final"] > report["reward_initial"] + 0.5, report
+    assert report["learned"], report
+    # The curve must end high in absolute terms, not just "less bad".
+    assert report["reward_final"] > 0.3, report

@@ -233,3 +233,41 @@ def test_grpo_round_captures_engine_stats(tmp_path, tiny_stack):
     done = [p for ev, p in captured if ev == "GRPO Round Done"]
     assert done and done[0]["engine_tokens_emitted"] > 0
     assert done[0]["engine_prefill_tokens"] > 0
+
+
+def test_train_step_uses_state_optimizer():
+    """Regression (r3): train_step must apply updates with the SAME
+    transformation whose .init built state.opt_state. The r2 code fell
+    back to a module-level lr-1e-5 default whenever the caller didn't
+    re-pass the optimizer — silently stepping ~1000x slower than the
+    make_train_state(learning_rate=...) the caller asked for."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from senweaver_ide_tpu.models import get_config
+    from senweaver_ide_tpu.training import make_train_state, train_step
+
+    cfg = get_config("tiny-test")
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (4, 16), 0, 512)
+    mask = jnp.ones((4, 16), jnp.bool_)
+    rewards = jnp.asarray([1.0, -1.0, 0.5, -0.5])
+    gids = jnp.zeros((4,), jnp.int32)
+
+    def delta(lr):
+        st = make_train_state(cfg, jax.random.PRNGKey(1), None,
+                              learning_rate=lr)
+        out, _ = train_step(st, cfg, None, tokens, mask, rewards, gids)
+        return sum(float(jnp.abs(a - b).sum()) for a, b in zip(
+            jax.tree_util.tree_leaves(st.params),
+            jax.tree_util.tree_leaves(out.params)))
+
+    d_small, d_big = delta(1e-5), delta(1e-2)
+    # adamw step magnitude scales ~linearly with lr: a 1000x lr gap must
+    # show up as a >=100x parameter-delta gap (it was ~1x when broken).
+    assert d_big > 100 * d_small, (d_small, d_big)
+    # and the state carries its optimizer through updates
+    st = make_train_state(cfg, jax.random.PRNGKey(1), None,
+                          learning_rate=1e-2)
+    out, _ = train_step(st, cfg, None, tokens, mask, rewards, gids)
+    assert out.opt is st.opt is not None

@@ -144,3 +144,39 @@ def test_fim_unsupported_provider_raises(fim_server):
                            rate_limiter=TPMRateLimiter())
     with pytest.raises(TransportUnavailable, match="does not support"):
         c.fim_complete("a", "b")
+
+
+# ---- provider-capability conformance (modelCapabilities.ts:214-263) ----
+
+def test_every_provider_default_model_resolves_capabilities():
+    """Every registered provider's default model must land on a REAL
+    capability entry (not the 128k fallback) — the reference's 20-provider
+    surface keeps its capability DB in lockstep with the provider list."""
+    from senweaver_ide_tpu.models.capabilities import (_DEFAULT,
+                                                       get_model_capabilities)
+    from senweaver_ide_tpu.transport.providers import PROVIDERS
+
+    for name, p in PROVIDERS.items():
+        if not p.default_model:
+            continue    # aggregator/self-hosted endpoints have no default
+        caps = get_model_capabilities(p.default_model)
+        assert caps.context_window > 0, (name, p.default_model)
+        assert caps is not _DEFAULT, (
+            f"provider {name} default model {p.default_model!r} fell "
+            f"through to the generic fallback — add a capability entry")
+
+
+def test_capability_lookup_specific_before_generic():
+    from senweaver_ide_tpu.models.capabilities import get_model_capabilities
+
+    assert get_model_capabilities("Qwen2.5-Coder-1.5B").supports_fim
+    assert not get_model_capabilities("qwen3-32b").supports_fim
+    assert get_model_capabilities("qwen3-32b").reasoning_think_tags
+    assert get_model_capabilities("deepseek-r1-distill").reasoning_think_tags
+    assert get_model_capabilities("gpt-4o-mini").max_output_tokens == 16_384
+    assert get_model_capabilities("gpt-4-turbo").max_output_tokens == 4096
+    assert get_model_capabilities("o1-preview").supports_system_message \
+        is False
+    assert get_model_capabilities("codestral-latest").supports_fim
+    # unknown models still resolve (the reference's default fallback)
+    assert get_model_capabilities("never-heard-of-it").context_window > 0
