@@ -37,11 +37,13 @@ def main() -> None:
                     help="serving context bound for the real policy")
     args = ap.parse_args()
 
-    if not args.model_dir:
-        # Scripted-policy path: the only device work is the tiny jit
-        # reward head — force CPU via the live config (env vars arrive
-        # too late when a platform plugin pre-imports jax, and a wedged
-        # accelerator tunnel would hang backend init forever).
+    if not args.model_dir or args.config.startswith("tiny"):
+        # Scripted-policy path (only device work is the tiny jit reward
+        # head) or a CPU-sized fixture checkpoint: force CPU via the
+        # live config BEFORE any package import — module imports touch
+        # jax.numpy, and on a wedged accelerator tunnel the resulting
+        # backend init blocks forever (observed r2/r3; env vars arrive
+        # too late when a platform plugin pre-imports jax).
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -54,11 +56,6 @@ def main() -> None:
         from senweaver_ide_tpu.rollout import (EnginePolicyClient,
                                                RolloutEngine)
         config = get_config(args.config)
-        if config.name.startswith("tiny"):
-            # Fixture checkpoints are CPU-sized; don't gamble on the
-            # accelerator tunnel for a smoke of the loading path.
-            import jax
-            jax.config.update("jax_platforms", "cpu")
         params = load_hf_params(args.model_dir, config)
         engine = RolloutEngine(params, config, max_len=args.engine_max_len)
         client = EnginePolicyClient(engine, load_tokenizer(args.model_dir),

@@ -39,13 +39,9 @@ _THINK = ("<think>", "</think>")
 # surface resolves real capabilities instead of the fallback.
 _CAPABILITIES: Tuple[Tuple[str, ModelCapabilities], ...] = (
     # --- local policy ladder (BASELINE configs) --------------------------
-    ("qwen2.5-coder", ModelCapabilities(
-        context_window=32_768, supports_fim=True, fim_tokens=_QWEN_FIM)),
-    ("qwen3", ModelCapabilities(context_window=131_072,
-                                reasoning_think_tags=_THINK)),
-    ("qwq", ModelCapabilities(context_window=131_072,
-                              reasoning_think_tags=_THINK)),
-    ("qwen", ModelCapabilities(context_window=131_072)),
+    # deepseek keys sort ABOVE the qwen family: R1 qwen-distill ids
+    # ("deepseek-r1-distill-qwen-7b") contain BOTH substrings and must
+    # resolve the reasoning entry, not generic qwen.
     ("deepseek-coder", ModelCapabilities(
         context_window=16_384, supports_fim=True,
         fim_tokens=_DEEPSEEK_FIM)),
@@ -56,6 +52,13 @@ _CAPABILITIES: Tuple[Tuple[str, ModelCapabilities], ...] = (
         max_output_tokens=8192)),
     ("deepseek", ModelCapabilities(context_window=65_536,
                                    max_output_tokens=8192)),
+    ("qwen2.5-coder", ModelCapabilities(
+        context_window=32_768, supports_fim=True, fim_tokens=_QWEN_FIM)),
+    ("qwen3", ModelCapabilities(context_window=131_072,
+                                reasoning_think_tags=_THINK)),
+    ("qwq", ModelCapabilities(context_window=131_072,
+                              reasoning_think_tags=_THINK)),
+    ("qwen", ModelCapabilities(context_window=131_072)),
     # --- mistral family --------------------------------------------------
     ("codestral", ModelCapabilities(
         context_window=262_144, supports_fim=True)),

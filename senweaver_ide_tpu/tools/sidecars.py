@@ -45,8 +45,11 @@ class SidecarConfig:
     max_fetch_bytes: int = 2_000_000
     default_max_length: int = 50_000
     user_agent: str = "senweaver-ide-tpu/0.2"
-    # Search engines tried in order until one returns results.
+    # Search engines: ALL are queried concurrently and rank-merged
+    # (the reference's 8-engine rotation, startWebSearchServer.cjs).
     search_engines: Sequence[SearchEngine] = ()
+    # Cap on concurrently-queried engines per search.
+    fanout: int = 8
     # Optional URL predicate for fetch_url/api_request (e.g. allowlist).
     url_filter: Optional[Callable[[str], bool]] = None
 
@@ -182,18 +185,62 @@ class SidecarServices:
 
     # -- web_search (startWebSearchServer.cjs) ----------------------------
     def web_search(self, p: Dict[str, Any]) -> Dict[str, Any]:
+        """Multi-engine fan-out → rank-merge (startWebSearchServer.cjs
+        :1025-1027 rotates 8 engines; here ALL configured engines are
+        queried CONCURRENTLY and their result lists fuse by reciprocal
+        rank, so one slow/flaky engine neither blocks nor biases the
+        answer). Dedup is by URL; an engine that throws only drops its
+        own votes. With zero engines (the hermetic default) this stays
+        an OK-shaped empty result, not a failed tool call."""
+        import concurrent.futures as _fut
+
         query = p["query"]
         limit = int(p.get("max_results") or 10)
+        engines = list(self.config.search_engines)[:self.config.fanout]
         errors: List[str] = []
-        for engine in self.config.search_engines:
+        per_engine: List[tuple] = []     # (engine_name, results)
+        if engines:
+            # No context manager: its exit JOINS workers, so one wedged
+            # engine would stall every search. Bounded wait + abandon.
+            pool = _fut.ThreadPoolExecutor(max_workers=len(engines))
+            futs = {pool.submit(e, query, limit):
+                    getattr(e, "__name__", f"engine{i}")
+                    for i, e in enumerate(engines)}
+            pending = set(futs)
             try:
-                results = engine(query, limit)[:limit]
-                if results:
-                    return {"query": query, "results": results,
-                            "engine": getattr(engine, "__name__", "engine")}
-            except Exception as e:  # engine down/offline → try the next
-                errors.append(f"{getattr(engine, '__name__', 'engine')}: "
-                              f"{type(e).__name__}")
+                for f in _fut.as_completed(futs,
+                                           timeout=self.config.timeout_s):
+                    pending.discard(f)
+                    name = futs[f]
+                    try:
+                        per_engine.append((name, list(f.result())[:limit]))
+                    except Exception as e:   # engine down → skip its votes
+                        errors.append(f"{name}: {type(e).__name__}")
+            except _fut.TimeoutError:        # stragglers forfeit their votes
+                for f in pending:
+                    errors.append(f"{futs[f]}: timeout")
+            finally:
+                pool.shutdown(wait=False, cancel_futures=True)
+        # Reciprocal-rank fusion over URL identity: score(url) =
+        # Σ_engines 1/(K + rank); K=60 is the standard RRF constant.
+        fused: Dict[str, Dict[str, Any]] = {}
+        K = 60.0
+        # Deterministic fold order; key on the NAME only (two engines may
+        # share a __name__, and result dicts don't compare).
+        for name, results in sorted(per_engine, key=lambda t: t[0]):
+            for rank, r in enumerate(results):
+                url = r.get("url") or r.get("link") or r.get("title", "")
+                entry = fused.setdefault(
+                    url, {"result": dict(r), "score": 0.0, "engines": []})
+                entry["score"] += 1.0 / (K + rank)
+                entry["engines"].append(name)
+        ranked = sorted(fused.values(), key=lambda e: -e["score"])[:limit]
+        if ranked:
+            return {"query": query,
+                    "results": [{**e["result"],
+                                 "engines": e["engines"]} for e in ranked],
+                    "engines_queried": len(engines),
+                    "engines_failed": len(errors)}
         # Graceful offline degradation: an OK result with zero hits (the
         # model sees "no results", not a failed tool call).
         return {"query": query, "results": [],

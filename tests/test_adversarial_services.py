@@ -1,0 +1,191 @@
+"""Adversarial coverage for the collaboration channel and extension
+servers (r2 weak item 9: only happy paths + basic eviction/reconnect
+were tested). Malformed frames, dead peers mid-relay, coordinator
+crash/recreate, garbage-spewing and mid-call-dying extension servers."""
+
+import json
+import socket
+import sys
+import time
+
+import pytest
+
+from senweaver_ide_tpu.services.collaboration import (CollabCoordinator,
+                                                      CollabSession)
+from senweaver_ide_tpu.services.extensions import (ExtensionServerError,
+                                                   ExtensionToolRegistry,
+                                                   ExtensionTransportError)
+
+
+@pytest.fixture()
+def coord():
+    c = CollabCoordinator(heartbeat_timeout_s=1.0)
+    c.start()
+    yield c
+    c.stop()
+
+
+def _session(coord, cid, **kw):
+    host, port = coord.address
+    s = CollabSession(host, port, cid, heartbeat_interval_s=0.2, **kw)
+    s.connect()
+    return s
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---- collaboration: malformed and hostile frames ------------------------
+
+def test_malformed_frames_do_not_kill_coordinator(coord):
+    host, port = coord.address
+    with socket.create_connection((host, port), timeout=5) as raw:
+        raw.sendall(b"\x00\xff\x00 not json at all\n")
+        raw.sendall(b'{"truncated": \n')
+        raw.sendall(b'42\n')                       # JSON, wrong shape
+        raw.sendall(b"\n\n\n")                     # empty lines
+        raw.settimeout(5)
+        data = raw.recv(65536)
+        assert b"error" in data                    # spoke, didn't die
+    # coordinator still serves real clients afterwards
+    s = _session(coord, "after-garbage")
+    try:
+        code = s.create_room()
+        assert code in coord.rooms
+    finally:
+        s.close()
+
+
+def test_binary_flood_then_normal_client(coord):
+    host, port = coord.address
+    with socket.create_connection((host, port), timeout=5) as raw:
+        raw.sendall(b"A" * 300_000 + b"\n")        # one huge junk line
+    s = _session(coord, "post-flood")
+    try:
+        assert s.create_room() in coord.rooms
+    finally:
+        s.close()
+
+
+def test_dead_peer_mid_relay_does_not_break_room(coord):
+    """A follower that vanishes without 'leave' must not take the room
+    down: the host keeps relaying, and the corpse is eventually
+    evicted by the heartbeat reaper."""
+    host_s = _session(coord, "host")
+    try:
+        code = host_s.create_room()
+        host, port = coord.address
+        raw = socket.create_connection((host, port), timeout=5)
+        raw.sendall((json.dumps({"id": 1, "op": "join_room", "room": code,
+                                 "client_id": "ghost"}) + "\n").encode())
+        raw.settimeout(5)
+        raw.recv(65536)                            # join ack
+        assert _wait(lambda: len(coord.rooms[code].participants) == 2)
+        raw.close()                                # vanish mid-session
+
+        for i in range(3):                         # relay into the void
+            host_s.send({"n": i})
+        time.sleep(0.3)
+        host_s.send({"n": "still-alive"})          # host unaffected
+        assert _wait(
+            lambda: "ghost" not in coord.rooms[code].participants,
+            timeout=6.0)                           # reaper collected it
+    finally:
+        host_s.close()
+
+
+def test_coordinator_crash_surfaces_to_session_then_recreate(coord):
+    s = _session(coord, "orphan")
+    code = s.create_room()
+    coord.stop()                                   # server crash
+    with pytest.raises(Exception):
+        for _ in range(10):                        # buffered sends may
+            s.send({"x": 1})                       # take a few tries
+            time.sleep(0.05)
+    s.close()
+
+    fresh = CollabCoordinator(heartbeat_timeout_s=1.0)
+    fresh.start()
+    try:
+        s2 = _session(fresh, "phoenix")
+        try:
+            new_code = s2.create_room()
+            assert new_code in fresh.rooms
+            assert code not in fresh.rooms         # no zombie state
+        finally:
+            s2.close()
+    finally:
+        fresh.stop()
+
+
+# ---- extension servers: garbage, death, id confusion --------------------
+
+NOISY_SERVER = '''
+import sys, json
+print("starting up... not json", flush=True)
+for line in sys.stdin:
+    req = json.loads(line)
+    rid = req["id"]
+    print("log: handling request", flush=True)          # stray line
+    print(json.dumps({"jsonrpc": "2.0", "id": 999999,
+                      "result": "stale"}), flush=True)  # wrong id
+    if req["method"] == "initialize":
+        r = {"name": "noisy"}
+    elif req["method"] == "tools/list":
+        r = {"tools": [{"name": "echo", "description": "",
+                        "inputSchema": {}}]}
+    else:
+        r = {"ok": True}
+    print(json.dumps({"jsonrpc": "2.0", "id": rid, "result": r}),
+          flush=True)
+'''
+
+DIES_MID_CALL = '''
+import sys, json
+n = 0
+for line in sys.stdin:
+    req = json.loads(line)
+    n += 1
+    if n >= 3:
+        sys.exit(1)                    # dies on the first tools/call
+    print(json.dumps({"jsonrpc": "2.0", "id": req["id"],
+                      "result": {"tools": []} if "list" in req["method"]
+                      else {"name": "mortal"}}), flush=True)
+'''
+
+
+def test_extension_survives_garbage_and_stale_ids(tmp_path):
+    script = tmp_path / "noisy.py"
+    script.write_text(NOISY_SERVER)
+    reg = ExtensionToolRegistry()
+    try:
+        reg.add_server("noisy", [sys.executable, str(script)])
+        tools = reg.all_tools()
+        assert [t.name for t in tools] == ["echo"]
+        out = reg.call("noisy.echo", {})
+        assert out == {"ok": True}
+    finally:
+        reg.close()
+
+
+def test_extension_dying_mid_call_raises_transport_error(tmp_path):
+    script = tmp_path / "mortal.py"
+    script.write_text(DIES_MID_CALL)
+    reg = ExtensionToolRegistry()
+    try:
+        reg.add_server("mortal", [sys.executable, str(script)])
+        with pytest.raises(ExtensionTransportError):
+            reg.call("mortal.anything", {})
+        # the server object reports dead; restart gives a fresh process
+        srv = reg.servers["mortal"]
+        assert _wait(lambda: not srv.alive)
+        srv.restart()
+        assert srv.alive
+    finally:
+        reg.close()

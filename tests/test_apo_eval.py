@@ -78,3 +78,35 @@ def test_run_uplift_eval_reports_uplift(tmp_path):
 
 def test_six_pattern_tasks_cover_all_patterns():
     assert len(SIX_PATTERN_TASKS) == 6
+
+
+def test_real_policy_uplift_path_end_to_end(tmp_path):
+    """The --model-dir path of eval_uplift.py must execute end to end:
+    a generated HF-layout fixture checkpoint loads through
+    models/load.py, serves through the engine, and drives the full APO
+    cycle (r2 verdict: this path had never been run)."""
+    import json
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    import jax
+
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.models.load import export_hf_params
+
+    cfg = get_config("tiny-test")
+    export_hf_params(init_params(cfg, jax.random.PRNGKey(7)),
+                     cfg, str(tmp_path / "ckpt"))
+    root = Path(__file__).resolve().parent.parent
+    r = subprocess.run(
+        [sys.executable, str(root / "eval_uplift.py"),
+         "--model-dir", str(tmp_path / "ckpt"), "--config", "tiny-test",
+         "--beam-rounds", "1", "--max-new-tokens", "8", "--tasks", "1",
+         "--engine-max-len", "2560"],
+        capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr[-2000:]
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "error" not in report, report
+    assert report["policy"]["config"] == "tiny-test"
+    assert "baseline_final_reward" in report

@@ -35,3 +35,9 @@ def test_control_plane_example():
     r = _run("control_plane.py")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "JOBS SESSION OK" in r.stdout
+
+
+def test_dashboard_demo_example():
+    r = _run("dashboard_demo.py", "--once")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DASHBOARD STATE OK" in r.stdout

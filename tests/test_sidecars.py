@@ -151,7 +151,7 @@ def test_web_search_pluggable_engine(tmp_path):
                           SidecarConfig(search_engines=(fake_engine,)))
     out = svc.web_search({"query": "jax", "max_results": 3})
     assert out["results"][0]["title"] == "hit for jax"
-    assert out["engine"] == "fake_engine"
+    assert out["results"][0]["engines"] == ["fake_engine"]
 
 
 def test_engine_failure_falls_through(tmp_path):
@@ -165,6 +165,53 @@ def test_engine_failure_falls_through(tmp_path):
                           SidecarConfig(search_engines=(broken, backup)))
     out = svc.web_search({"query": "q"})
     assert out["results"][0]["title"] == "from backup"
+    assert out["engines_failed"] == 1
+
+
+def test_web_search_fanout_rank_merges(tmp_path):
+    """All engines are queried; URLs returned by MORE engines (and at
+    better ranks) fuse to the top (reciprocal-rank fusion), deduped by
+    URL with per-result engine attribution."""
+    def alpha(query, limit):
+        return [{"title": "shared", "url": "http://s", "snippet": ""},
+                {"title": "only-a", "url": "http://a", "snippet": ""}]
+
+    def beta(query, limit):
+        return [{"title": "only-b", "url": "http://b", "snippet": ""},
+                {"title": "shared", "url": "http://s", "snippet": ""}]
+
+    def gamma(query, limit):
+        return [{"title": "shared", "url": "http://s", "snippet": ""}]
+
+    svc = SidecarServices(Workspace(tmp_path / "ws"),
+                          SidecarConfig(search_engines=(alpha, beta,
+                                                        gamma)))
+    out = svc.web_search({"query": "q", "max_results": 10})
+    assert out["engines_queried"] == 3
+    urls = [r["url"] for r in out["results"]]
+    assert urls[0] == "http://s"                   # 3 votes beats 1
+    assert set(urls) == {"http://s", "http://a", "http://b"}  # deduped
+    shared = out["results"][0]
+    assert sorted(shared["engines"]) == ["alpha", "beta", "gamma"]
+
+
+def test_web_search_fanout_cap(tmp_path):
+    calls = []
+
+    def make(name):
+        def engine(query, limit):
+            calls.append(name)
+            return []
+        engine.__name__ = name
+        return engine
+
+    svc = SidecarServices(
+        Workspace(tmp_path / "ws"),
+        SidecarConfig(search_engines=tuple(make(f"e{i}")
+                                           for i in range(5)),
+                      fanout=2))
+    svc.web_search({"query": "q"})
+    assert len(calls) == 2
 
 
 def test_url_filter_blocks(tmp_path):
@@ -191,3 +238,42 @@ def test_tools_service_integration(server, tmp_path):
 def test_html_to_text_structure():
     text = html_to_text("<div>a<br>b</div><ul><li>c</li><li>d</li></ul>")
     assert "a\nb" in text and "c\nd" in text
+
+
+def test_web_search_duplicate_engine_names(tmp_path):
+    def make(results):
+        def search(query, limit):      # shared __name__ on purpose
+            return results
+        return search
+
+    svc = SidecarServices(
+        Workspace(tmp_path / "ws"),
+        SidecarConfig(search_engines=(
+            make([{"title": "x", "url": "http://x", "snippet": ""}]),
+            make([{"title": "y", "url": "http://y", "snippet": ""}]))))
+    out = svc.web_search({"query": "q"})
+    assert {r["url"] for r in out["results"]} == {"http://x", "http://y"}
+
+
+def test_web_search_hung_engine_forfeits(tmp_path):
+    import threading
+    release = threading.Event()
+
+    def hung(query, limit):
+        release.wait(20)
+        return [{"title": "late", "url": "http://late", "snippet": ""}]
+
+    def fast(query, limit):
+        return [{"title": "fast", "url": "http://fast", "snippet": ""}]
+
+    svc = SidecarServices(
+        Workspace(tmp_path / "ws"),
+        SidecarConfig(search_engines=(hung, fast), timeout_s=1.5))
+    import time
+    t0 = time.monotonic()
+    out = svc.web_search({"query": "q"})
+    elapsed = time.monotonic() - t0
+    release.set()                        # unblock the abandoned worker
+    assert elapsed < 10, elapsed         # bounded, not joined forever
+    assert [r["url"] for r in out["results"]] == ["http://fast"]
+    assert out["engines_failed"] == 1

@@ -173,6 +173,10 @@ def test_capability_lookup_specific_before_generic():
     assert not get_model_capabilities("qwen3-32b").supports_fim
     assert get_model_capabilities("qwen3-32b").reasoning_think_tags
     assert get_model_capabilities("deepseek-r1-distill").reasoning_think_tags
+    # distill ids contain BOTH family substrings; the reasoning entry
+    # must win over generic qwen (ordering regression guard)
+    caps = get_model_capabilities("deepseek-r1-distill-qwen-7b")
+    assert caps.reasoning_think_tags and caps.context_window == 65_536
     assert get_model_capabilities("gpt-4o-mini").max_output_tokens == 16_384
     assert get_model_capabilities("gpt-4-turbo").max_output_tokens == 4096
     assert get_model_capabilities("o1-preview").supports_system_message \
