@@ -69,8 +69,27 @@ gids = garr(gid_h, P("dp"))
 state, metrics = train_step(state, cfg, mesh, tokens, mask, rewards, gids)
 loss = float(metrics["loss"])
 gn = float(metrics["grad_norm"])
+
+# Hybrid multi-slice mesh: dp spans the PROCESS boundary (the DCN axis
+# rehearsal — virtual slices group each process's contiguous devices),
+# fsdp/tp stay process-local (the ICI axes).
+from senweaver_ide_tpu.parallel import MeshConfig
+from senweaver_ide_tpu.parallel.mesh import make_hybrid_mesh
+
+hy_mesh = make_hybrid_mesh(MeshConfig(dp=2, fsdp=2, tp=2), num_slices=2)
+# The layout property under test: each process's LOCAL devices occupy
+# exactly one dp coordinate (dp spans the process/DCN boundary).
+local_dp = {int(np.argwhere(hy_mesh.devices == d)[0][0])
+            for d in jax.local_devices()}
+hy_state = make_train_state(cfg, jax.random.PRNGKey(1), hy_mesh,
+                            learning_rate=1e-3)
+hy_state, hy_metrics = train_step(hy_state, cfg, hy_mesh, tokens, mask,
+                                  rewards, gids)
 print(json.dumps({"pid": pid, "loss": loss, "grad_norm": gn,
-                  "step": int(state.step)}), flush=True)
+                  "step": int(state.step),
+                  "hybrid_loss": float(hy_metrics["loss"]),
+                  "hybrid_shape": dict(hy_mesh.shape),
+                  "local_dp_coords": sorted(local_dp)}), flush=True)
 """
 
 
@@ -114,3 +133,12 @@ def test_two_process_distributed_train_step(tmp_path):
     assert outs[0]["step"] == outs[1]["step"] == 1
     assert outs[0]["loss"] == outs[1]["loss"]
     assert outs[0]["grad_norm"] == outs[1]["grad_norm"]
+    # The hybrid multi-slice mesh (dp across the process/DCN boundary)
+    # also trained, with identical all-reduced loss on both sides, and
+    # the dp axis REALLY spans the process boundary: each process's
+    # local devices sit at one distinct dp coordinate.
+    assert outs[0]["hybrid_loss"] == outs[1]["hybrid_loss"]
+    assert outs[0]["hybrid_shape"] == {"dp": 2, "fsdp": 2, "tp": 2,
+                                       "sp": 1}
+    assert len(outs[0]["local_dp_coords"]) == 1
+    assert outs[0]["local_dp_coords"] != outs[1]["local_dp_coords"]
