@@ -247,6 +247,19 @@ class SidecarServices:
                 "note": "no search engine available"
                         + (f" ({'; '.join(errors)})" if errors else "")}
 
+    def text_fetcher(self) -> Callable[[str], str]:
+        """``fetch(url) -> body text`` over this sidecar's HTTP stack
+        (UA, timeout, byte cap, url_filter) — the injection point for
+        the concrete search-engine adapters (tools/search_engines.py):
+
+            cfg.search_engines = default_engines(svc.text_fetcher())
+        """
+        def fetch(url: str) -> str:
+            self._check_url(url)
+            raw, _ctype, _final = self._get(url)
+            return raw
+        return fetch
+
     # -- internals --------------------------------------------------------
     def _check_url(self, url: str) -> None:
         if self.config.url_filter is not None \

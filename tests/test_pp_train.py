@@ -53,39 +53,51 @@ def test_pp_train_step_matches_single_device(pp_mesh):
     assert int(pp_state.step) == 1
 
 
-def test_pp_1f1b_matches_gpipe(pp_mesh):
-    """The 1F1B schedule computes the SAME update as GPipe autodiff —
-    same loss, same grads (via grad_norm), same updated params — while
-    bounding resident activations by pipeline depth (min(M, 2K) saved
-    stage inputs) instead of all M microbatches."""
-    cfg = get_config("tiny-test")
-    params = init_params(cfg, jax.random.PRNGKey(4))
-    b, s = 8, 20
-    tokens = jax.random.randint(jax.random.PRNGKey(5), (b, s), 0, 512)
-    mask = jnp.ones((b, s), jnp.bool_).at[:, :4].set(False)
+def _assert_1f1b_matches_gpipe(cfg, mesh, *, key, b, s, n_microbatches,
+                               masked_prefix=0):
+    """Shared parity contract: 1F1B == GPipe on loss, grad_norm, and
+    EVERY param group (per-layer, embed scatter, lm_head/norm — the
+    first/last-stage specials)."""
+    params = init_params(cfg, jax.random.PRNGKey(key))
+    tokens = jax.random.randint(jax.random.PRNGKey(key + 1), (b, s), 0,
+                                512)
+    mask = jnp.ones((b, s), jnp.bool_)
+    if masked_prefix:
+        mask = mask.at[:, :masked_prefix].set(False)
     rewards = jnp.linspace(-1.0, 1.0, b)
-    gids = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3], jnp.int32)
+    gids = jnp.asarray(np.repeat(np.arange(b // 2), 2), jnp.int32)
 
-    st_g = make_pp_train_state(cfg, jax.random.PRNGKey(4), pp_mesh,
+    st_g = make_pp_train_state(cfg, jax.random.PRNGKey(key), mesh,
                                learning_rate=1e-3, params=params)
-    st_i = make_pp_train_state(cfg, jax.random.PRNGKey(4), pp_mesh,
+    st_i = make_pp_train_state(cfg, jax.random.PRNGKey(key), mesh,
                                learning_rate=1e-3, params=params)
-    st_g, m_g = pp_train_step(st_g, cfg, pp_mesh, tokens, mask, rewards,
-                              gids, n_microbatches=4, schedule="gpipe")
-    st_i, m_i = pp_train_step(st_i, cfg, pp_mesh, tokens, mask, rewards,
-                              gids, n_microbatches=4, schedule="1f1b")
+    st_g, m_g = pp_train_step(st_g, cfg, mesh, tokens, mask, rewards,
+                              gids, n_microbatches=n_microbatches,
+                              schedule="gpipe")
+    st_i, m_i = pp_train_step(st_i, cfg, mesh, tokens, mask, rewards,
+                              gids, n_microbatches=n_microbatches,
+                              schedule="1f1b")
     assert np.isclose(float(m_i["loss"]), float(m_g["loss"]), atol=1e-5)
     assert np.isclose(float(m_i["grad_norm"]), float(m_g["grad_norm"]),
                       rtol=1e-4)
     for name, g_leaf in st_g.params["layers"].items():
         np.testing.assert_allclose(np.asarray(st_i.params["layers"][name]),
-                                   np.asarray(g_leaf), atol=2e-5, rtol=2e-5)
-    np.testing.assert_allclose(np.asarray(st_i.params["embed"]),
-                               np.asarray(st_g.params["embed"]),
-                               atol=2e-5, rtol=2e-5)
-    np.testing.assert_allclose(np.asarray(st_i.params["lm_head"]),
-                               np.asarray(st_g.params["lm_head"]),
-                               atol=2e-5, rtol=2e-5)
+                                   np.asarray(g_leaf), atol=2e-5,
+                                   rtol=2e-5)
+    for group in ("embed", "lm_head", "final_norm"):
+        np.testing.assert_allclose(np.asarray(st_i.params[group]),
+                                   np.asarray(st_g.params[group]),
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_pp_1f1b_matches_gpipe(pp_mesh):
+    """The 1F1B schedule computes the SAME update as GPipe autodiff —
+    same loss, same grads (via grad_norm), same updated params — while
+    bounding resident activations by pipeline depth (min(M, 2K) saved
+    stage inputs) instead of all M microbatches."""
+    _assert_1f1b_matches_gpipe(get_config("tiny-test"), pp_mesh, key=4,
+                               b=8, s=20, n_microbatches=4,
+                               masked_prefix=4)
 
 
 def test_pp_1f1b_fewer_microbatches_than_depth(pp_mesh):
@@ -144,35 +156,10 @@ def test_pp_two_steps_keep_improving(pp_mesh):
 def test_pp_1f1b_four_stages():
     """Deeper pipeline (K=4): the interleave schedule and ring-buffer
     sizing must hold when warmup/cooldown dominate (K=4 stages, M=4
-    microbatches — 1 layer per stage on a 4-layer config)."""
+    microbatches — 1 layer per stage on a 4-layer config); same shared
+    parity contract as the K=2 case."""
     import dataclasses
     cfg = dataclasses.replace(get_config("tiny-test"), num_layers=4)
     mesh4 = make_named_mesh({"pp": 4}, devices=jax.devices()[:4])
-    params = init_params(cfg, jax.random.PRNGKey(9))
-    tokens = jax.random.randint(jax.random.PRNGKey(10), (8, 16), 0, 512)
-    mask = jnp.ones((8, 16), jnp.bool_)
-    rewards = jnp.linspace(-1.0, 1.0, 8)
-    gids = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3], jnp.int32)
-    st_g = make_pp_train_state(cfg, jax.random.PRNGKey(9), mesh4,
-                               params=params)
-    st_i = make_pp_train_state(cfg, jax.random.PRNGKey(9), mesh4,
-                               params=params)
-    st_g, m_g = pp_train_step(st_g, cfg, mesh4, tokens, mask, rewards,
-                              gids, n_microbatches=4, schedule="gpipe")
-    st_i, m_i = pp_train_step(st_i, cfg, mesh4, tokens, mask, rewards,
-                              gids, n_microbatches=4, schedule="1f1b")
-    assert np.isclose(float(m_i["loss"]), float(m_g["loss"]), atol=1e-5)
-    assert np.isclose(float(m_i["grad_norm"]), float(m_g["grad_norm"]),
-                      rtol=1e-4)
-    for name, g_leaf in st_g.params["layers"].items():
-        np.testing.assert_allclose(np.asarray(st_i.params["layers"][name]),
-                                   np.asarray(g_leaf), atol=2e-5,
-                                   rtol=2e-5)
-    # first/last-stage specials (embed scatter, head/norm grads) are the
-    # warmup/cooldown-sensitive pieces — check them at K=4 too
-    np.testing.assert_allclose(np.asarray(st_i.params["embed"]),
-                               np.asarray(st_g.params["embed"]),
-                               atol=2e-5, rtol=2e-5)
-    np.testing.assert_allclose(np.asarray(st_i.params["lm_head"]),
-                               np.asarray(st_g.params["lm_head"]),
-                               atol=2e-5, rtol=2e-5)
+    _assert_1f1b_matches_gpipe(cfg, mesh4, key=9, b=8, s=16,
+                               n_microbatches=4)

@@ -1,0 +1,122 @@
+"""Concrete search-engine adapters (tools/search_engines.py): parser
+fidelity on canned fixtures + fan-out integration through web_search —
+hermetic (the fetcher is injected; no network)."""
+
+from senweaver_ide_tpu.tools.search_engines import (arxiv_engine,
+                                                    bing_engine,
+                                                    default_engines,
+                                                    duckduckgo_engine,
+                                                    github_engine)
+from senweaver_ide_tpu.tools.sandbox import Workspace
+from senweaver_ide_tpu.tools.sidecars import SidecarConfig, SidecarServices
+
+DDG_PAGE = """
+<div class="result">
+ <a class="result__a" href="//duckduckgo.com/l/?uddg=https%3A%2F%2Fjax.dev%2Fdocs&amp;rut=x">JAX docs &amp; guides</a>
+ <a class="result__snippet" href="#">Composable <b>transformations</b> of programs.</a>
+</div>
+<div class="result">
+ <a class="result__a" href="https://example.org/direct">Direct hit</a>
+</div>
+"""
+
+BING_PAGE = """
+<ol><li class="b_algo"><h2><a href="https://jax.dev/">JAX</a></h2>
+<div><p>High-performance <i>array</i> computing.</p></div></li>
+<li class="b_algo"><h2><a href="https://flax.dev/">Flax</a></h2>
+<div></div></li></ol>
+"""
+
+GITHUB_JSON = """{"items": [
+ {"full_name": "jax-ml/jax", "html_url": "https://github.com/jax-ml/jax",
+  "description": "Composable transformations"},
+ {"full_name": "google/flax", "html_url": "https://github.com/google/flax",
+  "description": null}]}"""
+
+ARXIV_FEED = """<feed>
+<entry><id>http://arxiv.org/abs/1811.02084</id>
+<title>Mesh-TensorFlow: Deep Learning for Supercomputers</title>
+<summary>We introduce Mesh-TensorFlow...</summary></entry>
+<entry><id>http://arxiv.org/abs/2211.05102</id>
+<title>Efficiently Scaling Transformer Inference</title>
+<summary>Partitioning strategies.</summary></entry>
+</feed>"""
+
+
+def _fixture_fetch(url: str) -> str:
+    if "duckduckgo" in url:
+        return DDG_PAGE
+    if "bing.com" in url:
+        return BING_PAGE
+    if "api.github.com" in url:
+        return GITHUB_JSON
+    if "arxiv.org" in url:
+        return ARXIV_FEED
+    raise AssertionError(f"unexpected url {url}")
+
+
+def test_ddg_parser_unwraps_redirects_and_entities():
+    res = duckduckgo_engine(_fixture_fetch)("jax", 5)
+    assert res[0]["title"] == "JAX docs & guides"
+    assert res[0]["url"] == "https://jax.dev/docs"      # uddg unwrapped
+    assert "transformations" in res[0]["snippet"]
+    assert res[1]["url"] == "https://example.org/direct"
+
+
+def test_bing_parser_titles_and_snippets():
+    res = bing_engine(_fixture_fetch)("jax", 5)
+    assert [r["url"] for r in res] == ["https://jax.dev/",
+                                       "https://flax.dev/"]
+    assert "array computing" in res[0]["snippet"]
+    assert res[1]["snippet"] == ""
+
+
+def test_github_parser_null_description():
+    res = github_engine(_fixture_fetch)("jax", 5)
+    assert res[0]["title"] == "jax-ml/jax"
+    assert res[1]["snippet"] == ""
+
+
+def test_arxiv_parser_entries():
+    res = arxiv_engine(_fixture_fetch)("mesh", 1)       # limit respected
+    assert len(res) == 1
+    assert res[0]["url"].endswith("1811.02084")
+    assert "Mesh-TensorFlow" in res[0]["title"]
+
+
+def test_default_engines_through_fanout_merge(tmp_path):
+    svc = SidecarServices(
+        Workspace(tmp_path / "ws"),
+        SidecarConfig(search_engines=default_engines(_fixture_fetch)))
+    out = svc.web_search({"query": "jax", "max_results": 10})
+    assert out["engines_queried"] == 4
+    assert out["engines_failed"] == 0
+    urls = {r["url"] for r in out["results"]}
+    assert "https://jax.dev/" in urls and "https://github.com/jax-ml/jax" \
+        in urls
+    # every result carries its engine attribution
+    assert all(r["engines"] for r in out["results"])
+
+
+def test_text_fetcher_injection(tmp_path):
+    """The sidecar's own HTTP stack is the production fetcher (UA,
+    timeout, caps, url_filter apply to engine traffic too)."""
+    svc = SidecarServices(
+        Workspace(tmp_path / "ws"),
+        SidecarConfig(url_filter=lambda u: "allowed" in u))
+    fetch = svc.text_fetcher()
+    import pytest
+    with pytest.raises(PermissionError):
+        fetch("http://blocked.example/x")
+
+
+def test_ddg_parser_snippet_does_not_leak_and_late_uddg():
+    page = """
+<a class="result__a" href="//duckduckgo.com/l/?kh=-1&amp;uddg=https%3A%2F%2Ffirst.org">First (no snippet)</a>
+<a class="result__a" href="https://second.org/">Second</a>
+<a class="result__snippet" href="#">Belongs to second only.</a>
+"""
+    res = duckduckgo_engine(lambda u: page)("q", 5)
+    assert res[0]["url"] == "https://first.org"      # uddg after kh param
+    assert res[0]["snippet"] == ""                   # no theft from #2
+    assert "Belongs to second" in res[1]["snippet"]
