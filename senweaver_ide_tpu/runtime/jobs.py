@@ -37,10 +37,12 @@ class JobRunner:
                  make_session: Callable[..., "RolloutSession"],
                  train_state=None, model_config=None, mesh=None,
                  reward_override=None, pad_id: int = 0,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 apo=None, collector=None, engine=None):
         # Factory contract: make_session() for rollout episodes;
         # make_session(rules=[...]) for rule-scored eval sessions (the
-        # rules render into the session's APO prompt section).
+        # rules render into the session's APO prompt section);
+        # make_session(rules=..., thread_id=...) for the online loop.
         self.server = server
         self.make_session = make_session
         self.state = train_state
@@ -49,6 +51,12 @@ class JobRunner:
         self.reward_override = reward_override
         self.pad_id = pad_id
         self.max_len = max_len
+        # Online-improvement cycle dependencies (job type "online"):
+        # the APOService + shared collector (+ serving engine for
+        # weight publication).
+        self.apo = apo
+        self.collector = collector
+        self.engine = engine
         self._queue: "queue.Queue[Job]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -110,6 +118,8 @@ class JobRunner:
             return self._run_grpo(job, spec)
         if kind == "eval_rules":
             return self._run_eval_rules(spec)
+        if kind == "online":
+            return self._run_online(job, spec)
         raise ValueError(f"unknown job type {kind!r}")
 
     def _cancelled(self, job: Job) -> bool:
@@ -144,6 +154,42 @@ class JobRunner:
         return {"rounds_done": len(round_metrics),
                 "step": int(self.state.step),
                 "metrics": round_metrics}
+
+    def _run_online(self, job: Job, spec: Dict[str, Any]) -> Dict[str, Any]:
+        """The full improvement cycle as a control-plane job: GRPO weight
+        updates every round + the APO analyze/beam cycle on its gates
+        (training/online.py). Requires the runner to be built with
+        apo= and collector=."""
+        if self.state is None or self.model_config is None:
+            raise ValueError("runner was built without a train state")
+        if self.apo is None or self.collector is None:
+            raise ValueError("online jobs need apo= and collector= on "
+                             "the runner")
+        from ..training.online import OnlineImprovementLoop
+
+        loop = OnlineImprovementLoop(
+            self.state, self.model_config, self.mesh, self.make_session,
+            spec.get("tasks") or ["improve the workspace"],
+            apo=self.apo, collector=self.collector, engine=self.engine,
+            group_size=int(spec.get("group_size", 2)),
+            pad_id=self.pad_id, max_len=self.max_len,
+            ppo_epochs=int(spec.get("ppo_epochs", 1)),
+            reward_override=self.reward_override)
+        rounds = []
+        for _ in range(int(spec.get("rounds", 1))):
+            if self._cancelled(job):
+                break
+            r = loop.run_round()
+            rounds.append({"round": r.round_idx,
+                           "reward_mean": round(r.reward_mean, 4),
+                           "episodes": r.episodes,
+                           "rules_active": len(r.rules),
+                           "analyzed": r.analyzed,
+                           "beam_ran": r.beam_ran})
+        self.state = loop.state
+        return {"rounds_done": len(rounds), "step": int(self.state.step),
+                "optimized_rules": loop.current_rules(),
+                "rounds": rounds}
 
     def _run_eval_rules(self, spec: Dict[str, Any]) -> Dict[str, Any]:
         from ..apo.eval import evaluate_rules

@@ -76,7 +76,10 @@ def parse_bing_html(page: str, limit: int) -> List[Result]:
     for m in re.finditer(
             r'<li class="b_algo".*?<h2><a[^>]*href="([^"]+)"[^>]*>(.*?)'
             r"</a></h2>(.*?)</li>", page, re.S):
-        url, title, body = m.group(1), _clean(m.group(2)), m.group(3)
+        # hrefs are HTML-attribute-escaped; an un-unescaped '&amp;'
+        # breaks downstream fetches AND RRF dedup against other engines.
+        url = _html.unescape(m.group(1))
+        title, body = _clean(m.group(2)), m.group(3)
         sm = re.search(r"<p[^>]*>(.*?)</p>", body, re.S)
         out.append({"title": title, "url": url,
                     "snippet": _clean(sm.group(1))[:300] if sm else ""})

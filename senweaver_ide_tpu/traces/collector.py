@@ -277,6 +277,18 @@ class TraceCollector:
             self._dirty = False
             self._last_flush = time.time()
 
+    def get_active_trace(self, thread_id: str) -> Optional[Trace]:
+        """The thread's CURRENT trace (the one feedback would land on).
+
+        ``_active`` keeps pointing at the latest trace after
+        ``end_trace_for_thread`` by design — the reference records
+        post-turn user feedback against the finished conversation
+        (``:532-556``), and the online loop reads the same handle to
+        judge an episode just collected."""
+        with self._lock:
+            tid = self._active.get(thread_id)
+            return self._traces.get(tid) if tid else None
+
     # --- internals ---
 
     def _get_or_create(self, thread_id: str) -> Trace:

@@ -7,3 +7,4 @@ from .data import (Trajectory, TrajectoryDataset, make_batch,
 from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
 from .rl_loop import (EpisodeRecord, RoundResult,
                       collect_group_trajectories, grpo_round)
+from .online import OnlineImprovementLoop, OnlineRoundResult

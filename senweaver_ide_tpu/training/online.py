@@ -1,0 +1,183 @@
+"""The full online-improvement cycle: prompt search AND weight updates.
+
+This is the reference's auto-improvement premise assembled end to end —
+``apoService.ts`` ``_tryAutoAnalyze`` (:454-472) watches the trace
+corpus and, when its gates open, analyzes, requests a textual gradient,
+and beam-searches a better prompt; the TPU build ADDS the north-star
+upgrade alongside it: every round of collected episodes also takes a
+GRPO weight step and publishes the new params to the serving engine. One
+loop, both optimizers:
+
+    round N:
+      1. collect a GRPO group of episodes per task, with the CURRENT
+         optimized rules injected into every session's system prompt
+         (segments.get_optimized_rules — the applied-prompt state the
+         reference renders into its system message)
+      2. judge each episode with the outcome evaluator and record the
+         feedback on its trace (the corpus signal both optimizers gate
+         on: user-feedback reward dim + APO analysis thresholds)
+      3. GRPO update on the episodes' real sampled tokens; publish the
+         new weights to the engine (next round samples the new policy)
+      4. APO side: maybe_auto_analyze() (time/size gates); when the
+         corpus shows a low good-rate, run the prompt beam search —
+         next round's sessions inherit the winning rules
+
+The loop owns nothing heavy: caller supplies the session factory (which
+must accept ``rules=[...]``), the shared collector, the engine, and the
+train state — the same contract as ``runtime/jobs.py`` factories.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..apo.eval import outcome_feedback
+from ..apo.service import APOService
+from ..traces.collector import TraceCollector
+from .grpo import GRPOConfig
+from .rl_loop import grpo_round
+
+
+@dataclasses.dataclass
+class OnlineRoundResult:
+    round_idx: int
+    reward_mean: float
+    episodes: int
+    rules: List[str]            # rules ACTIVE during this round
+    analyzed: bool              # APO analysis ran this round
+    beam_ran: bool              # prompt search ran this round
+    train_metrics: Dict[str, float]
+
+
+class OnlineImprovementLoop:
+    """Couples grpo_round with the APO auto-analysis cycle."""
+
+    def __init__(self, state, model_config, mesh,
+                 make_session: Callable[..., "RolloutSession"],
+                 tasks: Sequence[str], *,
+                 apo: APOService,
+                 collector: TraceCollector,
+                 engine=None,
+                 group_size: int = 4,
+                 pad_id: int = 0,
+                 max_len: Optional[int] = None,
+                 grpo_config: GRPOConfig = GRPOConfig(),
+                 ppo_epochs: int = 1,
+                 max_parallel: int = 8,
+                 reward_override=None,
+                 feedback_fn=outcome_feedback,
+                 metrics_service=None):
+        self.state = state
+        self.model_config = model_config
+        self.mesh = mesh
+        self.make_session = make_session
+        self.tasks = list(tasks)
+        self.apo = apo
+        self.collector = collector
+        self.engine = engine
+        self.group_size = group_size
+        self.pad_id = pad_id
+        self.max_len = max_len
+        self.grpo_config = grpo_config
+        self.ppo_epochs = ppo_epochs
+        self.max_parallel = max_parallel
+        self.reward_override = reward_override
+        self.feedback_fn = feedback_fn
+        self.metrics_service = metrics_service
+        self._round = 0
+        # Atomic id source: sessions are created from the collection
+        # pool's worker threads (itertools.count.__next__ is atomic in
+        # CPython; a racy += would hand two episodes the same thread_id
+        # and cross-attribute their traces).
+        import itertools
+        self._session_ids = itertools.count(1)
+        # Factories that can't take thread_id force serial collection:
+        # concurrent sessions sharing the collector's default thread id
+        # would read each other's traces.
+        import inspect
+        try:
+            sig = inspect.signature(make_session)
+            self._factory_takes_thread_id = (
+                "thread_id" in sig.parameters
+                or any(p.kind is inspect.Parameter.VAR_KEYWORD
+                       for p in sig.parameters.values()))
+        except (TypeError, ValueError):
+            self._factory_takes_thread_id = False
+        if not self._factory_takes_thread_id and max_parallel > 1:
+            raise ValueError(
+                "session factory does not accept thread_id=; concurrent "
+                "collection (max_parallel > 1) would cross-attribute "
+                "episode traces — extend the factory or pass "
+                "max_parallel=1")
+
+    def current_rules(self) -> List[str]:
+        return self.apo.get_optimized_rules()
+
+    def _fresh_session(self, rules: List[str]):
+        """Factory call with a UNIQUE thread id — episodes share one
+        collector, so per-thread trace attribution needs distinct ids.
+        (Factories without thread_id support were rejected at
+        construction unless collection is serial.)"""
+        if not self._factory_takes_thread_id:
+            return self.make_session(rules=list(rules))
+        tid = f"online-r{self._round}-s{next(self._session_ids)}"
+        return self.make_session(rules=list(rules), thread_id=tid)
+
+    def run_round(self) -> OnlineRoundResult:
+        rules = self.current_rules()
+
+        def reward(ti, g, session):
+            # Judge the episode and RECORD the verdict on its trace —
+            # the feedback signal the reward head weights highest and
+            # the APO gates count. The trace reward (now including the
+            # feedback dim) or the caller's override scores the episode.
+            trace = self.collector.get_active_trace(session.thread_id)
+            if self.feedback_fn is not None and trace is not None:
+                fb = self.feedback_fn(trace)
+                if fb:
+                    session.record_feedback(fb)
+            if self.reward_override is not None:
+                return self.reward_override(ti, g, session)
+            return (trace.summary.final_reward or 0.0) \
+                if trace is not None else 0.0
+
+        out = grpo_round(
+            self.state, self.model_config, self.mesh,
+            lambda: self._fresh_session(rules), self.tasks,
+            group_size=self.group_size, pad_id=self.pad_id,
+            max_len=self.max_len, grpo_config=self.grpo_config,
+            ppo_epochs=self.ppo_epochs, max_parallel=self.max_parallel,
+            reward_override=reward,
+            metrics_service=self.metrics_service, engine=self.engine)
+        self.state = out.state
+        if self.engine is not None and hasattr(self.engine,
+                                               "update_params"):
+            self.engine.update_params(self.state.params)
+
+        # APO side of the cycle (the reference's timer tick, driven at
+        # round boundaries here): analysis when gates open; prompt beam
+        # search when the corpus shows a low good-rate.
+        report = self.apo.maybe_auto_analyze()
+        beam_ran = False
+        if report is not None and self.apo.should_auto_gradient() \
+                and self.apo.generate_fn is not None:
+            self.apo.run_beam_search()
+            beam_ran = True
+
+        ep_rewards = [e.reward for e in out.episodes]
+        result = OnlineRoundResult(
+            round_idx=self._round,
+            reward_mean=(sum(ep_rewards) / len(ep_rewards)
+                         if ep_rewards else 0.0),
+            episodes=len(out.episodes),
+            rules=rules,
+            analyzed=report is not None,
+            beam_ran=beam_ran,
+            train_metrics=dict(out.metrics))
+        self._round += 1
+        return result
+
+    def run(self, rounds: int) -> List[OnlineRoundResult]:
+        return [self.run_round() for _ in range(rounds)]

@@ -120,3 +120,11 @@ def test_ddg_parser_snippet_does_not_leak_and_late_uddg():
     assert res[0]["url"] == "https://first.org"      # uddg after kh param
     assert res[0]["snippet"] == ""                   # no theft from #2
     assert "Belongs to second" in res[1]["snippet"]
+
+
+def test_bing_parser_unescapes_hrefs():
+    page = ('<ol><li class="b_algo"><h2>'
+            '<a href="https://e.com/w?v=x&amp;t=10">T</a></h2>'
+            '<div><p>s</p></div></li></ol>')
+    res = bing_engine(lambda u: page)("q", 5)
+    assert res[0]["url"] == "https://e.com/w?v=x&t=10"
