@@ -174,6 +174,9 @@ class JobRunner:
             group_size=int(spec.get("group_size", 2)),
             pad_id=self.pad_id, max_len=self.max_len,
             ppo_epochs=int(spec.get("ppo_epochs", 1)),
+            # max_parallel=1 lets factories WITHOUT thread_id support run
+            # online jobs (serial collection is attribution-safe).
+            max_parallel=int(spec.get("max_parallel", 8)),
             reward_override=self.reward_override)
         rounds = []
         for _ in range(int(spec.get("rounds", 1))):

@@ -30,7 +30,7 @@ train state — the same contract as ``runtime/jobs.py`` factories.
 from __future__ import annotations
 
 import dataclasses
-import time
+import itertools
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..apo.eval import outcome_feedback
@@ -38,6 +38,9 @@ from ..apo.service import APOService
 from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
 from .rl_loop import grpo_round
+
+# Process-wide loop counter (see OnlineImprovementLoop._loop_id).
+_LOOP_IDS = itertools.count(1)
 
 
 @dataclasses.dataclass
@@ -90,8 +93,11 @@ class OnlineImprovementLoop:
         # Atomic id source: sessions are created from the collection
         # pool's worker threads (itertools.count.__next__ is atomic in
         # CPython; a racy += would hand two episodes the same thread_id
-        # and cross-attribute their traces).
-        import itertools
+        # and cross-attribute their traces). The loop instance id keeps
+        # thread ids unique ACROSS loops sharing one collector — two
+        # successive 'online' jobs must not collide on
+        # f"{thread_id}:{message_idx}" feedback keys.
+        self._loop_id = next(_LOOP_IDS)
         self._session_ids = itertools.count(1)
         # Factories that can't take thread_id force serial collection:
         # concurrent sessions sharing the collector's default thread id
@@ -122,7 +128,8 @@ class OnlineImprovementLoop:
         construction unless collection is serial.)"""
         if not self._factory_takes_thread_id:
             return self.make_session(rules=list(rules))
-        tid = f"online-r{self._round}-s{next(self._session_ids)}"
+        tid = (f"online{self._loop_id}-r{self._round}"
+               f"-s{next(self._session_ids)}")
         return self.make_session(rules=list(rules), thread_id=tid)
 
     def run_round(self) -> OnlineRoundResult:

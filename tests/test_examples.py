@@ -41,3 +41,9 @@ def test_dashboard_demo_example():
     r = _run("dashboard_demo.py", "--once")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "DASHBOARD STATE OK" in r.stdout
+
+
+def test_online_cycle_example():
+    r = _run("online_cycle.py", "--rounds", "2")
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ONLINE CYCLE OK" in r.stdout

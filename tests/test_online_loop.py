@@ -162,3 +162,20 @@ def test_online_loop_rejects_concurrent_without_thread_id(stack):
         OnlineImprovementLoop(state, cfg, None, legacy_factory, ["t"],
                               apo=apo, collector=collector,
                               max_parallel=8)
+
+
+def test_successive_loops_do_not_collide_on_thread_ids(stack):
+    """Two loops over ONE collector (successive 'online' jobs) must not
+    reuse thread ids — colliding f'{thread}:{idx}' feedback keys would
+    overwrite verdicts and freeze the APO gates."""
+    cfg, state, collector, apo, make_session = stack
+    kw = dict(apo=apo, collector=collector, group_size=2, max_len=1024,
+              max_parallel=1)
+    l1 = OnlineImprovementLoop(state, cfg, None, make_session, ["t"],
+                               **kw)
+    l1.run_round()
+    fb_after_first = collector.get_stats()["total_feedbacks"]
+    l2 = OnlineImprovementLoop(l1.state, cfg, None, make_session, ["t"],
+                               **kw)
+    l2.run_round()
+    assert collector.get_stats()["total_feedbacks"] > fb_after_first
