@@ -30,7 +30,7 @@ import time
 def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       group_size: int = 16, max_new_tokens: int = 16,
                       ppo_epochs: int = 2, seed: int = 0,
-                      window: int = 2) -> dict:
+                      window: int = 2, max_parallel: int = 8) -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -72,7 +72,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                          ["write plain ascii text"], group_size=group_size,
                          pad_id=tok.pad_id, max_len=2048,
                          grpo_config=GRPOConfig(kl_coef=0.0),
-                         ppo_epochs=ppo_epochs, max_parallel=8,
+                         ppo_epochs=ppo_epochs, max_parallel=max_parallel,
                          reward_override=reward)
         state = out.state
         # Publish the updated weights to the serving engine — the same

@@ -174,8 +174,11 @@ class JobRunner:
             group_size=int(spec.get("group_size", 2)),
             pad_id=self.pad_id, max_len=self.max_len,
             ppo_epochs=int(spec.get("ppo_epochs", 1)),
-            # max_parallel=1 lets factories WITHOUT thread_id support run
-            # online jobs (serial collection is attribution-safe).
+            # Default is concurrent collection (requires a thread_id-
+            # aware factory); a runner built on the legacy rules-only
+            # factory contract must SUBMIT {"max_parallel": 1} — serial
+            # collection is the attribution-safe mode the loop accepts
+            # for such factories.
             max_parallel=int(spec.get("max_parallel", 8)),
             reward_override=self.reward_override)
         rounds = []

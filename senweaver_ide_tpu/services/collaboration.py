@@ -160,6 +160,12 @@ class CollabCoordinator:
         except json.JSONDecodeError:
             self._reply(conn, {"type": "error", "error": "bad json"})
             return part
+        if not isinstance(req, dict):
+            # Valid JSON, wrong shape (e.g. a bare number): without this
+            # guard the .get below raised and killed the CONNECTION
+            # thread (found by the adversarial frame test).
+            self._reply(conn, {"type": "error", "error": "bad request"})
+            return part
         rid = req.get("id")
         op = req.get("op", "")
         pid = req.get("client_id", "")

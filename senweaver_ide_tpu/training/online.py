@@ -39,7 +39,13 @@ from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
 from .rl_loop import grpo_round
 
-# Process-wide loop counter (see OnlineImprovementLoop._loop_id).
+# Loop-id source (see OnlineImprovementLoop._loop_id): a process-unique
+# tag + counter. The tag matters for WAL-persisted collectors — feedback
+# keys f"{thread_id}:{message_idx}" survive restarts, and a bare counter
+# restarting at 1 would overwrite a previous process's verdicts.
+import uuid
+
+_PROC_TAG = uuid.uuid4().hex[:6]
 _LOOP_IDS = itertools.count(1)
 
 
@@ -128,7 +134,7 @@ class OnlineImprovementLoop:
         construction unless collection is serial.)"""
         if not self._factory_takes_thread_id:
             return self.make_session(rules=list(rules))
-        tid = (f"online{self._loop_id}-r{self._round}"
+        tid = (f"online-{_PROC_TAG}-{self._loop_id}-r{self._round}"
                f"-s{next(self._session_ids)}")
         return self.make_session(rules=list(rules), thread_id=tid)
 

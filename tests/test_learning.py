@@ -13,9 +13,13 @@ from eval_learning import run_learning_eval
 
 
 def test_grpo_learning_curve_rises():
+    # max_parallel=1: serial collection makes the engine's sample
+    # streams DETERMINISTIC (concurrent episodes race for slots and
+    # reorder the RNG stream — one full-suite run drew a curve ending
+    # 0.296 vs the 0.3 bar). One CPU core means serial costs nothing.
     report = run_learning_eval(rounds=6, lr=0.02, group_size=12,
                                max_new_tokens=12, ppo_epochs=2, seed=0,
-                               window=2)
+                               window=2, max_parallel=1)
     assert len(report["curve"]) == 6
     # Decisive: from ~-0.5 (random ~25% base rate) to near the +1 cap.
     assert report["reward_final"] > report["reward_initial"] + 0.5, report
