@@ -30,7 +30,8 @@ import time
 def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       group_size: int = 16, max_new_tokens: int = 16,
                       ppo_epochs: int = 2, seed: int = 0,
-                      window: int = 2, max_parallel: int = 8) -> dict:
+                      window: int = 2, max_parallel: int = 8,
+                      contextual: bool = False) -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -58,18 +59,33 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         return RolloutSession(client, f"{workdir}/ws",
                               include_tool_definitions=False)
 
+    # Contextual mode: two tasks with CONTRASTIVE target classes (low
+    # vs high byte half, 25% base rate each, mutually exclusive) — the
+    # policy must CONDITION on the prompt, not just learn a global
+    # emission bias. Group advantages are per task, so each task pushes
+    # its own class; early rounds see-saw between unconditional biases
+    # before the routing separates.
+    if contextual:
+        tasks = ["write plain ascii text", "write binary bytes"]
+        classes = [set(range(0, 128)), set(range(128, 256))]
+    else:
+        tasks = ["write plain ascii text"]
+        classes = [set(range(0, 128))]
+
     def reward(task_idx, g, session):
         out_ids = session.client.call_log[-1][1]
         if not out_ids:
             return -1.0
-        frac = sum(1 for t in out_ids if t < 128) / len(out_ids)
+        frac = sum(1 for t in out_ids
+                   if t in classes[task_idx]) / len(out_ids)
         return 2.0 * frac - 1.0
 
     curve = []
+    per_task = []
     t0 = time.monotonic()
     for r in range(rounds):
-        out = grpo_round(state, config, None, make_session,
-                         ["write plain ascii text"], group_size=group_size,
+        out = grpo_round(state, config, None, make_session, tasks,
+                         group_size=group_size,
                          pad_id=tok.pad_id, max_len=2048,
                          grpo_config=GRPOConfig(kl_coef=0.0),
                          ppo_epochs=ppo_epochs, max_parallel=max_parallel,
@@ -79,14 +95,18 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         # actor/learner weight sync the async trainer does at round
         # boundaries; without it every round samples the initial policy.
         engine.update_params(state.params)
-        curve.append(round(sum(e.reward for e in out.episodes)
-                           / max(len(out.episodes), 1), 4))
+        by_task = [[e.reward for e in out.episodes if e.task_idx == i]
+                   for i in range(len(tasks))]
+        means = [sum(v) / max(len(v), 1) for v in by_task]
+        curve.append(round(sum(means) / len(means), 4))
+        per_task.append([round(m, 4) for m in means])
 
     w = max(1, min(window, len(curve) // 2))
     initial = sum(curve[:w]) / w
     final = sum(curve[-w:]) / w
-    return {
-        "metric": "grpo_reward_curve[tiny-test,ascii-task]",
+    name = "contextual-2task" if contextual else "ascii-task"
+    report = {
+        "metric": f"grpo_reward_curve[tiny-test,{name}]",
         "rounds": rounds,
         "curve": curve,
         "reward_initial": round(initial, 4),
@@ -95,9 +115,24 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         "learned": bool(final > initial + 0.5),
         "config": {"lr": lr, "group_size": group_size,
                    "max_new_tokens": max_new_tokens,
-                   "ppo_epochs": ppo_epochs, "seed": seed},
+                   "ppo_epochs": ppo_epochs, "seed": seed,
+                   "contextual": contextual},
         "wall_s": round(time.monotonic() - t0, 1),
     }
+    if contextual:
+        report["per_task_curve"] = per_task
+        # Conditioning proof: BOTH contrastive tasks end above their
+        # start — a global bias can only raise one at the other's
+        # expense (they partition the byte space). Window-averaged like
+        # reward_initial/final (a single noisy round must not flip the
+        # headline flag).
+        def _task_mean(rows, i):
+            return sum(r[i] for r in rows) / len(rows)
+
+        report["both_tasks_improved"] = bool(all(
+            _task_mean(per_task[-w:], i) > _task_mean(per_task[:w], i) + 0.3
+            for i in range(len(tasks))))
+    return report
 
 
 def main() -> None:
@@ -108,6 +143,9 @@ def main() -> None:
     ap.add_argument("--max-new-tokens", type=int, default=16)
     ap.add_argument("--ppo-epochs", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--contextual", action="store_true",
+                    help="two contrastive tasks: the policy must learn "
+                         "prompt-CONDITIONAL emission, not a global bias")
     args = ap.parse_args()
 
     # Tiny-model rounds are CPU-sized; force CPU via the live config so a
@@ -119,7 +157,8 @@ def main() -> None:
     report = run_learning_eval(rounds=args.rounds, lr=args.lr,
                                group_size=args.group_size,
                                max_new_tokens=args.max_new_tokens,
-                               ppo_epochs=args.ppo_epochs, seed=args.seed)
+                               ppo_epochs=args.ppo_epochs, seed=args.seed,
+                               contextual=args.contextual)
     print(json.dumps(report))
 
 

@@ -89,10 +89,21 @@ def grpo_objective(
         kl_per_tok = jnp.exp(log_ratio) - log_ratio - 1.0
         kl = jnp.sum(kl_per_tok * mask) / denom
 
-    loss = pg_loss + config.kl_coef * kl
+    # Entropy bonus via the sampled-surprisal estimator E[-log p(x)] = H:
+    # the objective only sees target logps (full logits never reach it),
+    # so the bonus is a -logp penalty on sampled tokens — anti-collapse
+    # pressure that keeps exploration alive when a group's rewards go
+    # uniform (zero advantage) and nothing else pushes back. Exact only
+    # in expectation (the score-function term of ∇H is dropped), which
+    # is the standard confidence-penalty regularizer trade.
+    entropy = -jnp.sum(logp * mask) / denom
+
+    loss = (pg_loss + config.kl_coef * kl
+            - config.entropy_coef * entropy)
     metrics = {
         "pg_loss": pg_loss,
         "kl": kl,
+        "entropy": entropy,
         "ratio_mean": jnp.sum(ratio * mask) / denom,
         "clip_frac": jnp.sum((jnp.abs(ratio - 1.0) > config.clip_eps) * mask)
         / denom,

@@ -181,7 +181,7 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     # Same metrics schema as the monolithic step: every per-token-
     # normalized metric weight-sums across microbatches exactly like the
     # loss does.
-    acc_keys = ("pg_loss", "kl", "ratio_mean", "clip_frac")
+    acc_keys = ("pg_loss", "kl", "entropy", "ratio_mean", "clip_frac")
 
     def body(carry, m):
         grads_acc, loss_acc, metr_acc = carry

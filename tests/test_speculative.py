@@ -162,3 +162,15 @@ def test_online_draft_learning_raises_acceptance(rng):
     new_acc = dec.acceptance_rate
     assert new_out == base_out                     # exactness invariant
     assert new_acc > base_acc + 0.1, (base_acc, new_acc)
+
+
+def test_eval_speculative_script_reports_gain():
+    """The driver-runnable artifact path (eval_speculative.py) must
+    produce a positive acceptance gain with exact outputs."""
+    from eval_speculative import run_speculative_eval
+
+    report = run_speculative_eval(n_prompts=4, max_new_tokens=8, k=4,
+                                  distill_steps=40, seed=0)
+    assert report["outputs_exact"] is True
+    assert report["gain"] > 0.2, report
+    assert report["verify_rounds_after"] < report["verify_rounds_before"]
