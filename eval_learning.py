@@ -80,6 +80,13 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                    if t in classes[task_idx]) / len(out_ids)
         return 2.0 * frac - 1.0
 
+    # Contextual mode NEEDS the entropy bonus: without it the policy
+    # collapses into one task's unconditional bias, the starved task's
+    # rewards go uniform, and its advantage signal vanishes (observed;
+    # see ROUND3_NOTES.md §16).
+    gcfg = GRPOConfig(kl_coef=0.0,
+                      entropy_coef=0.02 if contextual else 0.0)
+
     curve = []
     per_task = []
     t0 = time.monotonic()
@@ -87,7 +94,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         out = grpo_round(state, config, None, make_session, tasks,
                          group_size=group_size,
                          pad_id=tok.pad_id, max_len=2048,
-                         grpo_config=GRPOConfig(kl_coef=0.0),
+                         grpo_config=gcfg,
                          ppo_epochs=ppo_epochs, max_parallel=max_parallel,
                          reward_override=reward)
         state = out.state
