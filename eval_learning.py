@@ -31,7 +31,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       group_size: int = 16, max_new_tokens: int = 16,
                       ppo_epochs: int = 2, seed: int = 0,
                       window: int = 2, max_parallel: int = 8,
-                      contextual: bool = False) -> dict:
+                      contextual: bool = False,
+                      model: str = "tiny-test") -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -41,7 +42,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
     from senweaver_ide_tpu.training import grpo_round, make_train_state
     from senweaver_ide_tpu.training.grpo import GRPOConfig
 
-    config = get_config("tiny-test")
+    config = get_config(model)
     state = make_train_state(config, jax.random.PRNGKey(seed), None,
                              learning_rate=lr)
     tok = ByteTokenizer()
@@ -113,7 +114,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
     final = sum(curve[-w:]) / w
     name = "contextual-2task" if contextual else "ascii-task"
     report = {
-        "metric": f"grpo_reward_curve[tiny-test,{name}]",
+        "metric": f"grpo_reward_curve[{model},{name}]",
         "rounds": rounds,
         "curve": curve,
         "reward_initial": round(initial, 4),
@@ -123,7 +124,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         "config": {"lr": lr, "group_size": group_size,
                    "max_new_tokens": max_new_tokens,
                    "ppo_epochs": ppo_epochs, "seed": seed,
-                   "contextual": contextual},
+                   "contextual": contextual, "model": model},
         "wall_s": round(time.monotonic() - t0, 1),
     }
     if contextual:
@@ -153,19 +154,28 @@ def main() -> None:
     ap.add_argument("--contextual", action="store_true",
                     help="two contrastive tasks: the policy must learn "
                          "prompt-CONDITIONAL emission, not a global bias")
+    ap.add_argument("--model", default="tiny-test",
+                    help="model preset (small-test for the contextual "
+                         "capacity run)")
+    ap.add_argument("--accel", action="store_true",
+                    help="run on the accelerator instead of forcing CPU "
+                         "(only with a healthy tunnel; probe first)")
     args = ap.parse_args()
 
     # Tiny-model rounds are CPU-sized; force CPU via the live config so a
     # wedged accelerator tunnel can't hang backend init (same posture as
-    # eval_uplift.py's scripted path).
+    # eval_uplift.py's scripted path). --accel opts into the real chip
+    # for the capacity runs that need it.
     import jax
-    jax.config.update("jax_platforms", "cpu")
+    if not args.accel:
+        jax.config.update("jax_platforms", "cpu")
 
     report = run_learning_eval(rounds=args.rounds, lr=args.lr,
                                group_size=args.group_size,
                                max_new_tokens=args.max_new_tokens,
                                ppo_epochs=args.ppo_epochs, seed=args.seed,
-                               contextual=args.contextual)
+                               contextual=args.contextual,
+                               model=args.model)
     print(json.dumps(report))
 
 

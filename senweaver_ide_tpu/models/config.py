@@ -17,6 +17,18 @@ import jax.numpy as jnp
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3-style NTK-by-parts RoPE scaling (HF ``rope_type: llama3``).
+
+    Frozen (hashable) because ModelConfig rides jit static args. Fields
+    mirror the HF ``rope_scaling`` dict of Llama-3.1+ checkpoints."""
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class ModelConfig:
     name: str
     vocab_size: int
@@ -28,6 +40,8 @@ class ModelConfig:
     head_dim: int
     max_seq_len: int
     rope_theta: float = 10000.0
+    # Llama-3.1+ long-context frequency scaling; None = plain RoPE.
+    rope_scaling: Optional[RopeScaling] = None
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     qkv_bias: bool = False
@@ -191,6 +205,40 @@ def tiny_test() -> ModelConfig:
         dtype=jnp.float32, matmul_precision="highest")
 
 
+def llama_3_2_1b() -> ModelConfig:
+    """Llama-3.2-1B: GQA, tied embeddings, llama3 RoPE scaling (the
+    128k-context serving config of an 8k-trained base)."""
+    return ModelConfig(
+        name="llama-3.2-1b", vocab_size=128_256, hidden_size=2048,
+        intermediate_size=8192, num_layers=16, num_heads=32, num_kv_heads=8,
+        head_dim=64, max_seq_len=131_072, rope_theta=500_000.0,
+        rope_scaling=RopeScaling(factor=32.0), rms_norm_eps=1e-5,
+        tie_word_embeddings=True)
+
+
+def llama_3_1_8b() -> ModelConfig:
+    """Llama-3.1-8B: the 7B-class member of the Llama family ladder."""
+    return ModelConfig(
+        name="llama-3.1-8b", vocab_size=128_256, hidden_size=4096,
+        intermediate_size=14_336, num_layers=32, num_heads=32,
+        num_kv_heads=8, head_dim=128, max_seq_len=131_072,
+        rope_theta=500_000.0, rope_scaling=RopeScaling(factor=8.0),
+        rms_norm_eps=1e-5)
+
+
+def small_test() -> ModelConfig:
+    """Between tiny-test and the real presets: enough capacity for
+    prompt-CONDITIONAL behavior (the contextual learning eval needs the
+    task tokens, buried in an ~1.8k-token prompt, to actually route the
+    output distribution — tiny-test's 2×d64 could not; see
+    ROUND3_NOTES.md §16), still seconds-per-round on one chip."""
+    return ModelConfig(
+        name="small-test", vocab_size=512, hidden_size=128,
+        intermediate_size=384, num_layers=4, num_heads=8, num_kv_heads=4,
+        head_dim=32, max_seq_len=4096, qkv_bias=True,
+        dtype=jnp.float32, matmul_precision="highest")
+
+
 PRESETS = {
     "qwen2.5-coder-0.5b": qwen2_5_coder_0_5b,
     "qwen2.5-coder-1.5b": qwen2_5_coder_1_5b,
@@ -199,8 +247,11 @@ PRESETS = {
     "mixtral-8x7b": mixtral_8x7b,
     "deepseek-coder-1.3b": deepseek_coder_1_3b,
     "deepseek-coder-6.7b": deepseek_coder_6_7b,
+    "llama-3.2-1b": llama_3_2_1b,
+    "llama-3.1-8b": llama_3_1_8b,
     "tiny-test": tiny_test,
     "tiny-moe-test": tiny_moe_test,
+    "small-test": small_test,
 }
 
 

@@ -170,6 +170,14 @@ def export_hf_params(params: Params, config: ModelConfig,
     be served by any HF-ecosystem runtime)."""
     from safetensors.numpy import save_file
 
+    from .quantize import is_quantized
+
+    if is_quantized(params):
+        # transposing the +/-127 codes without their scales would write a
+        # garbage checkpoint that loads cleanly elsewhere
+        raise TypeError("export_hf_params received int8-quantized params "
+                        "(models/quantize.py is a serving transform); "
+                        "export the full-precision train-state params")
     c = config
     os.makedirs(out_dir, exist_ok=True)
     lp = params["layers"]

@@ -1,0 +1,73 @@
+"""Weight-only int8 quantization for serving.
+
+Decode on one v5e chip is weight-HBM-bound (BENCH_NOTES.md: 2116
+tok/s/chip for the 1.5B ≈ the bf16 roofline 819 GB/s ÷ 3.1 GB). Storing
+the dense matmul weights as int8 with one fp32 scale per OUTPUT channel
+(absmax over the contraction axis) halves the bytes every decode step
+must stream, raising the bandwidth ceiling ~2× at <1% relative logit
+error; the MXU still computes in the activation dtype (the int8→bf16
+upcast happens at tile load, the scale is a fused output epilogue — see
+``transformer._dense``).
+
+Scope: the seven stacked per-layer dense matrices + ``lm_head``.
+Excluded on purpose:
+  - norms/biases (tiny, precision-critical),
+  - ``embed`` (a gather, not a matmul; tied-head quality is sensitive),
+  - MoE expert banks (4-D; routed access patterns want their own
+    per-expert treatment — future work).
+
+This is a SERVING transform: quantized params are not differentiable
+and must never enter ``train_step``. The actor/learner bridge
+(``RolloutEngine.update_params``) re-applies it on publish when the
+engine was built with quantized weights.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+# Stacked (L, in, out) layer matrices + the 2-D head; in all of them the
+# contraction axis is -2, so per-output-channel absmax is over axis=-2.
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def _quantize_matrix(w: jax.Array):
+    """(…, in, out) → int8 values + fp32 (…, out) per-channel scales."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_weights_int8(params: Dict) -> Dict:
+    """Return a new param pytree with dense weights int8-quantized.
+
+    Idempotent (already-int8 tensors pass through); MoE banks (ndim 4)
+    and anything outside QUANTIZABLE are left untouched."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    for name in QUANTIZABLE:
+        w = layers.get(name)
+        if w is None or w.dtype == jnp.int8 or w.ndim != 3:
+            continue
+        layers[name], layers[name + "_scale"] = _quantize_matrix(w)
+    out["layers"] = layers
+    head = params.get("lm_head")
+    if head is not None and head.dtype != jnp.int8:
+        out["lm_head"], out["lm_head_scale"] = _quantize_matrix(head)
+    return out
+
+
+def is_quantized(params: Dict) -> bool:
+    w = params.get("layers", {}).get("wq")
+    return w is not None and w.dtype == jnp.int8
+
+
+def quantized_bytes(params: Dict) -> int:
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))

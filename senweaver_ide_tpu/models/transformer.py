@@ -156,13 +156,31 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
     return params
 
 
+def _dense(h: jax.Array, lp: Dict[str, jax.Array], name: str,
+           spec: str) -> jax.Array:
+    """``einsum(spec, h, lp[name])`` with transparent weight-only int8.
+
+    When the stored weight is int8 (see ``models.quantize``), the matmul
+    upcasts it in-compute and applies the per-output-channel scale to the
+    (much smaller) output. Decode is weight-HBM-bound (BENCH_NOTES.md
+    roofline: 2116 tok/s ≈ the bf16 bandwidth ceiling), so halving the
+    bytes each step streams is the one remaining 2×-class lever; the
+    scale multiply is an elementwise epilogue XLA fuses into the dot."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        out = jnp.einsum(spec, h, w.astype(h.dtype))
+        return (out.astype(jnp.float32)
+                * lp[name + "_scale"]).astype(h.dtype)
+    return jnp.einsum(spec, h, w)
+
+
 def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
          cos: jax.Array, sin: jax.Array):
     """Project + rotate. h: (B, S, D) → q (B,S,Hq,Dh), k/v (B,S,Hkv,Dh)."""
     b, s, _ = h.shape
-    q = jnp.einsum("bsd,de->bse", h, lp["wq"])
-    k = jnp.einsum("bsd,de->bse", h, lp["wk"])
-    v = jnp.einsum("bsd,de->bse", h, lp["wv"])
+    q = _dense(h, lp, "wq", "bsd,de->bse")
+    k = _dense(h, lp, "wk", "bsd,de->bse")
+    v = _dense(h, lp, "wv", "bsd,de->bse")
     if c.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = apply_rope(q.reshape(b, s, c.num_heads, c.head_dim), cos, sin)
@@ -346,7 +364,7 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
         out = _self_attention(c, q, k, v, kv_mask, mesh)
         kv_out = (k, v)
 
-    x = x + jnp.einsum("bse,ed->bsd", out.reshape(b, s, c.q_dim), lp["wo"])
+    x = x + _dense(out.reshape(b, s, c.q_dim), lp, "wo", "bse,ed->bsd")
 
     h = rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
     if c.num_experts > 0:
@@ -361,10 +379,10 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                       "w_up": lp["w_up"], "w_down": lp["w_down"]}
         ffn_out, aux = moe_ffn(moe_params, moe_cfg, h)
         return x + ffn_out, kv_out, aux
-    gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
+    gate = _dense(h, lp, "w_gate", "bsd,df->bsf")
+    up = _dense(h, lp, "w_up", "bsd,df->bsf")
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    return (x + jnp.einsum("bsf,fd->bsd", act, lp["w_down"]), kv_out,
+    return (x + _dense(act, lp, "w_down", "bsf,fd->bsd"), kv_out,
             jnp.zeros((), jnp.float32))
 
 
@@ -419,7 +437,8 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
             base = base[:, None]                       # per-slot lengths
         positions = base + jnp.arange(s, dtype=jnp.int32)[None, :]
         positions = jnp.broadcast_to(positions, (b, s))
-    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta,
+                            scaling=c.rope_scaling)
 
     if cache is None:
         def one_layer(x, lp, cos, sin):
@@ -578,7 +597,7 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
     if head is None:  # tied embeddings
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, head)
+        logits = _dense(x, params, "lm_head", "bsd,dv->bsv")
     return logits.astype(jnp.float32), new_cache, aux_total
 
 

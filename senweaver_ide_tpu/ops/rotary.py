@@ -6,7 +6,8 @@ bf16 phase accumulation visibly degrades long-context quality on TPU.
 
 from __future__ import annotations
 
-from typing import Tuple
+import math
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -17,10 +18,40 @@ def rope_frequencies(head_dim: int, theta: float = 10000.0) -> jnp.ndarray:
     return 1.0 / (theta ** exponents)
 
 
+def scale_frequencies_llama3(inv_freq: jnp.ndarray, *, factor: float,
+                             low_freq_factor: float, high_freq_factor: float,
+                             original_max_position: int) -> jnp.ndarray:
+    """Llama-3 NTK-by-parts frequency scaling (HF ``rope_type: llama3``).
+
+    Long-wavelength components (period > original_max_position /
+    low_freq_factor) are slowed by ``factor`` — they are the ones that
+    would wrap past the original training window; short wavelengths
+    (period < original / high_freq_factor) are left untouched; the band
+    between interpolates linearly in 1/wavelength. This is what lets
+    Llama-3.1/3.2 checkpoints serve 128k contexts from an 8k-trained
+    base."""
+    wavelen = 2.0 * math.pi / inv_freq
+    smooth = ((original_max_position / wavelen) - low_freq_factor) / (
+        high_freq_factor - low_freq_factor)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    return (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+
+
 def rope_cos_sin(positions: jnp.ndarray, head_dim: int,
-                 theta: float = 10000.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """cos/sin tables for integer ``positions`` of any shape → (..., head_dim/2)."""
+                 theta: float = 10000.0,
+                 scaling: Optional[object] = None,
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer ``positions`` of any shape → (..., head_dim/2).
+
+    ``scaling``: a ``models.config.RopeScaling`` (or any object with its
+    fields) enabling Llama-3-style frequency scaling."""
     inv_freq = rope_frequencies(head_dim, theta)
+    if scaling is not None:
+        inv_freq = scale_frequencies_llama3(
+            inv_freq, factor=scaling.factor,
+            low_freq_factor=scaling.low_freq_factor,
+            high_freq_factor=scaling.high_freq_factor,
+            original_max_position=scaling.original_max_position)
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
 

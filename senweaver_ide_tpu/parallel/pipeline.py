@@ -35,6 +35,13 @@ def split_layers_for_stages(params: Params, n_stages: int) -> Params:
     """Reshape stacked layer leaves (L, ...) → (n_stages, L//n_stages, ...).
 
     The leading stage axis is what gets sharded over 'pp'."""
+    from ..models.quantize import is_quantized
+    if is_quantized(params):
+        # the stage bodies einsum lp["wq"] directly (no _dense dequant);
+        # int8 would silently promote unscaled — refuse up front
+        raise TypeError("pipeline stages do not support int8-quantized "
+                        "params (models/quantize.py is a serving-path "
+                        "transform); pass full-precision params")
     L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
     if L % n_stages != 0:
         raise ValueError(f"num_layers {L} not divisible by {n_stages} "
@@ -78,7 +85,8 @@ def pipeline_forward(params: Params, config: ModelConfig,
     x = params["embed"][tokens]                          # (B, S, D)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                  (b, s))
-    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta,
+                            scaling=c.rope_scaling)
     mb_x = x.reshape(M, mb, s, c.hidden_size)
     mb_cos = cos.reshape(M, mb, *cos.shape[1:])
     mb_sin = sin.reshape(M, mb, *sin.shape[1:])
@@ -202,7 +210,8 @@ def pipeline_train_grads_1f1b(params: Params, config: ModelConfig,
     x = params["embed"][inputs]                       # (B, S, D)
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                  (mb, s))
-    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta)
+    cos, sin = rope_cos_sin(positions, c.head_dim, c.rope_theta,
+                            scaling=c.rope_scaling)
 
     mb_x = x.reshape(M, mb, s, c.hidden_size)
     mb_tok = inputs.reshape(M, mb, s)

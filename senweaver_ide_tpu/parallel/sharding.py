@@ -43,6 +43,17 @@ PARAM_SPECS: Dict[str, P] = {
     "layers/w_up": P(None, "fsdp", "tp"),
     "layers/w_down": P(None, "tp", "fsdp"),
     "layers/router": P(None, "fsdp", None),
+    # int8 weight-only serving (models/quantize.py): per-output-channel
+    # scales shard like their weight's OUTPUT axis, so the epilogue
+    # multiply stays local to the shard that produced the output tile.
+    "lm_head_scale": P("tp"),
+    "layers/wq_scale": P(None, "tp"),
+    "layers/wk_scale": P(None, "tp"),
+    "layers/wv_scale": P(None, "tp"),
+    "layers/wo_scale": P(None, "fsdp"),
+    "layers/w_gate_scale": P(None, "tp"),
+    "layers/w_up_scale": P(None, "tp"),
+    "layers/w_down_scale": P(None, "fsdp"),
 }
 
 # MoE variants: expert banks carry an extra (E,) axis after the layer

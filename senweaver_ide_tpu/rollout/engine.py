@@ -347,7 +347,15 @@ class RolloutEngine:
         Registered prefixes are DROPPED: their KV was computed by the
         old policy and would silently mix policies if reused. Clients
         holding a prefix_id get a KeyError on next use and re-register
-        (EnginePolicyClient does this automatically)."""
+        (EnginePolicyClient does this automatically).
+
+        If the engine is serving int8-quantized weights
+        (``models.quantize``), the trainer's full-precision publish is
+        re-quantized here — the actor/learner bridge keeps the serving
+        representation stable across weight syncs."""
+        from ..models.quantize import is_quantized, quantize_weights_int8
+        if is_quantized(self.params) and not is_quantized(params):
+            params = quantize_weights_int8(params)
         with self._lock:
             self.params = self._place_params(params)
             self._prefixes.clear()
@@ -469,8 +477,11 @@ class RolloutEngine:
     def stats(self) -> Dict[str, int]:
         """Serving counters: prefill volume, prefix/continuation reuse,
         decode throughput inputs, hold evictions."""
+        from ..models.quantize import is_quantized
         with self._lock:
-            return dict(self._stats)
+            out = dict(self._stats)
+            out["weight_quant"] = int(is_quantized(self.params))
+            return out
 
     def result(self, rid: int) -> List[int]:
         with self._lock:

@@ -14,6 +14,7 @@ from .extensions import (ExtensionServer, ExtensionServerError,
 from .metrics import MetricsService, load_jsonl_metrics
 from .model_refresh import (CustomApiService, RefreshModelService,
                             fetch_model_list)
+from .onboarding import OnboardingService, install_onboarding_channel
 from .perf_monitor import (DEFAULT_THRESHOLDS_MS, PerformanceMonitor,
                            profile_capture)
 from .scm import GitRepo, SCMService, extract_commit_message
@@ -27,6 +28,7 @@ __all__ = [
     "DashboardService",
     "ExtensionToolRegistry", "MetricsService", "load_jsonl_metrics",
     "CustomApiService", "RefreshModelService", "fetch_model_list",
+    "OnboardingService", "install_onboarding_channel",
     "GitRepo", "SCMService", "extract_commit_message",
     "SkillInfo", "SkillService",
 ]

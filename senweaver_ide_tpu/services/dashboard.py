@@ -59,12 +59,13 @@ class DashboardService:
 
     def __init__(self, *, collector=None, apo=None, engine=None,
                  control=None, metrics_path: Optional[str] = None,
-                 title: str = "senweaver-tpu trainer"):
+                 onboarding=None, title: str = "senweaver-tpu trainer"):
         self.collector = collector
         self.apo = apo
         self.engine = engine
         self.control = control
         self.metrics_path = metrics_path
+        self.onboarding = onboarding
         self.title = title
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -110,6 +111,11 @@ class DashboardService:
                 out["jobs"] = self.control.list_jobs()
             except Exception as e:
                 out["jobs"] = [{"error": str(e)}]
+        if self.onboarding is not None:
+            try:
+                out["onboarding"] = self.onboarding.status()
+            except Exception as e:
+                out["onboarding"] = {"error": str(e)}
         out["training"] = _training_curves(self.metrics_path)
         return out
 
@@ -218,6 +224,7 @@ tr { border-bottom: 1px solid var(--border); }
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2><div id="apo"></div></section>
 <section><h2>Jobs</h2><div id="jobs"></div></section>
+<section><h2>Setup</h2><div id="onboarding"></div></section>
 </main>
 <script>
 "use strict";
@@ -357,6 +364,16 @@ async function refresh() {
       [j.job_id, statusSpan(j.status),
        new Date(j.submitted_at * 1000).toLocaleTimeString()]),
     ["job", "status", "submitted"]);
+  const ob = s.onboarding;
+  document.getElementById("onboarding").innerHTML = !ob ? "" :
+    ob.error ? `<p>onboarding source error: ${esc(ob.error)}</p>` :
+    (ob.complete ? "<p>onboarding complete</p>"
+                 : `<p>current step: <b>${esc(ob.current)}</b> — ` +
+                   `${esc(ob.prompt || "")}</p>`) +
+    table((ob.steps || []).map(st =>
+      [st.name, st.done ? "done" : (st.optional ? "optional" : "pending"),
+       String((ob.answers || {})[st.name] ?? "")]),
+      ["step", "state", "answer"]);
 }
 refresh();
 setInterval(refresh, 2500);

@@ -241,6 +241,13 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
     Optimizer resolution: an explicit ``optimizer`` wins, else the
     transformation the state was BUILT with (``state.opt``), else the
     module default — never a silent mismatch with the opt_state."""
+    from ..models.quantize import is_quantized
+    if is_quantized(state.params):
+        # einsum would silently promote unscaled int8 → garbage grads
+        raise TypeError(
+            "train_step received int8-quantized params "
+            "(models/quantize.py) — quantization is a SERVING transform; "
+            "train on the full-precision state and publish quantized")
     opt = optimizer or state.opt or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,

@@ -110,3 +110,17 @@ def test_sources_are_optional_and_errors_contained(tmp_path):
     assert s["traces"]["error"] == "boom"
     assert s["training"]["rounds"] == []
     json.dumps(s)
+
+
+def test_onboarding_panel_in_state(tmp_path):
+    from senweaver_ide_tpu.services.config import RuntimeConfig
+    from senweaver_ide_tpu.services.onboarding import OnboardingService
+    ob = OnboardingService(RuntimeConfig(),
+                           state_path=str(tmp_path / "ob.json"),
+                           accelerator_probe=lambda: False)
+    ob.answer("workspace", str(tmp_path / "ws"))
+    dash = DashboardService(onboarding=ob)
+    s = dash.state()
+    assert s["onboarding"]["current"] == "model"
+    assert s["onboarding"]["steps"][0]["done"] is True
+    json.dumps(s)

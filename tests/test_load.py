@@ -152,6 +152,34 @@ def test_parity_vs_transformers_llama(tmp_path):
     _hf_parity(tmp_path, model, our_cfg, 512)
 
 
+def test_parity_vs_transformers_llama3_rope_scaling(tmp_path):
+    """Llama-3.1-style checkpoints: our RopeScaling (NTK-by-parts) must
+    match transformers' llama3 rope_type bit-for-bit at fp32 tolerance —
+    this pins the frequency-band formula, not just the plain RoPE path."""
+    transformers = pytest.importorskip("transformers")
+    from senweaver_ide_tpu.models import RopeScaling
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=100000.0, rms_norm_eps=1e-5,
+        attention_bias=False, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32})
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    our_cfg = ModelConfig(
+        name="llama3-scaled-parity", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, rope_theta=100000.0,
+        rope_scaling=RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_position=32),
+        rms_norm_eps=1e-5, qkv_bias=False,
+        dtype=jnp.float32, matmul_precision="highest")
+    _hf_parity(tmp_path, model, our_cfg, 512)
+
+
 def test_moe_roundtrip_mixtral_layout(tmp_path, rng):
     """Export a tiny MoE model to the Mixtral block-sparse HF layout and
     load it back: forward must match the original exactly."""

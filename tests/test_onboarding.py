@@ -1,0 +1,120 @@
+"""Onboarding wizard: step validation, persistence/resume, config-tier
+writes, and the control-socket channel."""
+
+import json
+import os
+
+import pytest
+
+from senweaver_ide_tpu.services.config import RuntimeConfig
+from senweaver_ide_tpu.services.onboarding import (OnboardingService, STEPS,
+                                                   install_onboarding_channel)
+
+
+def _svc(tmp_path, probe=lambda: False):
+    cfg = RuntimeConfig(settings_path=str(tmp_path / "settings.json"))
+    svc = OnboardingService(cfg, state_path=str(tmp_path / "ob.json"),
+                            accelerator_probe=probe)
+    return cfg, svc
+
+
+def _complete_all(svc, tmp_path):
+    svc.answer("workspace", str(tmp_path / "ws"))
+    svc.answer("model", "qwen2.5-coder-1.5b")
+    svc.answer("provider", "anthropic")
+    svc.answer("accelerator", "cpu")
+    svc.answer("metrics", "false")
+
+
+def test_steps_progress_and_complete(tmp_path):
+    cfg, svc = _svc(tmp_path)
+    assert svc.status()["current"] == "workspace"
+    _complete_all(svc, tmp_path)
+    st = svc.status()
+    assert st["complete"] and st["current"] is None
+    # validated answers landed in the user config tier
+    assert cfg.get("model.preset") == "qwen2.5-coder-1.5b"
+    assert cfg.get("workspace.root") == str(tmp_path / "ws")
+    assert os.path.isdir(str(tmp_path / "ws"))     # workspace was created
+    assert cfg.get("metrics.enabled") is False
+
+
+def test_validation_rejects_bad_answers(tmp_path):
+    _, svc = _svc(tmp_path)
+    with pytest.raises(ValueError, match="unknown model preset"):
+        svc.answer("model", "gpt-17")
+    with pytest.raises(ValueError, match="unknown provider"):
+        svc.answer("provider", "nonesuch")
+    with pytest.raises(ValueError, match="probe failed"):
+        svc.answer("accelerator", "tpu")       # probe=False in _svc
+    with pytest.raises(ValueError, match="unknown onboarding step"):
+        svc.answer("nope", 1)
+
+
+def test_accelerator_accepts_tpu_when_probe_passes(tmp_path):
+    _, svc = _svc(tmp_path, probe=lambda: True)
+    st = svc.answer("accelerator", "tpu")
+    assert st["answers"]["accelerator"] == "tpu"
+
+
+def test_skip_only_optional(tmp_path):
+    _, svc = _svc(tmp_path)
+    with pytest.raises(ValueError, match="required"):
+        svc.skip("model")
+    st = svc.skip("metrics")
+    assert st["answers"]["metrics"] is None
+
+
+def test_state_resumes_across_instances(tmp_path):
+    cfg, svc = _svc(tmp_path)
+    svc.answer("workspace", str(tmp_path / "ws"))
+    svc.answer("model", "tiny-test")
+    # new instance over the same state file picks up mid-wizard
+    svc2 = OnboardingService(cfg, state_path=str(tmp_path / "ob.json"),
+                             accelerator_probe=lambda: False)
+    st = svc2.status()
+    assert st["current"] == "provider"
+    assert st["answers"]["model"] == "tiny-test"
+    svc2.reset()
+    assert svc2.status()["current"] == "workspace"
+
+
+def test_corrupt_state_starts_fresh(tmp_path):
+    (tmp_path / "ob.json").write_text("{not json")
+    _, svc = _svc(tmp_path)
+    assert svc.status()["current"] == "workspace"
+
+
+def test_control_channel_round_trip(tmp_path):
+    import socket
+
+    from senweaver_ide_tpu.runtime.control import ControlServer
+    cfg, svc = _svc(tmp_path)
+    server = ControlServer(str(tmp_path / "ctl.sock"))
+    install_onboarding_channel(server, svc)
+    server.start()
+    try:
+        def rpc(method, params):
+            with socket.socket(socket.AF_UNIX) as c:
+                c.connect(server.socket_path)
+                c.sendall(json.dumps({"jsonrpc": "2.0", "id": 1,
+                                      "method": method,
+                                      "params": params}).encode())
+                c.shutdown(socket.SHUT_WR)
+                return json.loads(c.makefile().read())["result"]
+
+        st = rpc("onboarding.status", {})
+        assert st["current"] == "workspace"
+        st = rpc("onboarding.answer", {"step": "workspace",
+                                       "value": str(tmp_path / "ws")})
+        assert st["answers"]["workspace"] == str(tmp_path / "ws")
+        st = rpc("onboarding.reset", {})
+        assert st["current"] == "workspace" and not st["answers"]
+    finally:
+        server.stop()
+
+
+def test_answer_rejects_missing_value(tmp_path):
+    _, svc = _svc(tmp_path)
+    with pytest.raises(ValueError, match="requires a value"):
+        svc.answer("workspace", None)

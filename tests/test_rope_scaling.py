@@ -1,0 +1,98 @@
+"""Llama-3 RoPE frequency scaling: formula parity with an explicit
+branch-wise reference, preset wiring, and cache-vs-full decode parity
+with scaling enabled (the property that keeps prefill and decode
+consistent for Llama-3.x serving)."""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import (ModelConfig, RopeScaling, get_config,
+                                      init_kv_cache, init_params)
+from senweaver_ide_tpu.models.transformer import forward
+from senweaver_ide_tpu.ops.rotary import (rope_cos_sin, rope_frequencies,
+                                          scale_frequencies_llama3)
+
+
+def _reference_scale(inv_freq: np.ndarray, factor, low, high, orig):
+    """Branch-wise restatement of the HF llama3 rope_scaling rule."""
+    out = np.empty_like(inv_freq)
+    for i, f in enumerate(inv_freq):
+        wavelen = 2.0 * math.pi / f
+        if wavelen < orig / high:          # short wavelength: untouched
+            out[i] = f
+        elif wavelen > orig / low:         # long wavelength: slowed
+            out[i] = f / factor
+        else:                              # mid band: interpolate
+            smooth = (orig / wavelen - low) / (high - low)
+            out[i] = (1.0 - smooth) * f / factor + smooth * f
+    return out
+
+
+@pytest.mark.parametrize("factor", [8.0, 32.0])
+def test_scaling_matches_branchwise_reference(factor):
+    inv = np.asarray(rope_frequencies(128, 500_000.0))
+    got = np.asarray(scale_frequencies_llama3(
+        jnp.asarray(inv), factor=factor, low_freq_factor=1.0,
+        high_freq_factor=4.0, original_max_position=8192))
+    want = _reference_scale(inv, factor, 1.0, 4.0, 8192)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    # the lowest frequency is in the slowed band; the highest untouched
+    assert got[-1] == pytest.approx(inv[-1] / factor, rel=1e-6)
+    assert got[0] == pytest.approx(inv[0], rel=1e-6)
+
+
+def test_rope_cos_sin_threads_scaling():
+    pos = jnp.arange(16)[None, :]
+    plain_c, _ = rope_cos_sin(pos, 64, 500_000.0)
+    scaled_c, _ = rope_cos_sin(pos, 64, 500_000.0,
+                               scaling=RopeScaling(factor=8.0))
+    assert not np.allclose(np.asarray(plain_c), np.asarray(scaled_c))
+
+
+def test_llama_presets_resolve():
+    for name, heads in (("llama-3.2-1b", 32), ("llama-3.1-8b", 32)):
+        c = get_config(name)
+        assert c.num_heads == heads and c.rope_scaling is not None
+        assert c.q_dim == c.num_heads * c.head_dim
+        assert c.rope_theta == 500_000.0
+
+
+def _tiny_llama() -> ModelConfig:
+    return dataclasses.replace(
+        get_config("tiny-test"), name="tiny-llama",
+        rope_scaling=RopeScaling(factor=8.0, original_max_position=32),
+        qkv_bias=False)
+
+
+def test_cache_decode_parity_with_scaling():
+    """Prefill+decode through the KV cache must equal the full forward
+    when scaling bends the frequency spectrum (positions cross the
+    original_max_position boundary so the scaled band matters)."""
+    c = _tiny_llama()
+    params = init_params(c, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 48), 0,
+                              c.vocab_size, dtype=jnp.int32)
+    full, _ = forward(params, c, toks)
+
+    cache = init_kv_cache(c, 2, 64)
+    logits, cache = forward(params, c, toks[:, :40], cache=cache,
+                            fresh_cache=True)
+    outs = [logits[:, -1]]
+    for i in range(40, 48):
+        step, cache = forward(params, c, toks[:, i:i + 1], cache=cache)
+        outs.append(step[:, -1])
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(full[:, 39:48]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_small_test_preset():
+    c = get_config("small-test")
+    params = init_params(c, jax.random.PRNGKey(0))
+    logits, _ = forward(params, c, jnp.ones((1, 8), jnp.int32))
+    assert logits.shape == (1, 8, c.vocab_size)

@@ -1,0 +1,146 @@
+"""Weight-only int8 quantization: accuracy, decode-path transparency,
+serving integration, and the publish re-quantization bridge."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import (get_config, init_kv_cache, init_params,
+                                      is_quantized, quantize_weights_int8,
+                                      quantized_bytes)
+from senweaver_ide_tpu.models.transformer import forward
+
+
+def _setup(name="tiny-test"):
+    c = get_config(name)
+    params = init_params(c, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 24), 0,
+                              c.vocab_size, dtype=jnp.int32)
+    return c, params, toks
+
+
+def test_quantized_forward_close_to_fp():
+    c, params, toks = _setup()
+    ref, _ = forward(params, c, toks)
+    qp = quantize_weights_int8(params)
+    assert is_quantized(qp) and not is_quantized(params)
+    got, _ = forward(qp, c, toks)
+    ref, got = np.asarray(ref), np.asarray(got)
+    # int8 per-channel error compounds over layers; demand the logits
+    # stay close in relative norm and agree on nearly all argmaxes
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, rel
+    agree = np.mean(got.argmax(-1) == ref.argmax(-1))
+    assert agree > 0.9, agree
+
+
+def test_quantized_cache_decode_matches_full():
+    """The property serving relies on: prefill+decode through the KV
+    cache equals the no-cache forward — with int8 weights in play."""
+    c, params, toks = _setup()
+    qp = quantize_weights_int8(params)
+    full, _ = forward(qp, c, toks)
+    cache = init_kv_cache(c, 2, 32)
+    logits, cache = forward(qp, c, toks[:, :16], cache=cache,
+                            fresh_cache=True)
+    outs = [logits[:, -1]]
+    for i in range(16, 24):
+        step, cache = forward(qp, c, toks[:, i:i + 1], cache=cache)
+        outs.append(step[:, -1])
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(full[:, 15:24]),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_idempotent_and_smaller():
+    _, params, _ = _setup()
+    qp = quantize_weights_int8(params)
+    assert quantized_bytes(qp) < 0.62 * quantized_bytes(params)
+    qp2 = quantize_weights_int8(qp)
+    assert qp2["layers"]["wq"].dtype == jnp.int8
+
+
+def test_untied_head_quantized():
+    c, params, toks = _setup()
+    c = dataclasses.replace(c, tie_word_embeddings=False)
+    params = init_params(c, jax.random.PRNGKey(0))
+    qp = quantize_weights_int8(params)
+    assert qp["lm_head"].dtype == jnp.int8
+    ref, _ = forward(params, c, toks)
+    got, _ = forward(qp, c, toks)
+    rel = (np.linalg.norm(np.asarray(got) - np.asarray(ref))
+           / np.linalg.norm(np.asarray(ref)))
+    assert rel < 0.05, rel
+
+
+def test_moe_banks_left_alone():
+    c = get_config("tiny-moe-test")
+    params = init_params(c, jax.random.PRNGKey(0))
+    qp = quantize_weights_int8(params)
+    # attention quantizes; 4-D expert banks and router stay fp
+    assert qp["layers"]["wq"].dtype == jnp.int8
+    assert qp["layers"]["w_gate"].dtype == c.dtype
+    toks = jnp.ones((1, 8), jnp.int32)
+    ref, _ = forward(params, c, toks)
+    got, _ = forward(qp, c, toks)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_engine_republish_requantizes():
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    c, params, _ = _setup()
+    engine = RolloutEngine(quantize_weights_int8(params), c, num_slots=2,
+                           max_len=64, eos_id=None, seed=0)
+    assert is_quantized(engine.params)
+    # trainer publishes full-precision weights; the bridge re-quantizes
+    engine.update_params(init_params(c, jax.random.PRNGKey(7)))
+    assert is_quantized(engine.params)
+    rid = engine.submit([1, 2, 3], max_new_tokens=4)
+    out = engine.run()
+    assert len(out[rid]) == 4
+
+
+def test_train_and_pipeline_reject_int8():
+    import optax
+    import pytest
+
+    from senweaver_ide_tpu.parallel.pipeline import split_layers_for_stages
+    from senweaver_ide_tpu.training.trainer import TrainState, train_step
+    c, params, toks = _setup()
+    qp = quantize_weights_int8(params)
+    with pytest.raises(TypeError, match="serving"):
+        split_layers_for_stages(qp, 2)
+    opt = optax.sgd(0.1)
+    state = TrainState(params=qp, opt_state=None, step=jnp.zeros((),
+                       jnp.int32), opt=opt)
+    with pytest.raises(TypeError, match="SERVING"):
+        train_step(state, c, None, toks,
+                   jnp.ones_like(toks, jnp.bool_),
+                   jnp.ones((2,), jnp.float32),
+                   jnp.arange(2, dtype=jnp.int32))
+
+
+def test_mesh_backed_quantized_engine():
+    """Scale leaves must have sharding rules: a mesh-backed engine with
+    int8 params places every leaf through param_specs."""
+    from senweaver_ide_tpu.parallel import MeshConfig, make_mesh
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    c, params, _ = _setup()
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    engine = RolloutEngine(quantize_weights_int8(params), c, num_slots=4,
+                           max_len=64, eos_id=None, seed=0, mesh=mesh)
+    rid = engine.submit([1, 2, 3], max_new_tokens=4)
+    assert len(engine.run()[rid]) == 4
+    # publish path re-places re-quantized params through the same specs
+    engine.update_params(init_params(c, jax.random.PRNGKey(3)))
+    assert is_quantized(engine.params)
+
+
+def test_export_hf_rejects_int8(tmp_path):
+    from senweaver_ide_tpu.models.load import export_hf_params
+    c, params, _ = _setup()
+    with pytest.raises(TypeError, match="serving"):
+        export_hf_params(quantize_weights_int8(params), c, str(tmp_path))
