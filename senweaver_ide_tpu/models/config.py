@@ -45,6 +45,9 @@ class ModelConfig:
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     qkv_bias: bool = False
+    # Qwen3-style per-head RMSNorm on q and k (over head_dim, before
+    # RoPE) — replaces Qwen2's qkv biases as the attention stabilizer.
+    qk_norm: bool = False
     # int8 KV cache with per-(position, head) scales: halves cache HBM so
     # memory-capacity-bound serving (6.7b on one 16 GB chip) fits 2× the
     # decode batch. See models/transformer.py _quantize_kv.
@@ -205,6 +208,24 @@ def tiny_test() -> ModelConfig:
         dtype=jnp.float32, matmul_precision="highest")
 
 
+def qwen3_1_7b() -> ModelConfig:
+    """Qwen3-1.7B: QK-norm GQA, no attention biases, tied embeddings."""
+    return ModelConfig(
+        name="qwen3-1.7b", vocab_size=151_936, hidden_size=2048,
+        intermediate_size=6144, num_layers=28, num_heads=16, num_kv_heads=8,
+        head_dim=128, max_seq_len=32_768, rope_theta=1_000_000.0,
+        tie_word_embeddings=True, qk_norm=True)
+
+
+def qwen3_8b() -> ModelConfig:
+    """Qwen3-8B: the 7B-class member of the Qwen3 ladder."""
+    return ModelConfig(
+        name="qwen3-8b", vocab_size=151_936, hidden_size=4096,
+        intermediate_size=12_288, num_layers=36, num_heads=32,
+        num_kv_heads=8, head_dim=128, max_seq_len=32_768,
+        rope_theta=1_000_000.0, qk_norm=True)
+
+
 def llama_3_2_1b() -> ModelConfig:
     """Llama-3.2-1B: GQA, tied embeddings, llama3 RoPE scaling (the
     128k-context serving config of an 8k-trained base)."""
@@ -249,6 +270,8 @@ PRESETS = {
     "deepseek-coder-6.7b": deepseek_coder_6_7b,
     "llama-3.2-1b": llama_3_2_1b,
     "llama-3.1-8b": llama_3_1_8b,
+    "qwen3-1.7b": qwen3_1_7b,
+    "qwen3-8b": qwen3_8b,
     "tiny-test": tiny_test,
     "tiny-moe-test": tiny_moe_test,
     "small-test": small_test,

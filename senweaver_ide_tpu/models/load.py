@@ -138,6 +138,11 @@ def load_hf_params(model_dir: str, config: ModelConfig, *,
                                False)
         layers["bv"] = stacked(p + "self_attn.v_proj.bias", (c.kv_dim,),
                                False)
+    if c.qk_norm:
+        layers["q_norm"] = stacked(p + "self_attn.q_norm.weight",
+                                   (c.head_dim,), False)
+        layers["k_norm"] = stacked(p + "self_attn.k_norm.weight",
+                                   (c.head_dim,), False)
 
     params: Params = {
         "embed": _take(raw, "model.embed_tokens.weight", (V, D)),
@@ -220,6 +225,9 @@ def export_hf_params(params: Params, config: ModelConfig,
             out[p + "self_attn.q_proj.bias"] = t(lp["bq"][i])
             out[p + "self_attn.k_proj.bias"] = t(lp["bk"][i])
             out[p + "self_attn.v_proj.bias"] = t(lp["bv"][i])
+        if c.qk_norm:
+            out[p + "self_attn.q_norm.weight"] = t(lp["q_norm"][i])
+            out[p + "self_attn.k_norm.weight"] = t(lp["k_norm"][i])
     path = os.path.join(out_dir, "model.safetensors")
     save_file(out, path)
     return path

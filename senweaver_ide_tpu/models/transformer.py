@@ -144,6 +144,9 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         layers["bq"] = jnp.zeros((L, c.q_dim), c.dtype)
         layers["bk"] = jnp.zeros((L, c.kv_dim), c.dtype)
         layers["bv"] = jnp.zeros((L, c.kv_dim), c.dtype)
+    if c.qk_norm:
+        layers["q_norm"] = jnp.ones((L, c.head_dim), c.dtype)
+        layers["k_norm"] = jnp.ones((L, c.head_dim), c.dtype)
 
     params: Params = {
         "embed": (jax.random.normal(k_embed, (c.vocab_size, D), c.dtype)
@@ -169,9 +172,20 @@ def _dense(h: jax.Array, lp: Dict[str, jax.Array], name: str,
     w = lp[name]
     if w.dtype == jnp.int8:
         out = jnp.einsum(spec, h, w.astype(h.dtype))
-        return (out.astype(jnp.float32)
-                * lp[name + "_scale"]).astype(h.dtype)
-    return jnp.einsum(spec, h, w)
+        out = (out.astype(jnp.float32)
+               * lp[name + "_scale"]).astype(h.dtype)
+    else:
+        out = jnp.einsum(spec, h, w)
+    la = lp.get(name + "_lora_a")
+    if la is not None:
+        # Low-rank adapter (training/lora.py): y += (h @ A) @ B, with
+        # the alpha/rank scaling baked into A at merge time. Factored
+        # order keeps the FLOPs O(r·(in+out)) instead of materializing
+        # the (in, out) delta; works over an int8 base (QLoRA-style).
+        lb = lp[name + "_lora_b"]
+        out = out + jnp.einsum("bsr,ro->bso",
+                               jnp.einsum("bsi,ir->bsr", h, la), lb)
+    return out
 
 
 def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
@@ -183,8 +197,14 @@ def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
     v = _dense(h, lp, "wv", "bsd,de->bse")
     if c.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
-    q = apply_rope(q.reshape(b, s, c.num_heads, c.head_dim), cos, sin)
-    k = apply_rope(k.reshape(b, s, c.num_kv_heads, c.head_dim), cos, sin)
+    q = q.reshape(b, s, c.num_heads, c.head_dim)
+    k = k.reshape(b, s, c.num_kv_heads, c.head_dim)
+    if c.qk_norm:
+        # Qwen3: per-head RMSNorm over head_dim BEFORE RoPE
+        q = rms_norm(q, lp["q_norm"], c.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], c.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
     v = v.reshape(b, s, c.num_kv_heads, c.head_dim)
     return q, k, v
 

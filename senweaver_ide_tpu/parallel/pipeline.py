@@ -42,6 +42,13 @@ def split_layers_for_stages(params: Params, n_stages: int) -> Params:
         raise TypeError("pipeline stages do not support int8-quantized "
                         "params (models/quantize.py is a serving-path "
                         "transform); pass full-precision params")
+    if any("_lora_" in name for name in params["layers"]):
+        # adapter leaves would reshape into stages and ride along but
+        # never be applied — the pipeline would silently serve the
+        # UN-adapted base policy
+        raise TypeError("pipeline stages do not apply LoRA adapter "
+                        "leaves; fold them first (training.lora."
+                        "materialize_lora) and pass the plain params")
     L = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
     if L % n_stages != 0:
         raise ValueError(f"num_layers {L} not divisible by {n_stages} "

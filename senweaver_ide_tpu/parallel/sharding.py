@@ -39,6 +39,8 @@ PARAM_SPECS: Dict[str, P] = {
     "layers/bv": P(None, "tp"),
     "layers/wo": P(None, "tp", "fsdp"),
     "layers/mlp_norm": P(None, None),
+    "layers/q_norm": P(None, None),     # (L, head_dim) — replicated
+    "layers/k_norm": P(None, None),
     "layers/w_gate": P(None, "fsdp", "tp"),
     "layers/w_up": P(None, "fsdp", "tp"),
     "layers/w_down": P(None, "tp", "fsdp"),
@@ -55,6 +57,12 @@ PARAM_SPECS: Dict[str, P] = {
     "layers/w_up_scale": P(None, "tp"),
     "layers/w_down_scale": P(None, "fsdp"),
 }
+
+# LoRA adapter leaves (training/lora.py): replicated — rank-r factors
+# are tiny and the (h@A)@B epilogue is cheapest with local factors.
+for _t in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+    PARAM_SPECS[f"layers/{_t}_lora_a"] = P(None, None, None)
+    PARAM_SPECS[f"layers/{_t}_lora_b"] = P(None, None, None)
 
 # MoE variants: expert banks carry an extra (E,) axis after the layer
 # axis, sharded over 'ep' (models/config.py num_experts > 0).

@@ -61,7 +61,7 @@ def _v_model(value: Any, svc: "OnboardingService") -> str:
 
 
 def _v_provider(value: Any, svc: "OnboardingService") -> str:
-    from ..models.capabilities import get_model_capabilities
+    from ..models.capabilities import _DEFAULT, get_model_capabilities
     from ..transport.providers import PROVIDERS
     name = str(value)
     if name not in PROVIDERS:
@@ -69,7 +69,14 @@ def _v_provider(value: Any, svc: "OnboardingService") -> str:
                          f"available: {sorted(PROVIDERS)}")
     default_model = PROVIDERS[name].default_model
     if default_model:
-        get_model_capabilities(default_model)   # must resolve, not raise
+        # get_model_capabilities never raises — it falls back to a
+        # generic 128k entry; identity-check against the fallback, same
+        # as the provider conformance test, so a provider whose default
+        # model has no real DB entry fails HERE, not deep inside a job
+        if get_model_capabilities(default_model) is _DEFAULT:
+            raise ValueError(
+                f"provider {name!r} default model {default_model!r} has "
+                f"no capabilities entry (models/capabilities.py)")
     return name
 
 

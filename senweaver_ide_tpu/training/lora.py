@@ -1,0 +1,133 @@
+"""LoRA adapters: low-rank GRPO fine-tuning that fits one chip.
+
+Full-precision GRPO on a 6.7B policy needs ~13.4 GB bf16 weights +
+~27 GB fp32-equivalent Adam moments + a full-size gradient tree — far
+past one 16 GB v5e. LoRA freezes the base and trains rank-r factors on
+the attention (and optionally MLP) matmuls: the gradient tree and
+optimizer state shrink to the adapters (tens of MB at r=16), and with
+an int8-quantized base (models/quantize.py) the whole setup — weights,
+adapters, moments, activations — fits a single chip (QLoRA recipe,
+TPU-first: the dequant epilogue lives inside ``transformer._dense``,
+so the merged forward is one code path for full/int8/LoRA serving).
+
+Mechanics:
+  - ``init_lora(config, key, rank, targets)`` → adapter pytree shaped
+    like the layer stack: ``{"layers": {"wq_lora_a": (L, in, r),
+    "wq_lora_b": (L, r, out), ...}}``; B starts at zero so the adapted
+    model EQUALS the base at init (the LoRA invariant).
+  - ``merge_lora(base_params, lora)`` → params whose layers dict also
+    carries the adapter leaves; ``transformer._dense`` applies
+    ``y += (h @ A) @ B`` wherever they are present. The merge is a dict
+    union — no weight materialization, scan-compatible (leading L).
+  - ``train_step(..., lora_base=base)`` (training/trainer.py) treats
+    ``state.params`` as the adapter tree: gradients and optimizer state
+    exist ONLY for the adapters; the base is a closed-over constant.
+  - ``materialize_lora(base, lora, config)`` folds A·B into the dense
+    weights for publish/export (re-quantizing if the base was int8).
+
+The alpha/rank scale is baked into A at init (A ~ N(0, 1/in)·alpha/r,
+B = 0): the adapted function class is identical and no extra scale leaf
+has to ride the scanned layer dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.config import ModelConfig
+from ..models.quantize import _quantize_matrix, is_quantized
+
+# (in_dim, out_dim) resolvers per supported target matrix.
+_TARGET_DIMS = {
+    "wq": lambda c: (c.hidden_size, c.q_dim),
+    "wk": lambda c: (c.hidden_size, c.kv_dim),
+    "wv": lambda c: (c.hidden_size, c.kv_dim),
+    "wo": lambda c: (c.q_dim, c.hidden_size),
+    "w_gate": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_up": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_down": lambda c: (c.intermediate_size, c.hidden_size),
+}
+
+DEFAULT_TARGETS: Tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+
+def init_lora(config: ModelConfig, key: jax.Array, *, rank: int = 16,
+              alpha: float = None, targets: Sequence[str] = DEFAULT_TARGETS,
+              ) -> Dict:
+    """Adapter pytree; zero function delta at init (B = 0)."""
+    if config.num_experts > 0:
+        bad = {"w_gate", "w_up", "w_down"} & set(targets)
+        if bad:
+            raise ValueError(f"MoE expert banks are not LoRA targets "
+                             f"(got {sorted(bad)}); use attention targets")
+    alpha = 2.0 * rank if alpha is None else alpha
+    L = config.num_layers
+    layers: Dict[str, jax.Array] = {}
+    keys = jax.random.split(key, len(targets))
+    for t, k in zip(targets, keys):
+        if t not in _TARGET_DIMS:
+            raise ValueError(f"unknown LoRA target {t!r}; "
+                             f"available: {sorted(_TARGET_DIMS)}")
+        d_in, d_out = _TARGET_DIMS[t](config)
+        scale = (alpha / rank) / float(d_in) ** 0.5
+        layers[t + "_lora_a"] = (
+            jax.random.normal(k, (L, d_in, rank), config.dtype)
+            * jnp.asarray(scale, config.dtype))
+        layers[t + "_lora_b"] = jnp.zeros((L, rank, d_out), config.dtype)
+    return {"layers": layers}
+
+
+def merge_lora(base_params: Dict, lora: Dict) -> Dict:
+    """Params view with adapter leaves alongside the base layer stack —
+    what ``forward`` consumes. Pure dict union (no array math)."""
+    out = dict(base_params)
+    out["layers"] = {**base_params["layers"], **lora["layers"]}
+    return out
+
+
+def split_lora(params: Dict) -> Tuple[Dict, Dict]:
+    """Inverse of merge_lora: (base_params, lora)."""
+    base, adapters = {}, {}
+    for name, leaf in params["layers"].items():
+        (adapters if "_lora_" in name else base)[name] = leaf
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["layers"] = base
+    return out, {"layers": adapters}
+
+
+def materialize_lora(base_params: Dict, lora: Dict,
+                     config: ModelConfig) -> Dict:
+    """Fold A·B into the dense weights → a plain param tree (publish /
+    export path). An int8 base is dequantized per matrix, folded, and
+    re-quantized, so a QLoRA-served engine keeps its representation."""
+    out = dict(base_params)
+    layers = dict(base_params["layers"])
+    for name in list(lora["layers"]):
+        if not name.endswith("_lora_a"):
+            continue
+        target = name[: -len("_lora_a")]
+        a = lora["layers"][name]
+        b = lora["layers"][target + "_lora_b"]
+        delta = jnp.einsum("lir,lro->lio", a.astype(jnp.float32),
+                           b.astype(jnp.float32))
+        w = layers[target]
+        if w.dtype == jnp.int8:
+            scale = layers[target + "_scale"]          # (L, out)
+            wf = w.astype(jnp.float32) * scale[:, None, :]
+            layers[target], layers[target + "_scale"] = _quantize_matrix(
+                (wf + delta).astype(config.dtype))
+        else:
+            layers[target] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    out["layers"] = layers
+    return out
+
+
+def lora_param_count(lora: Dict) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(lora))
+
+
+__all__ = ["DEFAULT_TARGETS", "init_lora", "lora_param_count",
+           "materialize_lora", "merge_lora", "split_lora", "is_quantized"]

@@ -132,6 +132,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                metrics_service=None,
                perf_monitor=None,
                engine=None,
+               lora_base=None,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -156,14 +157,15 @@ def grpo_round(state: TrainState, model_config, mesh,
             group_size=group_size, pad_id=pad_id, max_len=max_len,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
-            perf_monitor=perf_monitor, engine=engine)
+            perf_monitor=perf_monitor, engine=engine, lora_base=lora_base)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      group_size, pad_id, max_len, grpo_config,
                      reward_override, max_parallel, accum_steps=1,
                      ppo_epochs=1, metrics_service=None,
-                     perf_monitor=None, engine=None) -> RoundResult:
+                     perf_monitor=None, engine=None,
+                     lora_base=None) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
@@ -201,7 +203,11 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if ppo_epochs > 1 and old_logp is None:
         from .async_loop import behavior_logp_batched
         t_b = _time.monotonic()
-        old_logp = behavior_logp_batched(state.params, model_config,
+        logp_params = state.params
+        if lora_base is not None:
+            from .lora import merge_lora
+            logp_params = merge_lora(lora_base, state.params)
+        old_logp = behavior_logp_batched(logp_params, model_config,
                                          tokens, accum_steps)
         if perf_monitor is not None:
             perf_monitor.record_ms("behavior_logp",
@@ -211,7 +217,8 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     for _ in range(ppo_epochs):
         state, metrics = train_step(
             state, model_config, mesh, tokens, mask, rewards, group_ids,
-            old_logp=old, grpo_config=grpo_config, accum_steps=accum_steps)
+            old_logp=old, grpo_config=grpo_config, accum_steps=accum_steps,
+            lora_base=lora_base)
     out_metrics = {k: float(v) for k, v in metrics.items()}
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step",

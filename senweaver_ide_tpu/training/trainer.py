@@ -89,6 +89,30 @@ def make_train_state(config: ModelConfig, key: jax.Array,
                       step=jnp.zeros((), jnp.int32), opt=opt)
 
 
+def make_lora_train_state(config: ModelConfig, base_params: Params,
+                          key: jax.Array, mesh: Optional[Mesh] = None, *,
+                          rank: int = 16, alpha: Optional[float] = None,
+                          targets: Optional[Tuple[str, ...]] = None,
+                          learning_rate: float = 1e-4,
+                          optimizer: Optional[
+                              optax.GradientTransformation] = None,
+                          ) -> TrainState:
+    """TrainState whose params are ONLY the LoRA adapters for
+    ``base_params`` (training/lora.py): pass the frozen base to
+    ``train_step(..., lora_base=base_params)``. Adapters are replicated
+    on the mesh (they are tiny; the base keeps its own shardings)."""
+    from .lora import DEFAULT_TARGETS, init_lora
+    lora = init_lora(config, key, rank=rank, alpha=alpha,
+                     targets=targets or DEFAULT_TARGETS)
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        lora = jax.device_put(lora, repl)
+    opt = optimizer or make_optimizer(learning_rate)
+    opt_state = jax.jit(opt.init)(lora)
+    return TrainState(params=lora, opt_state=opt_state,
+                      step=jnp.zeros((), jnp.int32), opt=opt)
+
+
 def _opt_state_shardings(opt, params, mesh):
     """Shardings for the optimizer state: any leaf whose (shape, dtype)
     matches a param leaf (Adam moments are param-shaped) inherits that param's
@@ -121,6 +145,7 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                      num_groups: int,
                      accum_steps: int,
                      mesh: Optional[Mesh] = None,
+                     lora_base: Optional[Params] = None,
                      ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """The GRPO step — always the accumulated form; ``accum_steps=1``
     is a length-1 scan and IS the monolithic step (single implementation,
@@ -164,8 +189,16 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                micro(old_logp) if has_old else zeros_f32)
 
     def loss_fn(params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old):
-        logits, _, moe_aux = forward(params, config, m_in, with_aux=True,
-                                     mesh=mesh)
+        if lora_base is not None:
+            # LoRA: `params` is the adapter tree; the frozen base rides
+            # as a closed-over constant — gradients and optimizer state
+            # exist only for the adapters (training/lora.py).
+            from .lora import merge_lora
+            model_params = merge_lora(lora_base, params)
+        else:
+            model_params = params
+        logits, _, moe_aux = forward(model_params, config, m_in,
+                                     with_aux=True, mesh=mesh)
         logp = token_logprobs(logits, m_tgt)
         olp = m_old if has_old else jax.lax.stop_gradient(logp)
         loss, metrics = grpo_objective(logp, olp, m_adv, m_mask, grpo_config,
@@ -230,6 +263,7 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
                optimizer: Optional[optax.GradientTransformation] = None,
                num_groups: Optional[int] = None,
                accum_steps: int = 1,
+               lora_base: Optional[Params] = None,
                ) -> Tuple[TrainState, Dict[str, jax.Array]]:
     """One GRPO update. tokens: (B, S) prompt+completion; completion_mask True
     on completion positions; rewards: (B,) finalReward; group_ids: (B,) prompt
@@ -248,11 +282,13 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
             "train_step received int8-quantized params "
             "(models/quantize.py) — quantization is a SERVING transform; "
             "train on the full-precision state and publish quantized")
+    # An int8 lora_base is ALLOWED: adapters differentiate through the
+    # dequant epilogue wrt activations only (QLoRA; training/lora.py).
     opt = optimizer or state.opt or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
             old_logp, ref_logp, grpo_config, n_groups, accum_steps)
     if mesh is not None:
         with mesh:
-            return _grpo_step(*args, mesh=mesh)
-    return _grpo_step(*args)
+            return _grpo_step(*args, mesh=mesh, lora_base=lora_base)
+    return _grpo_step(*args, lora_base=lora_base)

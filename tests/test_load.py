@@ -152,6 +152,61 @@ def test_parity_vs_transformers_llama(tmp_path):
     _hf_parity(tmp_path, model, our_cfg, 512)
 
 
+def test_parity_vs_transformers_qwen3(tmp_path):
+    """Qwen3's QK-norm (per-head RMSNorm before RoPE) wired exactly as
+    HF does it — parity vs Qwen3ForCausalLM at fp32 tolerance."""
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen3ForCausalLM"):
+        pytest.skip("transformers too old for Qwen3")
+
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=128, rope_theta=1_000_000.0,
+        rms_norm_eps=1e-6, tie_word_embeddings=False,
+        attention_bias=False)
+    model = transformers.Qwen3ForCausalLM(hf_cfg)
+    our_cfg = ModelConfig(
+        name="qwen3-parity", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, rope_theta=1_000_000.0,
+        qkv_bias=False, qk_norm=True,
+        dtype=jnp.float32, matmul_precision="highest")
+    _hf_parity(tmp_path, model, our_cfg, 512)
+
+
+def test_qk_norm_roundtrip_and_cache_parity(tmp_path):
+    """Export/load round-trip carries q_norm/k_norm; prefill+decode
+    through the KV cache equals the full forward with QK-norm on."""
+    cfg = dataclasses.replace(get_config("tiny-test"), name="tiny-qk",
+                              qkv_bias=False, qk_norm=True)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    # break the all-ones init so the round-trip actually checks values
+    import jax as _jax
+    params["layers"]["q_norm"] = _jax.random.uniform(
+        _jax.random.PRNGKey(4), params["layers"]["q_norm"].shape,
+        minval=0.5, maxval=1.5)
+    export_hf_params(params, cfg, str(tmp_path))
+    loaded = load_hf_params(str(tmp_path), cfg)
+    np.testing.assert_allclose(np.asarray(loaded["layers"]["q_norm"]),
+                               np.asarray(params["layers"]["q_norm"]),
+                               rtol=1e-6)
+
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, 24), 0, 512)
+    full, _ = forward(params, cfg, toks)
+    from senweaver_ide_tpu.models import init_kv_cache
+    cache = init_kv_cache(cfg, 2, 32)
+    logits, cache = forward(params, cfg, toks[:, :16], cache=cache,
+                            fresh_cache=True)
+    outs = [logits[:, -1]]
+    for i in range(16, 24):
+        step, cache = forward(params, cfg, toks[:, i:i + 1], cache=cache)
+        outs.append(step[:, -1])
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(full[:, 15:24]),
+                               atol=2e-4, rtol=2e-4)
+
+
 def test_parity_vs_transformers_llama3_rope_scaling(tmp_path):
     """Llama-3.1-style checkpoints: our RopeScaling (NTK-by-parts) must
     match transformers' llama3 rope_type bit-for-bit at fp32 tolerance —

@@ -1,0 +1,158 @@
+"""LoRA adapters: zero-delta init, adapter-only training (full and
+QLoRA int8-base), materialization parity, and the grpo_round path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.models import (get_config, init_params,
+                                      quantize_weights_int8)
+from senweaver_ide_tpu.models.transformer import forward
+from senweaver_ide_tpu.training import (init_lora, lora_param_count,
+                                        make_lora_train_state,
+                                        materialize_lora, merge_lora,
+                                        split_lora, train_step)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    c = get_config("tiny-test")
+    base = init_params(c, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 24), 0,
+                              c.vocab_size, dtype=jnp.int32)
+    return c, base, toks
+
+
+def test_zero_delta_at_init(setup):
+    c, base, toks = setup
+    lora = init_lora(c, jax.random.PRNGKey(2), rank=4)
+    ref, _ = forward(base, c, toks)
+    got, _ = forward(merge_lora(base, lora), c, toks)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_split_inverts_merge(setup):
+    c, base, _ = setup
+    lora = init_lora(c, jax.random.PRNGKey(2), rank=4)
+    b2, l2 = split_lora(merge_lora(base, lora))
+    assert set(b2["layers"]) == set(base["layers"])
+    assert set(l2["layers"]) == set(lora["layers"])
+
+
+def test_adapter_training_moves_only_adapters(setup):
+    c, base, toks = setup
+    state = make_lora_train_state(c, base, jax.random.PRNGKey(3), rank=4,
+                                  learning_rate=0.1)
+    n_adapter = lora_param_count(state.params)
+    n_base = sum(int(x.size) for x in jax.tree_util.tree_leaves(base))
+    assert n_adapter < 0.2 * n_base
+    mask = jnp.ones_like(toks, jnp.bool_)
+    rewards = jnp.asarray([1.0, -1.0, 0.5, -0.5])
+    groups = jnp.asarray([0, 0, 1, 1], jnp.int32)
+    before = jax.tree_util.tree_map(np.asarray, state.params)
+    state2, metrics = train_step(state, c, None, toks, mask, rewards,
+                                 groups, lora_base=base)
+    assert np.isfinite(float(metrics["loss"]))
+    moved = jax.tree_util.tree_reduce(
+        lambda acc, x: acc + float(np.abs(x).sum()),
+        jax.tree_util.tree_map(lambda a, b: np.asarray(a) - b,
+                               state2.params, before), 0.0)
+    assert moved > 0.0               # adapters actually stepped
+    # the function changed even though B started at zero (A's grad is
+    # nonzero only through B, so step 1 moves B; assert after 2 steps)
+    state3, _ = train_step(state2, c, None, toks, mask, rewards, groups,
+                           lora_base=base)
+    ref, _ = forward(base, c, toks)
+    got, _ = forward(merge_lora(base, state3.params), c, toks)
+    assert float(np.abs(np.asarray(got) - np.asarray(ref)).max()) > 0.0
+
+
+def test_qlora_int8_base_trains(setup):
+    c, base, toks = setup
+    qbase = quantize_weights_int8(base)
+    state = make_lora_train_state(c, qbase, jax.random.PRNGKey(4), rank=4,
+                                  learning_rate=0.1)
+    mask = jnp.ones_like(toks, jnp.bool_)
+    rewards = jnp.asarray([1.0, -1.0, 1.0, -1.0])
+    groups = jnp.asarray([0, 0, 1, 1], jnp.int32)
+    state2, metrics = train_step(state, c, None, toks, mask, rewards,
+                                 groups, lora_base=qbase)
+    assert np.isfinite(float(metrics["loss"]))
+    out, _ = forward(merge_lora(qbase, state2.params), c, toks)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_materialize_matches_runtime_merge(setup):
+    c, base, toks = setup
+    lora = init_lora(c, jax.random.PRNGKey(5), rank=4)
+    # give B real values so the delta is nonzero
+    lora["layers"] = {
+        k: (jax.random.normal(jax.random.PRNGKey(6), v.shape, v.dtype) * 0.02
+            if k.endswith("_lora_b") else v)
+        for k, v in lora["layers"].items()}
+    runtime, _ = forward(merge_lora(base, lora), c, toks)
+    folded = materialize_lora(base, lora, c)
+    assert not any("_lora_" in k for k in folded["layers"])
+    static, _ = forward(folded, c, toks)
+    np.testing.assert_allclose(np.asarray(static), np.asarray(runtime),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_materialize_requantizes_int8_base(setup):
+    c, base, toks = setup
+    qbase = quantize_weights_int8(base)
+    lora = init_lora(c, jax.random.PRNGKey(7), rank=4)
+    folded = materialize_lora(qbase, lora, c)
+    assert folded["layers"]["wq"].dtype == jnp.int8
+    # zero-delta lora: folded int8 weights round-trip the quantization
+    out, _ = forward(folded, c, toks)
+    ref, _ = forward(qbase, c, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-2, rtol=5e-2)
+
+
+def test_grpo_round_with_lora(tmp_path):
+    """The full collect→update loop trains adapters only (engine serves
+    the merged policy; weights publish via materialize_lora)."""
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import (EnginePolicyClient, RolloutEngine,
+                                           RolloutSession)
+    from senweaver_ide_tpu.training import grpo_round
+
+    c = get_config("tiny-test")
+    base = init_params(c, jax.random.PRNGKey(0))
+    state = make_lora_train_state(c, base, jax.random.PRNGKey(1), rank=4,
+                                  learning_rate=0.05)
+    tok = ByteTokenizer()
+    engine = RolloutEngine(materialize_lora(base, state.params, c), c,
+                           num_slots=4, max_len=2048, eos_id=None, seed=0)
+
+    def make_session():
+        client = EnginePolicyClient(engine, tok, default_max_new_tokens=8,
+                                    record_calls=True)
+        return RolloutSession(client, str(tmp_path / "ws"),
+                              include_tool_definitions=False)
+
+    def reward(task_idx, g, session):
+        out_ids = session.client.call_log[-1][1]
+        frac = sum(1 for t in out_ids if t < 128) / max(len(out_ids), 1)
+        return 2.0 * frac - 1.0
+
+    out = grpo_round(state, c, None, make_session, ["write ascii"],
+                     group_size=4, pad_id=tok.pad_id, max_len=1024,
+                     reward_override=reward, ppo_epochs=2,
+                     lora_base=base)
+    assert np.isfinite(float(out.metrics["loss"]))
+    assert set(out.state.params["layers"]) == set(state.params["layers"])
+    engine.update_params(materialize_lora(base, out.state.params, c))
+
+
+def test_pipeline_rejects_unmaterialized_lora(setup):
+    from senweaver_ide_tpu.parallel.pipeline import split_layers_for_stages
+    c, base, _ = setup
+    lora = init_lora(c, jax.random.PRNGKey(8), rank=4)
+    with pytest.raises(TypeError, match="materialize_lora"):
+        split_layers_for_stages(merge_lora(base, lora), 2)
+    # folded params pass
+    split_layers_for_stages(materialize_lora(base, lora, c), 2)

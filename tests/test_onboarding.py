@@ -118,3 +118,15 @@ def test_answer_rejects_missing_value(tmp_path):
     _, svc = _svc(tmp_path)
     with pytest.raises(ValueError, match="requires a value"):
         svc.answer("workspace", None)
+
+
+def test_provider_rejects_capability_fallback(tmp_path, monkeypatch):
+    from senweaver_ide_tpu.transport import providers as prov_mod
+    _, svc = _svc(tmp_path)
+    fake = dict(prov_mod.PROVIDERS)
+    fake["ghost"] = prov_mod.ProviderSettings(
+        name="ghost", endpoint_style="openai-compat", base_url="https://x",
+        api_key_env="G", default_model="model-with-no-db-entry-xyz")
+    monkeypatch.setattr(prov_mod, "PROVIDERS", fake)
+    with pytest.raises(ValueError, match="no capabilities entry"):
+        svc.answer("provider", "ghost")
