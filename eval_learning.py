@@ -32,25 +32,41 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       ppo_epochs: int = 2, seed: int = 0,
                       window: int = 2, max_parallel: int = 8,
                       contextual: bool = False,
-                      model: str = "tiny-test") -> dict:
+                      model: str = "tiny-test",
+                      lora_rank: int = 0) -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
     from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
     from senweaver_ide_tpu.rollout import (EnginePolicyClient, RolloutEngine,
                                            RolloutSession)
-    from senweaver_ide_tpu.training import grpo_round, make_train_state
+    from senweaver_ide_tpu.training import (grpo_round, make_lora_train_state,
+                                            make_train_state,
+                                            materialize_lora)
     from senweaver_ide_tpu.training.grpo import GRPOConfig
 
     config = get_config(model)
-    state = make_train_state(config, jax.random.PRNGKey(seed), None,
-                             learning_rate=lr)
+    # lora_rank > 0: the adapter-only variant of the same proof — the
+    # frozen base plus rank-r factors must STILL climb the curve (the
+    # single-chip 7B-class training path; training/lora.py).
+    lora_base = None
+    if lora_rank > 0:
+        from senweaver_ide_tpu.models import init_params
+        lora_base = init_params(config, jax.random.PRNGKey(seed))
+        state = make_lora_train_state(config, lora_base,
+                                     jax.random.PRNGKey(seed + 1),
+                                     rank=lora_rank, learning_rate=lr)
+    else:
+        state = make_train_state(config, jax.random.PRNGKey(seed), None,
+                                 learning_rate=lr)
     tok = ByteTokenizer()
     workdir = tempfile.mkdtemp(prefix="learn_")
 
     # eos_id=None: fixed-length completions — reward reflects token
     # CONTENT only, not length noise.
-    engine = RolloutEngine(state.params, config, num_slots=8, max_len=4096,
+    serving = (materialize_lora(lora_base, state.params, config)
+               if lora_base is not None else state.params)
+    engine = RolloutEngine(serving, config, num_slots=8, max_len=4096,
                            eos_id=None, seed=seed)
 
     def make_session():
@@ -97,12 +113,14 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                          pad_id=tok.pad_id, max_len=2048,
                          grpo_config=gcfg,
                          ppo_epochs=ppo_epochs, max_parallel=max_parallel,
-                         reward_override=reward)
+                         reward_override=reward, lora_base=lora_base)
         state = out.state
         # Publish the updated weights to the serving engine — the same
         # actor/learner weight sync the async trainer does at round
         # boundaries; without it every round samples the initial policy.
-        engine.update_params(state.params)
+        engine.update_params(
+            materialize_lora(lora_base, state.params, config)
+            if lora_base is not None else state.params)
         by_task = [[e.reward for e in out.episodes if e.task_idx == i]
                    for i in range(len(tasks))]
         means = [sum(v) / max(len(v), 1) for v in by_task]
@@ -124,7 +142,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         "config": {"lr": lr, "group_size": group_size,
                    "max_new_tokens": max_new_tokens,
                    "ppo_epochs": ppo_epochs, "seed": seed,
-                   "contextual": contextual, "model": model},
+                   "contextual": contextual, "model": model,
+                   "lora_rank": lora_rank},
         "wall_s": round(time.monotonic() - t0, 1),
     }
     if contextual:
@@ -154,6 +173,9 @@ def main() -> None:
     ap.add_argument("--contextual", action="store_true",
                     help="two contrastive tasks: the policy must learn "
                          "prompt-CONDITIONAL emission, not a global bias")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="train rank-r LoRA adapters on a frozen base "
+                         "instead of full fine-tuning (0 = full)")
     ap.add_argument("--model", default="tiny-test",
                     help="model preset (small-test for the contextual "
                          "capacity run)")
@@ -175,7 +197,7 @@ def main() -> None:
                                max_new_tokens=args.max_new_tokens,
                                ppo_epochs=args.ppo_epochs, seed=args.seed,
                                contextual=args.contextual,
-                               model=args.model)
+                               model=args.model, lora_rank=args.lora_rank)
     print(json.dumps(report))
 
 

@@ -112,7 +112,8 @@ class AsyncGRPOTrainer:
                  prefetch: int = 1,
                  importance_correction: bool = True,
                  publish_params: Optional[Callable[[object], None]] = None,
-                 metrics_service=None):
+                 metrics_service=None,
+                 lora_base=None):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -131,6 +132,11 @@ class AsyncGRPOTrainer:
         self.importance_correction = importance_correction
         self.publish_params = publish_params
         self.metrics_service = metrics_service
+        # LoRA: state.params are ONLY the adapters over this frozen base
+        # (training/lora.py); behavior snapshots and publishes carry the
+        # MATERIALIZED policy so logp recomputation and engines see full
+        # weights, while the train step differentiates adapters only.
+        self.lora_base = lora_base
 
         self._queue: "queue.Queue[_Collected]" = queue.Queue(
             maxsize=max(1, prefetch))
@@ -141,7 +147,8 @@ class AsyncGRPOTrainer:
         # and is only touched by _flush_pending_publish (collector
         # thread, or run()'s finally after the collector joined).
         self._pending_publish: Optional[tuple] = None
-        self._applied_behavior: tuple = (0, state.params)
+        self._applied_behavior: tuple = (0,
+                                         self._merged_view(state.params))
         self._version = 0
         self._stop = threading.Event()
         self._error: Optional[BaseException] = None
@@ -156,8 +163,28 @@ class AsyncGRPOTrainer:
             pending = self._pending_publish
             self._pending_publish = None
         if pending is not None and self.publish_params is not None:
+            pending = (pending[0], self._folded_view(pending[1]))
             self.publish_params(pending[1])
             self._applied_behavior = pending
+
+    def _merged_view(self, params):
+        """Zero-copy full-policy view (dict union): what behavior-logp
+        recompute and no-publish collection consume — forward() applies
+        adapter leaves directly, so no weight fold is needed."""
+        if self.lora_base is None:
+            return params
+        from .lora import merge_lora
+        return merge_lora(self.lora_base, params)
+
+    def _folded_view(self, params):
+        """Materialized full weights — ONLY for actual publication to an
+        engine. Folding is O(full model); it runs at flush time so
+        latest-wins coalescing never burns a discarded fold, and at most
+        one folded copy is resident."""
+        if self.lora_base is None:
+            return params
+        from .lora import materialize_lora
+        return materialize_lora(self.lora_base, params, self.model_config)
 
     def _collect_loop(self) -> None:
         produced = 0
@@ -180,7 +207,8 @@ class AsyncGRPOTrainer:
                     # No publication channel: sessions read trainer state
                     # directly, so the live reference IS the behavior.
                     version = self._version
-                    params = self.state.params   # reference, not a copy
+                    # reference for full FT; zero-copy merge for LoRA
+                    params = self._merged_view(self.state.params)
                 t0 = time.monotonic()
                 trajectories, episodes = collect_group_trajectories(
                     self.make_session, self.tasks,
@@ -269,7 +297,7 @@ class AsyncGRPOTrainer:
                 self.state, self.model_config, self.mesh, tokens, mask,
                 rewards, group_ids, old_logp=old_logp,
                 grpo_config=self.grpo_config,
-                accum_steps=self.accum_steps)
+                accum_steps=self.accum_steps, lora_base=self.lora_base)
         self._version += 1
         if self.publish_params is not None:
             # Defer to the collector's next round boundary (latest wins):
@@ -278,6 +306,8 @@ class AsyncGRPOTrainer:
             # and params are staged TOGETHER so the collector's applied
             # snapshot is always a coherent pair.
             with self._publish_lock:
+                # adapters staged raw; the O(model) fold happens at
+                # flush (once per APPLIED publish, not per train round)
                 self._pending_publish = (self._version, self.state.params)
 
         out = {k: float(v) for k, v in metrics.items()}

@@ -102,6 +102,14 @@ def make_lora_train_state(config: ModelConfig, base_params: Params,
     ``train_step(..., lora_base=base_params)``. Adapters are replicated
     on the mesh (they are tiny; the base keeps its own shardings)."""
     from .lora import DEFAULT_TARGETS, init_lora
+    wq = base_params["layers"]["wq"]
+    expect = (config.num_layers, config.hidden_size, config.q_dim)
+    if tuple(wq.shape) != expect:
+        # adapter shapes come from config; a mismatched base would only
+        # explode later, deep inside the jitted step
+        raise ValueError(f"base_params do not match config "
+                         f"{config.name!r}: wq {tuple(wq.shape)} != "
+                         f"{expect}")
     lora = init_lora(config, key, rank=rank, alpha=alpha,
                      targets=targets or DEFAULT_TARGETS)
     if mesh is not None:

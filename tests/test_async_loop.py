@@ -135,3 +135,33 @@ def test_async_on_mesh_places_batches(rng):
     assert len(results) == 2
     for r in results:
         assert np.isfinite(r.metrics["loss"])
+
+
+def test_async_lora_trains_adapters_and_publishes_folded(rng):
+    """LoRA mode: the trainer steps ONLY the adapter tree; everything
+    leaving the trainer (published weights, behavior-logp params) is the
+    materialized full policy."""
+    from senweaver_ide_tpu.models import init_params
+    from senweaver_ide_tpu.training import make_lora_train_state
+
+    cfg = tiny_test()
+    base = init_params(cfg, jax.random.PRNGKey(0))
+    state = make_lora_train_state(cfg, base, jax.random.PRNGKey(1),
+                                  rank=4, learning_rate=0.05)
+    published = []
+    trainer = _make_trainer(state, cfg, rng, ppo_epochs=2,
+                            publish_params=lambda p: published.append(p),
+                            lora_base=base)
+    results = trainer.run(2)
+    assert len(results) == 2
+    for r in results:
+        assert np.isfinite(r.metrics["loss"])
+    # trainer state stays adapter-only
+    assert all("_lora_" in k for k in trainer.state.params["layers"])
+    # published weights are folded full policies (no adapter leaves)
+    assert published and not any("_lora_" in k
+                                 for k in published[-1]["layers"])
+    # the fold carries the trained delta: published wq = base wq + A@B
+    # with B != 0 after ppo_epochs=2 rounds of updates
+    assert not np.array_equal(np.asarray(base["layers"]["wq"]),
+                              np.asarray(published[-1]["layers"]["wq"]))

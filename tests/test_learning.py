@@ -26,3 +26,18 @@ def test_grpo_learning_curve_rises():
     assert report["learned"], report
     # The curve must end high in absolute terms, not just "less bad".
     assert report["reward_final"] > 0.3, report
+
+
+def test_lora_learning_curve_rises():
+    """Adapter-only GRPO (frozen base + rank-8 factors) must climb the
+    same curve — the single-chip 7B-class training path must not just
+    run, it must LEARN (training/lora.py)."""
+    # max_parallel=1 for deterministic sample streams (see above);
+    # max_new_tokens=8 — at 12-16 the rank-8/lr-0.1 adapters oscillate
+    # (observed: rises to 0.22 then dips), at 8 the curve climbs
+    # steadily: -0.58 -> 0.0 over 6 rounds on this exact config.
+    report = run_learning_eval(rounds=6, lr=0.1, group_size=12,
+                               max_new_tokens=8, ppo_epochs=2, seed=0,
+                               window=1, max_parallel=1, lora_rank=8)
+    assert report["config"]["lora_rank"] == 8
+    assert report["reward_final"] > report["reward_initial"] + 0.4, report

@@ -181,16 +181,21 @@ def test_qk_norm_roundtrip_and_cache_parity(tmp_path):
     cfg = dataclasses.replace(get_config("tiny-test"), name="tiny-qk",
                               qkv_bias=False, qk_norm=True)
     params = init_params(cfg, jax.random.PRNGKey(3))
-    # break the all-ones init so the round-trip actually checks values
+    # break the all-ones init with DISTINCT values per tensor so a
+    # q/k mapping swap in load/export cannot round-trip undetected
     import jax as _jax
     params["layers"]["q_norm"] = _jax.random.uniform(
         _jax.random.PRNGKey(4), params["layers"]["q_norm"].shape,
         minval=0.5, maxval=1.5)
+    params["layers"]["k_norm"] = _jax.random.uniform(
+        _jax.random.PRNGKey(5), params["layers"]["k_norm"].shape,
+        minval=0.5, maxval=1.5)
     export_hf_params(params, cfg, str(tmp_path))
     loaded = load_hf_params(str(tmp_path), cfg)
-    np.testing.assert_allclose(np.asarray(loaded["layers"]["q_norm"]),
-                               np.asarray(params["layers"]["q_norm"]),
-                               rtol=1e-6)
+    for name in ("q_norm", "k_norm"):
+        np.testing.assert_allclose(np.asarray(loaded["layers"][name]),
+                                   np.asarray(params["layers"][name]),
+                                   rtol=1e-6)
 
     toks = jax.random.randint(jax.random.PRNGKey(5), (2, 24), 0, 512)
     full, _ = forward(params, cfg, toks)
