@@ -115,6 +115,13 @@ def _artifact_summaries() -> dict:
     spec = read("SPEC_r03.json")
     if spec and "gain" in spec:
         out["speculative_acceptance_gain"] = spec["gain"]
+    ctx = read("LEARNING_CONTEXTUAL_SHORT_r03.json")
+    if ctx and "peak_window_mean" in ctx:
+        out["contextual_peak_window_mean"] = ctx["peak_window_mean"]
+        out["contextual_conditioned"] = ctx.get("conditioned")
+    lora = read("LEARNING_LORA_r03.json")
+    if lora and "uplift" in lora:
+        out["lora_learning_uplift"] = lora["uplift"]
     return out
 
 

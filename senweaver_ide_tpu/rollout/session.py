@@ -48,6 +48,7 @@ class RolloutSession:
                  skills: Optional[SkillService] = None,
                  apo_rules: Optional[List[str]] = None,
                  include_tool_definitions: bool = True,
+                 system_message_override: Optional[str] = None,
                  perf_monitor=None,
                  loop_sleep=None):
         self.client = client
@@ -64,6 +65,11 @@ class RolloutSession:
         # Tiny-window policies (tests, byte-level tokenizers) can skip the
         # ~6k-char tool-grammar section; real rollouts keep it.
         self.include_tool_definitions = include_tool_definitions
+        # Full replacement of the assembled system message (APO rules and
+        # skills catalog included) — for controlled experiments that need
+        # the prompt PREFIX pinned (e.g. eval_learning --short-prompt
+        # isolating prompt length from model capacity). None = assemble.
+        self.system_message_override = system_message_override
         self.history: List[ChatMessage] = []
         self._message_idx = 0
         self._wire_agent_tools()
@@ -157,6 +163,8 @@ class RolloutSession:
     # -- system message ----------------------------------------------------
     def system_message(self) -> str:
         import time as _time
+        if self.system_message_override is not None:
+            return self.system_message_override
         t0 = _time.monotonic()
         comp = get_composition(self.chat_mode)
         sysmsg = chat_system_message(

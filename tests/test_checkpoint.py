@@ -163,3 +163,36 @@ def test_uploader_failed_batch_not_marked():
     assert up.upload(traces) == 0
     up.transport = lambda b: True
     assert up.upload(traces) == 1
+
+
+def test_lora_state_roundtrip(tmp_path):
+    """Adapter-only TrainStates checkpoint and resume like any other —
+    the LoRA path inherits save/restore for free, but only a test proves
+    the tree (adapter leaves + masked-size opt state) survives."""
+    from senweaver_ide_tpu.models import get_config, init_params
+    from senweaver_ide_tpu.training import make_lora_train_state
+
+    config = get_config("tiny-test")
+    base = init_params(config, jax.random.PRNGKey(0))
+    state0 = make_lora_train_state(config, base, jax.random.PRNGKey(1),
+                                   rank=4, learning_rate=0.05)
+    b, s = 4, 16
+    state1, _ = train_step(state0, config, None,
+                           jnp.ones((b, s), jnp.int32),
+                           jnp.ones((b, s), jnp.bool_),
+                           jnp.linspace(-1, 1, b),
+                           jnp.zeros((b,), jnp.int32), lora_base=base)
+    mgr = CheckpointManager(str(tmp_path / "ck"), use_orbax=False)
+    mgr.save(state1)
+    restored, _ = mgr.restore(state0)
+    assert int(restored.step) == 1
+    for a, got in zip(jax.tree_util.tree_leaves(state1.params),
+                      jax.tree_util.tree_leaves(restored.params)):
+        assert jnp.allclose(jnp.asarray(a), jnp.asarray(got))
+    # resuming training from the restored adapters works
+    state2, metrics = train_step(restored, config, None,
+                                 jnp.ones((b, s), jnp.int32),
+                                 jnp.ones((b, s), jnp.bool_),
+                                 jnp.linspace(-1, 1, b),
+                                 jnp.zeros((b,), jnp.int32), lora_base=base)
+    assert jnp.isfinite(metrics["loss"])

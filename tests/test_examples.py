@@ -47,3 +47,10 @@ def test_online_cycle_example():
     r = _run("online_cycle.py", "--rounds", "2")
     assert r.returncode == 0, r.stderr[-2000:]
     assert "ONLINE CYCLE OK" in r.stdout
+
+
+def test_qlora_quickstart_example():
+    r = _run("qlora_quickstart.py", "--rounds", "1", "--rank", "4")
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "trainable adapter params" in r.stdout
+    assert "folded int8 policy" in r.stdout

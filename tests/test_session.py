@@ -121,3 +121,12 @@ def test_checkpoint_branching(session):
     session.jump_to_turn(0)
     assert session.history == []
     assert "def run():" in session.workspace.read_text("app.py")
+
+
+def test_system_message_override_pins_prompt(tmp_path):
+    s = RolloutSession(Client([]), str(tmp_path / "ws"),
+                       system_message_override="You are a byte emitter.")
+    try:
+        assert s.system_message() == "You are a byte emitter."
+    finally:
+        s.close()
