@@ -115,10 +115,12 @@ def _artifact_summaries() -> dict:
     spec = read("SPEC_r03.json")
     if spec and "gain" in spec:
         out["speculative_acceptance_gain"] = spec["gain"]
-    ctx = read("LEARNING_CONTEXTUAL_SHORT_r03.json")
+    ctx = read("LEARNING_CONTEXTUAL_ANCHORED_r03.json") or read(
+        "LEARNING_CONTEXTUAL_SHORT_r03.json")
     if ctx and "peak_window_mean" in ctx:
         out["contextual_peak_window_mean"] = ctx["peak_window_mean"]
         out["contextual_conditioned"] = ctx.get("conditioned")
+        out["contextual_final"] = ctx.get("reward_final")
     lora = read("LEARNING_LORA_r03.json")
     if lora and "uplift" in lora:
         out["lora_learning_uplift"] = lora["uplift"]

@@ -34,7 +34,9 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       contextual: bool = False,
                       model: str = "tiny-test",
                       lora_rank: int = 0,
-                      short_prompt: bool = False) -> dict:
+                      short_prompt: bool = False,
+                      anchor_kl: float = 0.0,
+                      anchor_every: int = 5) -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -117,8 +119,14 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
     # collapses into one task's unconditional bias, the starved task's
     # rewards go uniform, and its advantage signal vanishes (observed;
     # see ROUND3_NOTES.md §16).
-    gcfg = GRPOConfig(kl_coef=0.0,
+    # anchor_kl > 0: k3-KL toward a ROLLING snapshot of the policy
+    # (refreshed every anchor_every rounds) — the stabilizer for the
+    # conditioning collapse observed in long unanchored contextual runs
+    # (ROUND3_NOTES.md §23): the anchor lets the policy keep improving
+    # slowly but penalizes rapid drift away from its recent self.
+    gcfg = GRPOConfig(kl_coef=anchor_kl,
                       entropy_coef=0.02 if contextual else 0.0)
+    anchor = serving_params(state.params) if anchor_kl > 0 else None
 
     curve = []
     per_task = []
@@ -129,12 +137,19 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                          pad_id=tok.pad_id, max_len=2048,
                          grpo_config=gcfg,
                          ppo_epochs=ppo_epochs, max_parallel=max_parallel,
-                         reward_override=reward, lora_base=lora_base)
+                         reward_override=reward, lora_base=lora_base,
+                         ref_params=anchor)
         state = out.state
         # Publish the updated weights to the serving engine — the same
         # actor/learner weight sync the async trainer does at round
         # boundaries; without it every round samples the initial policy.
-        engine.update_params(serving_params(state.params))
+        served = serving_params(state.params)
+        engine.update_params(served)
+        # anchor_every=0 means a FIXED anchor (never refreshed); the
+        # refresh reuses the already-folded serving view
+        if (anchor is not None and anchor_every > 0
+                and (r + 1) % anchor_every == 0):
+            anchor = served
         by_task = [[e.reward for e in out.episodes if e.task_idx == i]
                    for i in range(len(tasks))]
         means = [sum(v) / max(len(v), 1) for v in by_task]
@@ -157,7 +172,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                    "max_new_tokens": max_new_tokens,
                    "ppo_epochs": ppo_epochs, "seed": seed,
                    "contextual": contextual, "model": model,
-                   "lora_rank": lora_rank, "short_prompt": short_prompt},
+                   "lora_rank": lora_rank, "short_prompt": short_prompt,
+                   "anchor_kl": anchor_kl, "anchor_every": anchor_every},
         "wall_s": round(time.monotonic() - t0, 1),
     }
     if contextual:
@@ -195,6 +211,11 @@ def main() -> None:
     ap.add_argument("--contextual", action="store_true",
                     help="two contrastive tasks: the policy must learn "
                          "prompt-CONDITIONAL emission, not a global bias")
+    ap.add_argument("--anchor-kl", type=float, default=0.0,
+                    help="k3-KL coefficient toward a rolling policy "
+                         "snapshot (0 = unanchored)")
+    ap.add_argument("--anchor-every", type=int, default=5,
+                    help="rounds between anchor refreshes")
     ap.add_argument("--short-prompt", action="store_true",
                     help="pin a ~30-byte system message (isolates prompt "
                          "length from capacity in the contextual mode)")
@@ -223,7 +244,9 @@ def main() -> None:
                                ppo_epochs=args.ppo_epochs, seed=args.seed,
                                contextual=args.contextual,
                                model=args.model, lora_rank=args.lora_rank,
-                               short_prompt=args.short_prompt)
+                               short_prompt=args.short_prompt,
+                               anchor_kl=args.anchor_kl,
+                               anchor_every=args.anchor_every)
     print(json.dumps(report))
 
 

@@ -67,8 +67,11 @@ def reward(task_idx, g, session):
 
 
 for r in range(args.rounds):
+    # max_len must hold the FULL sampled context (~1.9k-byte assembled
+    # prompt + completion): truncating below it would recompute train
+    # logps on a different context than the recorded behavior logps
     out = grpo_round(state, cfg, None, make_session, ["write ascii"],
-                     group_size=8, pad_id=tok.pad_id, max_len=1024,
+                     group_size=8, pad_id=tok.pad_id, max_len=2048,
                      reward_override=reward, ppo_epochs=2, lora_base=base)
     state = out.state
     engine.update_params(materialize_lora(base, state.params, cfg))

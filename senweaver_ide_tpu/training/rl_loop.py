@@ -133,6 +133,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                perf_monitor=None,
                engine=None,
                lora_base=None,
+               ref_params=None,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -157,7 +158,8 @@ def grpo_round(state: TrainState, model_config, mesh,
             group_size=group_size, pad_id=pad_id, max_len=max_len,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
-            perf_monitor=perf_monitor, engine=engine, lora_base=lora_base)
+            perf_monitor=perf_monitor, engine=engine, lora_base=lora_base,
+            ref_params=ref_params)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
@@ -165,7 +167,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      reward_override, max_parallel, accum_steps=1,
                      ppo_epochs=1, metrics_service=None,
                      perf_monitor=None, engine=None,
-                     lora_base=None) -> RoundResult:
+                     lora_base=None, ref_params=None) -> RoundResult:
     import time as _time
     t0 = _time.monotonic()
     trajectories, episodes = collect_group_trajectories(
@@ -213,12 +215,23 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             perf_monitor.record_ms("behavior_logp",
                                    (_time.monotonic() - t_b) * 1000.0)
     old = old_logp
+    # Anchored training: a frozen REFERENCE policy (e.g. a rolling
+    # snapshot of the serving params a few rounds back) supplies
+    # ref_logp for the k3 KL term — the stabilizer against the observed
+    # conditioning collapse under long unanchored runs
+    # (ROUND3_NOTES.md §23). ref_params must be a FULL policy tree
+    # (callers using LoRA pass the materialized/merged view).
+    ref = None
+    if ref_params is not None and grpo_config.kl_coef > 0.0:
+        from .async_loop import behavior_logp_batched
+        ref = behavior_logp_batched(ref_params, model_config, tokens,
+                                    accum_steps)
     t1 = _time.monotonic()
     for _ in range(ppo_epochs):
         state, metrics = train_step(
             state, model_config, mesh, tokens, mask, rewards, group_ids,
-            old_logp=old, grpo_config=grpo_config, accum_steps=accum_steps,
-            lora_base=lora_base)
+            old_logp=old, ref_logp=ref, grpo_config=grpo_config,
+            accum_steps=accum_steps, lora_base=lora_base)
     out_metrics = {k: float(v) for k, v in metrics.items()}
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step",

@@ -307,3 +307,35 @@ def test_entropy_bonus_engages():
                        grpo_config=GRPOConfig(entropy_coef=0.1),
                        accum_steps=2)
     assert "entropy" in m2 and np.isfinite(float(m2["entropy"]))
+
+
+def test_grpo_round_anchored_reference(tmp_path, tiny_stack):
+    """ref_params + kl_coef engage the k3-KL term inside the round: on
+    the FIRST update the policy equals the anchor, so kl must be ~0 and
+    the update must still be finite (the stabilizer for long contextual
+    runs, ROUND3_NOTES.md §23)."""
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+    config, state = tiny_stack
+    tok = ByteTokenizer()
+
+    def make_session():
+        engine = RolloutEngine(state.params, config, num_slots=2,
+                               max_len=4096, eos_id=tok.eos_id, seed=3)
+        client = EnginePolicyClient(engine, tok, model_name="tiny-test",
+                                    default_max_new_tokens=6,
+                                    record_calls=True)
+        return RolloutSession(client, str(tmp_path / "anch"),
+                              include_tool_definitions=False)
+
+    def reward(task_idx, g, session):
+        return 1.0 if g % 2 == 0 else -1.0
+
+    out = grpo_round(state, config, None, make_session, ["task"],
+                     group_size=2, pad_id=tok.pad_id, max_len=2048,
+                     reward_override=reward,
+                     grpo_config=GRPOConfig(kl_coef=0.05),
+                     ref_params=state.params)
+    assert np.isfinite(out.metrics["loss"])
+    # policy == anchor on the first update: k3 KL at the sampled tokens
+    # is 0 up to numerical noise
+    assert abs(out.metrics["kl"]) < 1e-3, out.metrics
