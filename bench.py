@@ -115,9 +115,10 @@ def _artifact_summaries() -> dict:
     spec = read("SPEC_r03.json")
     if spec and "gain" in spec:
         out["speculative_acceptance_gain"] = spec["gain"]
-    ctx = read("LEARNING_CONTEXTUAL_ANCHORED_r03.json") or read(
-        "LEARNING_CONTEXTUAL_SHORT_r03.json")
-    if ctx and "peak_window_mean" in ctx:
+    ctx = next((c for c in (read("LEARNING_CONTEXTUAL_ANCHORED_r03.json"),
+                            read("LEARNING_CONTEXTUAL_SHORT_r03.json"))
+                if c and "peak_window_mean" in c), None)
+    if ctx:
         out["contextual_peak_window_mean"] = ctx["peak_window_mean"]
         out["contextual_conditioned"] = ctx.get("conditioned")
         out["contextual_final"] = ctx.get("reward_final")

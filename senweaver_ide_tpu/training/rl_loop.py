@@ -224,8 +224,12 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     ref = None
     if ref_params is not None and grpo_config.kl_coef > 0.0:
         from .async_loop import behavior_logp_batched
+        t_r = _time.monotonic()
         ref = behavior_logp_batched(ref_params, model_config, tokens,
                                     accum_steps)
+        if perf_monitor is not None:
+            perf_monitor.record_ms("ref_logp",
+                                   (_time.monotonic() - t_r) * 1000.0)
     t1 = _time.monotonic()
     for _ in range(ppo_epochs):
         state, metrics = train_step(
