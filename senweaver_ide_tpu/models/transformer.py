@@ -215,15 +215,15 @@ def _self_attention(c: ModelConfig, q, k, v, kv_mask, mesh):
     if c.attn_impl == "einsum":
         return attention(q, k, v, q_offset=0, kv_mask=kv_mask, causal=True,
                          window=c.sliding_window)
-    if c.sliding_window is not None:
-        raise NotImplementedError(
-            f"sliding_window is implemented for attn_impl='einsum' only "
-            f"(got {c.attn_impl!r}); the flash/ring kernels would silently "
-            f"attend outside the window")
     if c.attn_impl == "flash":
         from ..ops.flash_attention import flash_attention
         return flash_attention(q, k, v, q_offset=0, kv_mask=kv_mask,
-                               causal=True)
+                               causal=True, window=c.sliding_window)
+    if c.sliding_window is not None:
+        raise NotImplementedError(
+            f"sliding_window is implemented for attn_impl='einsum'/'flash' "
+            f"only (got {c.attn_impl!r}); the ring kernels would silently "
+            f"attend outside the window")
     if c.attn_impl in ("ring", "ulysses"):
         from ..parallel.ring_attention import (make_ring_attention,
                                                make_ulysses_attention)

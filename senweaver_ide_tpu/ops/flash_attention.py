@@ -39,7 +39,8 @@ from .attention import NEG_INF
 
 
 def _fa_kernel(offsets_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-               acc_ref, m_ref, l_ref, *, causal: bool, scale: float,
+               acc_ref, m_ref, l_ref, *, causal: bool,
+               window: Optional[int], scale: float,
                block_q: int, block_kv: int):
     """One (batch, head, q-block) program; innermost grid axis = KV block."""
     ki = pl.program_id(3)
@@ -68,6 +69,10 @@ def _fa_kernel(offsets_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
             mask = (k_start + cols) <= (q_start + rows)
+            if window is not None:
+                # SWA: kv in (q - window, q] (ops/attention.py semantics)
+                mask = jnp.logical_and(
+                    mask, (k_start + cols) > (q_start + rows) - window)
             s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]                                # (block_q, 1)
@@ -82,8 +87,13 @@ def _fa_kernel(offsets_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
         m_ref[:] = m_new
 
     if causal:
-        # Skip KV blocks strictly after the q-block's last row.
-        pl.when(k_start <= q_start + block_q - 1)(_compute)
+        # Skip KV blocks strictly after the q-block's last row — and,
+        # under SWA, blocks entirely before every row's window.
+        live = k_start <= q_start + block_q - 1
+        if window is not None:
+            live = jnp.logical_and(
+                live, k_start + block_kv - 1 >= q_start - window + 1)
+        pl.when(live)(_compute)
     else:
         _compute()
 
@@ -96,8 +106,8 @@ def _fa_kernel(offsets_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
         lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
-def _fa_forward(q, k, v, bias, offsets, *, causal, block_q, block_kv,
-                interpret) -> Tuple[jax.Array, jax.Array]:
+def _fa_forward(q, k, v, bias, offsets, *, causal, window, block_q,
+                block_kv, interpret) -> Tuple[jax.Array, jax.Array]:
     """Pallas forward in (B, H, S, D) layout. bias (B, Skv) fp32 additive;
     offsets (2,) int32 [q_offset, kv_offset]. S axes must be multiples of the
     block sizes (wrapper pads). Returns (out (B,Hq,Sq,D), lse (B,Hq,Sq))."""
@@ -110,7 +120,8 @@ def _fa_forward(q, k, v, bias, offsets, *, causal, block_q, block_kv,
     # equal to the array dims — give bias/lse a singleton sublane axis.
     bias3 = bias[:, None, :]                              # (B, 1, Skv)
 
-    kernel = functools.partial(_fa_kernel, causal=causal, scale=scale,
+    kernel = functools.partial(_fa_kernel, causal=causal, window=window,
+                               scale=scale,
                                block_q=block_q, block_kv=block_kv)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -157,7 +168,7 @@ def _fa_forward(q, k, v, bias, offsets, *, causal, block_q, block_kv,
 
 
 def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
-                           block_kv):
+                           window, block_kv):
     """Blockwise flash backward in (B, H, S, D) layout: ``lax.scan`` over KV
     blocks, recomputing p = exp(s − lse) per block. fp32 throughout."""
     b, hq, sq, d = q.shape
@@ -193,6 +204,9 @@ def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
                 k_pos = (offsets[1] + ki * block_kv
                          + jnp.arange(block_kv, dtype=jnp.int32))
                 mask = k_pos[None, :] <= q_pos[:, None]      # (Sq, block_kv)
+                if window is not None:
+                    mask = jnp.logical_and(
+                        mask, k_pos[None, :] > q_pos[:, None] - window)
                 s = jnp.where(mask[None, None, None, :, :], s, NEG_INF)
             # Same fully-masked guard as the forward kernel (lse == NEG_INF).
             p = jnp.where(s > _MASKED, jnp.exp(s - lse_g[..., None]), 0.0)
@@ -213,8 +227,14 @@ def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
 
         if causal:
             # Mirror the forward kernel's block skip: a KV block strictly
-            # after the last query position contributes nothing (p == 0).
+            # after the last query position contributes nothing (p == 0);
+            # under SWA, nor does one entirely before every window.
             block_live = (offsets[1] + ki * block_kv) <= (offsets[0] + sq - 1)
+            if window is not None:
+                block_live = jnp.logical_and(
+                    block_live,
+                    offsets[1] + ki * block_kv + block_kv - 1
+                    >= offsets[0] - window + 1)
             dq, dk_blk, dv_blk = jax.lax.cond(block_live, compute, skip, dq)
         else:
             dq, dk_blk, dv_blk = compute(dq)
@@ -232,17 +252,19 @@ def _fa_backward_blockwise(q, k, v, bias, offsets, out, lse, g, *, causal,
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash_fn(causal: bool, block_q: int, block_kv: int,
-                   interpret: bool):
+def _make_flash_fn(causal: bool, window: Optional[int], block_q: int,
+                   block_kv: int, interpret: bool):
     @jax.custom_vjp
     def fa(q, k, v, bias, offsets):
         out, _ = _fa_forward(q, k, v, bias, offsets, causal=causal,
+                             window=window,
                              block_q=block_q, block_kv=block_kv,
                              interpret=interpret)
         return out
 
     def fwd(q, k, v, bias, offsets):
         out, lse = _fa_forward(q, k, v, bias, offsets, causal=causal,
+                               window=window,
                                block_q=block_q, block_kv=block_kv,
                                interpret=interpret)
         return out, (q, k, v, bias, offsets, out, lse)
@@ -251,7 +273,7 @@ def _make_flash_fn(causal: bool, block_q: int, block_kv: int,
         q, k, v, bias, offsets, out, lse = res
         dq, dk, dv, dbias = _fa_backward_blockwise(
             q, k, v, bias, offsets, out, lse, g, causal=causal,
-            block_kv=block_kv)
+            window=window, block_kv=block_kv)
         return dq, dk, dv, dbias, None
 
     fa.defvjp(fwd, bwd)
@@ -281,16 +303,21 @@ def flash_attention(
     kv_offset=0,
     kv_mask: Optional[jax.Array] = None,  # (B, Skv) True = valid
     causal: bool = True,
+    window: Optional[int] = None,         # SWA width: kv in (q-window, q]
     block_q: int = 128,
     block_kv: int = 128,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in replacement for ``ops.attention.attention``, plus
-    ``kv_offset`` for rotated KV chunks (ring attention). Pads both sequence
-    axes to block multiples internally; offsets may be traced scalars.
-    Returns (B, Sq, Hq, D) in q.dtype."""
+    ``kv_offset`` for rotated KV chunks (ring attention) and ``window``
+    (sliding-window attention — in-kernel band mask with block skipping
+    on BOTH edges, so FLOPs scale with window, not sequence). Pads both
+    sequence axes to block multiples internally; offsets may be traced
+    scalars. Returns (B, Sq, Hq, D) in q.dtype."""
     b, sq, hq, d = q.shape
     skv = k.shape[1]
+    if window is not None and not causal:
+        raise ValueError("sliding window requires causal attention")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, _round_up(sq, 16))
@@ -309,6 +336,6 @@ def flash_attention(
     offsets = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                          jnp.asarray(kv_offset, jnp.int32)])
 
-    fa = _make_flash_fn(causal, block_q, block_kv, interpret)
+    fa = _make_flash_fn(causal, window, block_q, block_kv, interpret)
     out = fa(qt, kt, vt, bias, offsets)
     return out[:, :, :sq].transpose(0, 2, 1, 3)

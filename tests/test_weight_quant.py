@@ -144,3 +144,30 @@ def test_export_hf_rejects_int8(tmp_path):
     c, params, _ = _setup()
     with pytest.raises(TypeError, match="serving"):
         export_hf_params(quantize_weights_int8(params), c, str(tmp_path))
+
+
+def test_int8_weights_with_flash_decode():
+    """The two serving accelerators compose: int8 weight matmuls with
+    the flash-decode cache kernel (interpret mode on CPU)."""
+    c, params, toks = _setup()
+    c = dataclasses.replace(c, decode_attn_impl="flash")
+    qp = quantize_weights_int8(params)
+    cache = init_kv_cache(c, 2, 128)      # 128-aligned: flash engages
+    logits, cache = forward(qp, c, toks[:, :16], cache=cache,
+                            fresh_cache=True)
+    outs = [logits[:, -1]]
+    for i in range(16, 24):
+        step, cache = forward(qp, c, toks[:, i:i + 1], cache=cache)
+        outs.append(step[:, -1])
+    einsum_cfg = dataclasses.replace(c, decode_attn_impl="einsum")
+    cache2 = init_kv_cache(einsum_cfg, 2, 128)
+    logits2, cache2 = forward(qp, einsum_cfg, toks[:, :16], cache=cache2,
+                              fresh_cache=True)
+    outs2 = [logits2[:, -1]]
+    for i in range(16, 24):
+        step2, cache2 = forward(qp, einsum_cfg, toks[:, i:i + 1],
+                                cache=cache2)
+        outs2.append(step2[:, -1])
+    np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
+                               np.asarray(jnp.stack(outs2, 1)),
+                               atol=3e-4, rtol=3e-4)
