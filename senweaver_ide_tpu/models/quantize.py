@@ -9,12 +9,14 @@ error; the MXU still computes in the activation dtype (the int8→bf16
 upcast happens at tile load, the scale is a fused output epilogue — see
 ``transformer._dense``).
 
-Scope: the seven stacked per-layer dense matrices + ``lm_head``.
-Excluded on purpose:
+Scope: the seven stacked per-layer dense matrices + ``lm_head`` +
+the 4-D MoE expert banks (per-expert per-output-channel scales — with
+expert parallelism this is what fits Mixtral-class weights on a small
+pod slice). Excluded on purpose:
   - norms/biases (tiny, precision-critical),
-  - ``embed`` (a gather, not a matmul; tied-head quality is sensitive),
-  - MoE expert banks (4-D; routed access patterns want their own
-    per-expert treatment — future work).
+  - the MoE router (routing decisions are precision-sensitive and the
+    matrix is tiny),
+  - ``embed`` (a gather, not a matmul; tied-head quality is sensitive).
 
 This is a SERVING transform: quantized params are not differentiable
 and must never enter ``train_step``. The actor/learner bridge
@@ -47,13 +49,16 @@ def _quantize_matrix(w: jax.Array):
 def quantize_weights_int8(params: Dict) -> Dict:
     """Return a new param pytree with dense weights int8-quantized.
 
-    Idempotent (already-int8 tensors pass through); MoE banks (ndim 4)
-    and anything outside QUANTIZABLE are left untouched."""
+    Idempotent (already-int8 tensors pass through); anything outside
+    QUANTIZABLE (router, norms, biases, embed) is left untouched."""
     out = dict(params)
     layers = dict(params["layers"])
     for name in QUANTIZABLE:
         w = layers.get(name)
-        if w is None or w.dtype == jnp.int8 or w.ndim != 3:
+        # 3-D: stacked dense (L, in, out); 4-D: stacked MoE expert banks
+        # (L, E, in, out) — _quantize_matrix is rank-generic (absmax
+        # over the contraction axis -2, scales (..., out)).
+        if w is None or w.dtype == jnp.int8 or w.ndim not in (3, 4):
             continue
         layers[name], layers[name + "_scale"] = _quantize_matrix(w)
     out["layers"] = layers

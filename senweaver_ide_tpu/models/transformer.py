@@ -397,6 +397,9 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                             dtype=c.dtype)
         moe_params = {"router": lp["router"], "w_gate": lp["w_gate"],
                       "w_up": lp["w_up"], "w_down": lp["w_down"]}
+        for _n in ("w_gate_scale", "w_up_scale", "w_down_scale"):
+            if _n in lp:       # int8 expert banks (models/quantize.py)
+                moe_params[_n] = lp[_n]
         ffn_out, aux = moe_ffn(moe_params, moe_cfg, h)
         return x + ffn_out, kv_out, aux
     gate = _dense(h, lp, "w_gate", "bsd,df->bsf")

@@ -105,6 +105,31 @@ def _expert_ffn(w_gate, w_up, w_down, h):
     return jnp.einsum("...f,fd->...d", act, w_down)
 
 
+def _expert_ffn_q(w_gate, g_s, w_up, u_s, w_down, d_s, h):
+    """int8 expert bank variant (models/quantize.py): upcast at use,
+    per-output-channel scale as a fused epilogue — halves the expert
+    HBM each routed batch streams."""
+    def mm(h_, w, s_, spec):
+        out = jnp.einsum(spec, h_, w.astype(h_.dtype))
+        return (out.astype(jnp.float32) * s_).astype(h_.dtype)
+
+    gate = mm(h, w_gate, g_s, "...d,df->...f")
+    up = mm(h, w_up, u_s, "...d,df->...f")
+    act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+    return mm(act, w_down, d_s, "...f,fd->...d")
+
+
+def _run_experts(params: MoEParams, expert_in: jax.Array) -> jax.Array:
+    """vmap over experts, int8-aware (both moe_ffn paths share it)."""
+    if params["w_gate"].dtype == jnp.int8:
+        return jax.vmap(_expert_ffn_q)(
+            params["w_gate"], params["w_gate_scale"],
+            params["w_up"], params["w_up_scale"],
+            params["w_down"], params["w_down_scale"], expert_in)
+    return jax.vmap(_expert_ffn)(params["w_gate"], params["w_up"],
+                                 params["w_down"], expert_in)
+
+
 def _capacity(cfg: MoEConfig, tokens: int) -> int:
     return max(1, math.ceil(tokens / cfg.num_experts
                             * cfg.capacity_factor * cfg.top_k))
@@ -120,8 +145,7 @@ def moe_ffn(params: MoEParams, cfg: MoEConfig,
     dispatch, combine, aux = _route(cfg, params["router"], x_flat, C)
     expert_in = jnp.einsum("tec,td->ecd", dispatch,
                            x_flat.astype(jnp.float32)).astype(x.dtype)
-    expert_out = jax.vmap(_expert_ffn)(params["w_gate"], params["w_up"],
-                                       params["w_down"], expert_in)
+    expert_out = _run_experts(params, expert_in)
     y = jnp.einsum("tec,ecd->td", combine,
                    expert_out.astype(jnp.float32))
     return y.reshape(b, s, d).astype(x.dtype), aux
@@ -141,7 +165,9 @@ def moe_ffn_sharded(params: MoEParams, cfg: MoEConfig, x: jax.Array, *,
         raise ValueError(f"num_experts {E} not divisible by ep={ep}")
     b, s, d = x.shape
 
-    def fn(router, w_gate, w_up, w_down, x_local):
+    quantized = params["w_gate"].dtype == jnp.int8
+
+    def fn(router, w_gate, w_up, w_down, x_local, *scales):
         # x_local: (B/ep, S, D); local experts: (E/ep, D, F).
         bl = x_local.shape[0]
         t_local = bl * s
@@ -157,8 +183,11 @@ def moe_ffn_sharded(params: MoEParams, cfg: MoEConfig, x: jax.Array, *,
         buf = jax.lax.all_to_all(buf, "ep", split_axis=0, concat_axis=1,
                                  tiled=False)              # (E/ep, ep, C, D)
         buf = buf.reshape(E // ep, ep * C, d)
-        out = jax.vmap(_expert_ffn)(w_gate, w_up, w_down,
-                                    buf)                   # (E/ep, ep·C, D)
+        local = {"w_gate": w_gate, "w_up": w_up, "w_down": w_down}
+        if quantized:
+            local["w_gate_scale"], local["w_up_scale"], \
+                local["w_down_scale"] = scales
+        out = _run_experts(local, buf)                     # (E/ep, ep·C, D)
         # Return trip: back to token shards.
         out = out.reshape(E // ep, ep, C, d)
         out = jax.lax.all_to_all(out, "ep", split_axis=1, concat_axis=0,
@@ -168,10 +197,16 @@ def moe_ffn_sharded(params: MoEParams, cfg: MoEConfig, x: jax.Array, *,
         aux = jax.lax.pmean(aux, "ep")
         return y.reshape(bl, s, d).astype(x_local.dtype), aux
 
+    args = [params["router"], params["w_gate"], params["w_up"],
+            params["w_down"], x]
+    in_specs = [P(), P("ep"), P("ep"), P("ep"), P("ep")]
+    if quantized:
+        # per-expert scales shard over 'ep' exactly like their banks
+        args += [params["w_gate_scale"], params["w_up_scale"],
+                 params["w_down_scale"]]
+        in_specs += [P("ep"), P("ep"), P("ep")]
     out, aux = shard_map(
         fn, mesh=mesh,
-        in_specs=(P(), P("ep"), P("ep"), P("ep"), P("ep")),
-        out_specs=(P("ep"), P()), check_rep=False)(
-        params["router"], params["w_gate"], params["w_up"],
-        params["w_down"], x)
+        in_specs=tuple(in_specs),
+        out_specs=(P("ep"), P()), check_rep=False)(*args)
     return out, aux

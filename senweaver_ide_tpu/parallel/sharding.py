@@ -72,6 +72,14 @@ MOE_PARAM_SPECS: Dict[str, P] = {
     "layers/w_down": P(None, "ep", "tp", "fsdp"),
 }
 
+# int8 MoE banks: (L, E, out) scales follow the bank's expert + OUTPUT
+# axes (distinguished from the 2-axis dense scales by ndim).
+MOE_SCALE_SPECS: Dict[str, P] = {
+    "layers/w_gate_scale": P(None, "ep", "tp"),
+    "layers/w_up_scale": P(None, "ep", "tp"),
+    "layers/w_down_scale": P(None, "ep", "fsdp"),
+}
+
 # Activation specs.
 ACT_SPEC = P(("dp", "fsdp"), "sp", None)          # (B, S, D)
 LOGITS_SPEC = P(("dp", "fsdp"), "sp", "tp")       # (B, S, V)
@@ -98,6 +106,8 @@ def restrict_spec(spec: P, mesh: Mesh) -> P:
 def spec_for_path(path: str, ndim: int = -1) -> P:
     if path in MOE_PARAM_SPECS and ndim == 4:
         return MOE_PARAM_SPECS[path]
+    if path in MOE_SCALE_SPECS and ndim == 3:
+        return MOE_SCALE_SPECS[path]
     if path in PARAM_SPECS:
         return PARAM_SPECS[path]
     raise KeyError(f"no sharding rule for param path {path!r}")

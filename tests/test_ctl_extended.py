@@ -337,3 +337,39 @@ def test_ctl_version():
                           capture_output=True, text=True, timeout=10)
     assert proc.returncode == 0
     assert proc.stdout.startswith("senweaver-ctl ")
+
+
+@needs_native
+def test_ctl_drives_onboarding(tmp_path, auth_server):
+    """The C++ CLI walks the onboarding wizard over the control socket —
+    the operator's first-run path end to end through the native binary."""
+    from senweaver_ide_tpu.services.config import RuntimeConfig
+    from senweaver_ide_tpu.services.onboarding import (
+        OnboardingService, install_onboarding_channel)
+
+    cfg = RuntimeConfig(settings_path=str(tmp_path / "settings.json"))
+    ob = OnboardingService(cfg, state_path=str(tmp_path / "ob.json"),
+                           accelerator_probe=lambda: False)
+    install_onboarding_channel(auth_server, ob)
+    tok = tmp_path / "tok"
+    tok.write_text("sekrit\n")
+
+    rc, out = _ctl(auth_server, "call", "onboarding.status", "{}",
+                   token_file=tok)
+    assert rc == 0 and out["result"]["current"] == "workspace"
+    rc, out = _ctl(auth_server, "call", "onboarding.answer",
+                   json.dumps({"step": "workspace",
+                               "value": str(tmp_path / "ws")}),
+                   token_file=tok)
+    assert rc == 0
+    assert out["result"]["answers"]["workspace"] == str(tmp_path / "ws")
+    rc, out = _ctl(auth_server, "call", "onboarding.answer",
+                   json.dumps({"step": "model", "value": "qwen3-1.7b"}),
+                   token_file=tok)
+    assert rc == 0 and cfg.get("model.preset") == "qwen3-1.7b"
+    # invalid answers surface as RPC errors (nonzero exit), state intact
+    rc, out = _ctl(auth_server, "call", "onboarding.answer",
+                   json.dumps({"step": "model", "value": "gpt-17"}),
+                   token_file=tok)
+    assert rc != 0
+    assert cfg.get("model.preset") == "qwen3-1.7b"

@@ -76,17 +76,32 @@ def test_untied_head_quantized():
     assert rel < 0.05, rel
 
 
-def test_moe_banks_left_alone():
+def test_moe_banks_quantized_router_fp():
+    """Expert banks quantize (per-expert per-channel scales); the tiny
+    precision-sensitive router stays fp; the routed forward stays close
+    to full precision."""
     c = get_config("tiny-moe-test")
     params = init_params(c, jax.random.PRNGKey(0))
     qp = quantize_weights_int8(params)
-    # attention quantizes; 4-D expert banks and router stay fp
     assert qp["layers"]["wq"].dtype == jnp.int8
-    assert qp["layers"]["w_gate"].dtype == c.dtype
-    toks = jnp.ones((1, 8), jnp.int32)
+    assert qp["layers"]["w_gate"].dtype == jnp.int8
+    assert qp["layers"]["w_gate_scale"].shape == qp["layers"][
+        "w_gate"].shape[:2] + qp["layers"]["w_gate"].shape[-1:]
+    assert qp["layers"]["router"].dtype == c.dtype
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                              c.vocab_size, dtype=jnp.int32)
     ref, _ = forward(params, c, toks)
     got, _ = forward(qp, c, toks)
-    assert np.isfinite(np.asarray(got)).all()
+    # top-k routing is DISCONTINUOUS: the int8 perturbation flips expert
+    # assignment for borderline tokens, so the norm metric is dominated
+    # by a few rerouted positions (observed rel ≈ 0.13 on this random
+    # tiny model). The serving-relevant metric is argmax agreement.
+    rel = (np.linalg.norm(np.asarray(got) - np.asarray(ref))
+           / np.linalg.norm(np.asarray(ref)))
+    assert rel < 0.25, rel
+    agree = np.mean(np.asarray(got).argmax(-1)
+                    == np.asarray(ref).argmax(-1))
+    assert agree > 0.85, agree
 
 
 def test_engine_republish_requantizes():
@@ -171,3 +186,23 @@ def test_int8_weights_with_flash_decode():
     np.testing.assert_allclose(np.asarray(jnp.stack(outs, 1)),
                                np.asarray(jnp.stack(outs2, 1)),
                                atol=3e-4, rtol=3e-4)
+
+
+def test_all_serving_levers_compose():
+    """The max-memory-efficiency serving config: sliding-window RING
+    cache + int8 KV quantization + int8 weights + flash decode, through
+    the engine (the one-16GB-chip 7B posture, every lever at once)."""
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    c = dataclasses.replace(get_config("tiny-test"), sliding_window=128,
+                            kv_quant=True, decode_attn_impl="flash",
+                            max_seq_len=512)
+    params = quantize_weights_int8(init_params(c, jax.random.PRNGKey(0)))
+    engine = RolloutEngine(params, c, num_slots=2, max_len=128,
+                           eos_id=None, seed=0)
+    rid = engine.submit(list(range(1, 40)), max_new_tokens=110)
+    out = engine.run()
+    # decode proceeds PAST the ring capacity (modular writes) and stays
+    # finite/int-valued the whole way
+    assert len(out[rid]) == 110
+    st = engine.stats()
+    assert st["weight_quant"] == 1
