@@ -2,8 +2,9 @@ from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 from .trainer import (TrainState, make_lora_train_state, make_optimizer,
                       make_train_state, train_step)
-from .lora import (init_lora, lora_param_count, materialize_lora,
-                   merge_lora, split_lora)
+from .lora import (export_peft_adapter, init_lora, load_peft_adapter,
+                   lora_param_count, materialize_lora, merge_lora,
+                   split_lora)
 from .checkpoint import CheckpointManager
 from .data import (Trajectory, TrajectoryDataset, make_batch,
                    make_batch_logps)

@@ -129,5 +129,152 @@ def lora_param_count(lora: Dict) -> int:
     return sum(int(x.size) for x in jax.tree_util.tree_leaves(lora))
 
 
-__all__ = ["DEFAULT_TARGETS", "init_lora", "lora_param_count",
-           "materialize_lora", "merge_lora", "split_lora", "is_quantized"]
+# HF module names for each target (PEFT adapter layout).
+_PEFT_MODULES = {
+    "wq": "self_attn.q_proj", "wk": "self_attn.k_proj",
+    "wv": "self_attn.v_proj", "wo": "self_attn.o_proj",
+    "w_gate": "mlp.gate_proj", "w_up": "mlp.up_proj",
+    "w_down": "mlp.down_proj",
+}
+
+# Hub repo ids for the local presets — what a PEFT runtime needs in
+# adapter_config.json to resolve the base checkpoint.
+_HF_REPO_IDS = {
+    "qwen2.5-coder-0.5b": "Qwen/Qwen2.5-Coder-0.5B",
+    "qwen2.5-coder-1.5b": "Qwen/Qwen2.5-Coder-1.5B",
+    "qwen2.5-coder-7b": "Qwen/Qwen2.5-Coder-7B",
+    "qwen3-1.7b": "Qwen/Qwen3-1.7B",
+    "qwen3-8b": "Qwen/Qwen3-8B",
+    "deepseek-coder-1.3b": "deepseek-ai/deepseek-coder-1.3b-base",
+    "deepseek-coder-6.7b": "deepseek-ai/deepseek-coder-6.7b-base",
+    "mistral-7b": "mistralai/Mistral-7B-v0.1",
+    "mixtral-8x7b": "mistralai/Mixtral-8x7B-v0.1",
+    "llama-3.2-1b": "meta-llama/Llama-3.2-1B",
+    "llama-3.1-8b": "meta-llama/Llama-3.1-8B",
+}
+
+
+def export_peft_adapter(lora: Dict, config: ModelConfig,
+                        out_dir: str, *,
+                        base_model: str = None) -> str:
+    """Write adapters in the HF-PEFT layout (adapter_model.safetensors +
+    adapter_config.json) so a GRPO-trained adapter drops into any
+    PEFT-ecosystem runtime over the matching base checkpoint.
+
+    The alpha/rank scale is baked into our A at init, so the exported
+    config pins ``lora_alpha == r`` (scaling 1.0) — the folded product
+    A·B is identical either way. PEFT stores lora_A as (r, in) and
+    lora_B as (out, r) (torch Linear layout); ours are (in, r)/(r, out).
+    """
+    import json
+    import os
+
+    import numpy as np
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    tensors: Dict[str, "np.ndarray"] = {}
+    rank = None
+    targets = []
+    for name, leaf in lora["layers"].items():
+        if not name.endswith("_lora_a"):
+            continue
+        target = name[: -len("_lora_a")]
+        targets.append(_PEFT_MODULES[target].rsplit(".", 1)[-1])
+        # one device→host transfer per stacked tensor, sliced host-side
+        a = np.asarray(lora["layers"][name], dtype=np.float32)
+        b = np.asarray(lora["layers"][target + "_lora_b"],
+                       dtype=np.float32)
+        rank = int(a.shape[-1])
+        for i in range(a.shape[0]):
+            prefix = (f"base_model.model.model.layers.{i}."
+                      f"{_PEFT_MODULES[target]}")
+            tensors[prefix + ".lora_A.weight"] = np.ascontiguousarray(
+                a[i].T)                                    # (r, in)
+            tensors[prefix + ".lora_B.weight"] = np.ascontiguousarray(
+                b[i].T)                                    # (out, r)
+    path = os.path.join(out_dir, "adapter_model.safetensors")
+    save_file(tensors, path)
+    with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
+        json.dump({"peft_type": "LORA", "r": rank, "lora_alpha": rank,
+                   "lora_dropout": 0.0, "bias": "none",
+                   "base_model_name_or_path": (
+                       base_model or _HF_REPO_IDS.get(config.name,
+                                                      config.name)),
+                   "target_modules": sorted(set(targets)),
+                   "task_type": "CAUSAL_LM"}, f, indent=1)
+    return path
+
+
+def load_peft_adapter(adapter_dir: str, config: ModelConfig) -> Dict:
+    """Read a PEFT-layout adapter dir back into our stacked tree.
+
+    Scaling: PEFT applies ``lora_alpha / r`` at runtime; we bake it into
+    A, so A is multiplied by that factor on load (round-trips exports
+    from :func:`export_peft_adapter`, whose config pins the factor to 1).
+    """
+    import json
+    import os
+
+    import numpy as np
+    from safetensors.numpy import load_file
+
+    with open(os.path.join(adapter_dir, "adapter_config.json")) as f:
+        meta = json.load(f)
+    r = float(meta["r"])
+    alpha = float(meta.get("lora_alpha", r))
+    # PEFT's rsLoRA option scales by alpha/sqrt(r) instead of alpha/r
+    scaling = alpha / (r ** 0.5) if meta.get("use_rslora") else alpha / r
+    raw = load_file(os.path.join(adapter_dir, "adapter_model.safetensors"))
+    module_to_target = {v: k for k, v in _PEFT_MODULES.items()}
+
+    per_target: Dict[str, Dict[int, Dict[str, "np.ndarray"]]] = {}
+    skipped = []
+    for key, tensor in raw.items():
+        # base_model.model.model.layers.{i}.<module>.lora_{A,B}.weight;
+        # keys outside that pattern (modules_to_save tensors, adapters
+        # on modules this architecture doesn't have) are skipped — a
+        # partial load is reported, a fully-unusable one is an error.
+        parts = key.split(".")
+        if "layers" not in parts or parts[-2] not in ("lora_A", "lora_B"):
+            skipped.append(key)
+            continue
+        li = parts.index("layers")
+        module = ".".join(parts[li + 2:-2])
+        target = module_to_target.get(module)
+        if target is None:
+            skipped.append(key)
+            continue
+        i = int(parts[li + 1])
+        per_target.setdefault(target, {}).setdefault(i, {})[parts[-2]] = \
+            tensor
+    if not per_target:
+        raise ValueError(
+            f"no loadable LoRA tensors in {adapter_dir!r} (skipped "
+            f"{len(skipped)} keys, e.g. {skipped[:3]}); supported "
+            f"modules: {sorted(module_to_target)}")
+
+    layers: Dict[str, jax.Array] = {}
+    for target, rows in per_target.items():
+        L = config.num_layers
+        if sorted(rows) != list(range(L)):
+            raise ValueError(f"adapter covers layers {sorted(rows)} but "
+                             f"config {config.name!r} has {L}")
+        d_in, d_out = _TARGET_DIMS[target](config)
+        got = rows[0]["lora_A"].T.shape
+        if got != (d_in, int(r)):
+            # fail HERE with the offending module, not deep inside a
+            # jitted einsum (models/load.py _take precedent)
+            raise ValueError(
+                f"adapter {target} lora_A shape {got} does not match "
+                f"config {config.name!r} expectation ({d_in}, {int(r)})")
+        a = jnp.stack([jnp.asarray(rows[i]["lora_A"].T) for i in range(L)])
+        b = jnp.stack([jnp.asarray(rows[i]["lora_B"].T) for i in range(L)])
+        layers[target + "_lora_a"] = (a * scaling).astype(config.dtype)
+        layers[target + "_lora_b"] = b.astype(config.dtype)
+    return {"layers": layers}
+
+
+__all__ = ["DEFAULT_TARGETS", "export_peft_adapter", "init_lora",
+           "load_peft_adapter", "lora_param_count", "materialize_lora",
+           "merge_lora", "split_lora", "is_quantized"]

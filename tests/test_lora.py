@@ -156,3 +156,110 @@ def test_pipeline_rejects_unmaterialized_lora(setup):
         split_layers_for_stages(merge_lora(base, lora), 2)
     # folded params pass
     split_layers_for_stages(materialize_lora(base, lora, c), 2)
+
+
+def test_peft_adapter_round_trip(tmp_path, setup):
+    """Export → PEFT layout on disk → load: the same policy function
+    (the interchange path for PEFT-ecosystem runtimes)."""
+    import json
+    import os
+
+    from senweaver_ide_tpu.training import (export_peft_adapter,
+                                            load_peft_adapter)
+    c, base, toks = setup
+    lora = init_lora(c, jax.random.PRNGKey(9), rank=4,
+                     targets=("wq", "wo", "w_down"))
+    lora["layers"] = {
+        k: jax.random.normal(jax.random.PRNGKey(10), v.shape, v.dtype) * 0.05
+        for k, v in lora["layers"].items()}
+    path = export_peft_adapter(lora, c, str(tmp_path))
+    assert os.path.exists(path)
+    meta = json.load(open(str(tmp_path / "adapter_config.json")))
+    assert meta["r"] == 4 and meta["lora_alpha"] == 4   # scaling baked in
+    assert sorted(meta["target_modules"]) == ["down_proj", "o_proj",
+                                              "q_proj"]
+    loaded = load_peft_adapter(str(tmp_path), c)
+    assert set(loaded["layers"]) == set(lora["layers"])
+    ref, _ = forward(merge_lora(base, lora), c, toks)
+    got, _ = forward(merge_lora(base, loaded), c, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_peft_load_applies_external_scaling(tmp_path, setup):
+    """An adapter exported by PEFT itself (alpha != r) gets alpha/r
+    folded into A on load."""
+    import json
+
+    from senweaver_ide_tpu.training import (export_peft_adapter,
+                                            load_peft_adapter)
+    c, base, toks = setup
+    lora = init_lora(c, jax.random.PRNGKey(11), rank=4, targets=("wq",))
+    lora["layers"] = {
+        k: jnp.ones_like(v) * 0.01 for k, v in lora["layers"].items()}
+    export_peft_adapter(lora, c, str(tmp_path))
+    cfg_path = tmp_path / "adapter_config.json"
+    meta = json.load(open(str(cfg_path)))
+    meta["lora_alpha"] = 8                     # external convention
+    json.dump(meta, open(str(cfg_path), "w"))
+    loaded = load_peft_adapter(str(tmp_path), c)
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"]["wq_lora_a"]),
+        np.asarray(lora["layers"]["wq_lora_a"]) * 2.0, rtol=1e-6)
+
+
+def test_peft_load_robustness(tmp_path, setup):
+    """Non-LoRA keys skip; unknown-module adapters skip; an adapter that
+    yields nothing raises clearly; shape mismatches name the module."""
+    import json
+
+    from safetensors.numpy import load_file, save_file
+
+    from senweaver_ide_tpu.training import (export_peft_adapter,
+                                            load_peft_adapter)
+    c, base, _ = setup
+    lora = init_lora(c, jax.random.PRNGKey(12), rank=4, targets=("wq",))
+    export_peft_adapter(lora, c, str(tmp_path))
+    path = tmp_path / "adapter_model.safetensors"
+    tensors = load_file(str(path))
+    # modules_to_save-style key and an unsupported-module adapter key
+    tensors["base_model.model.lm_head.weight"] = np.zeros((4, 4),
+                                                          np.float32)
+    tensors["base_model.model.model.layers.0.self_attn.qkv_proj"
+            ".lora_A.weight"] = np.zeros((4, 4), np.float32)
+    save_file(tensors, str(path))
+    loaded = load_peft_adapter(str(tmp_path), c)        # skips both
+    assert set(loaded["layers"]) == {"wq_lora_a", "wq_lora_b"}
+
+    # only unusable keys -> clear error
+    save_file({"base_model.model.lm_head.weight":
+               np.zeros((4, 4), np.float32)}, str(path))
+    with pytest.raises(ValueError, match="no loadable LoRA"):
+        load_peft_adapter(str(tmp_path), c)
+
+    # wrong-architecture adapter -> named module in the error
+    export_peft_adapter(lora, c, str(tmp_path))
+    import dataclasses
+    wrong = dataclasses.replace(c, hidden_size=128, num_heads=8)
+    with pytest.raises(ValueError, match="wq lora_A shape"):
+        load_peft_adapter(str(tmp_path), wrong)
+
+
+def test_peft_rslora_scaling(tmp_path, setup):
+    import json
+
+    from senweaver_ide_tpu.training import (export_peft_adapter,
+                                            load_peft_adapter)
+    c, _, _ = setup
+    lora = init_lora(c, jax.random.PRNGKey(13), rank=4, targets=("wq",))
+    lora["layers"] = {k: jnp.ones_like(v) * 0.01
+                      for k, v in lora["layers"].items()}
+    export_peft_adapter(lora, c, str(tmp_path))
+    cfg_path = tmp_path / "adapter_config.json"
+    meta = json.load(open(str(cfg_path)))
+    meta["use_rslora"] = True                  # alpha/sqrt(r) = 4/2 = 2
+    json.dump(meta, open(str(cfg_path), "w"))
+    loaded = load_peft_adapter(str(tmp_path), c)
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"]["wq_lora_a"]),
+        np.asarray(lora["layers"]["wq_lora_a"]) * 2.0, rtol=1e-6)
