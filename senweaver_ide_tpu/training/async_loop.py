@@ -113,7 +113,8 @@ class AsyncGRPOTrainer:
                  importance_correction: bool = True,
                  publish_params: Optional[Callable[[object], None]] = None,
                  metrics_service=None,
-                 lora_base=None):
+                 lora_base=None,
+                 ref_params=None):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -137,6 +138,12 @@ class AsyncGRPOTrainer:
         # MATERIALIZED policy so logp recomputation and engines see full
         # weights, while the train step differentiates adapters only.
         self.lora_base = lora_base
+        # Frozen/rolling reference for the k3-KL term (grpo_round's
+        # ref_params analogue): a FULL policy tree; combined with
+        # grpo_config.kl_coef > 0 it anchors long runs against drift
+        # (ROUND3_NOTES.md §24). Swap via set_ref_params at round
+        # boundaries for a rolling anchor.
+        self.ref_params = ref_params
 
         self._queue: "queue.Queue[_Collected]" = queue.Queue(
             maxsize=max(1, prefetch))
@@ -166,6 +173,12 @@ class AsyncGRPOTrainer:
             pending = (pending[0], self._folded_view(pending[1]))
             self.publish_params(pending[1])
             self._applied_behavior = pending
+
+    def set_ref_params(self, ref_params) -> None:
+        """Swap the KL anchor (rolling-anchor pattern); takes effect on
+        the next train round. Pass a FULL policy tree (materialized for
+        LoRA)."""
+        self.ref_params = ref_params
 
     def _merged_view(self, params):
         """Zero-copy full-policy view (dict union): what behavior-logp
@@ -292,10 +305,15 @@ class AsyncGRPOTrainer:
                                              self.model_config, tokens,
                                              self.accum_steps)
 
+        ref_logp = None
+        ref = self.ref_params     # single read: set_ref_params may swap
+        if ref is not None and self.grpo_config.kl_coef > 0.0:
+            ref_logp = behavior_logp_batched(ref, self.model_config,
+                                             tokens, self.accum_steps)
         for _ in range(self.ppo_epochs):
             self.state, metrics = train_step(
                 self.state, self.model_config, self.mesh, tokens, mask,
-                rewards, group_ids, old_logp=old_logp,
+                rewards, group_ids, old_logp=old_logp, ref_logp=ref_logp,
                 grpo_config=self.grpo_config,
                 accum_steps=self.accum_steps, lora_base=self.lora_base)
         self._version += 1

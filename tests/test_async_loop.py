@@ -165,3 +165,18 @@ def test_async_lora_trains_adapters_and_publishes_folded(rng):
     # with B != 0 after ppo_epochs=2 rounds of updates
     assert not np.array_equal(np.asarray(base["layers"]["wq"]),
                               np.asarray(published[-1]["layers"]["wq"]))
+
+
+def test_async_anchored_reference(rng):
+    """ref_params + kl_coef in the async loop: first update equals the
+    anchor, so kl ~ 0 while the path is engaged."""
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+    cfg = tiny_test()
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    trainer = _make_trainer(state, cfg, rng,
+                            grpo_config=GRPOConfig(kl_coef=0.05),
+                            ref_params=state.params)
+    results = trainer.run(1)
+    assert np.isfinite(results[0].metrics["loss"])
+    assert abs(results[0].metrics["kl"]) < 1e-3
