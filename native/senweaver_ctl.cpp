@@ -437,6 +437,109 @@ void relay(int a, int b) {
   }
 }
 
+// JSON-RPC outcome: 0 = success envelope, 2 = error envelope (position
+// comparison disambiguates payloads that merely CONTAIN the other key).
+int response_exit_code(const std::string& response) {
+  size_t err_pos = response.find("\"error\":");
+  size_t res_pos = response.find("\"result\":");
+  if (err_pos == std::string::npos) return 0;
+  if (res_pos == std::string::npos) return 2;
+  return err_pos < res_pos ? 2 : 0;
+}
+
+// ---- onboard: interactive first-run wizard over the control socket ----
+// Drives services/onboarding.py's RPC channel (status/answer/skip): each
+// pending step's prompt is printed, the operator's line is submitted as
+// the answer (empty line = skip, valid only for optional steps), and
+// validator rejections are shown and retried — the CLI face of the
+// reference's onboarding surface.
+
+std::string extract_json_string(const std::string& body, const char* key) {
+  std::string pat = std::string("\"") + key + "\": \"";
+  size_t at = body.find(pat);
+  if (at == std::string::npos) return "";
+  at += pat.size();
+  std::string out;
+  while (at < body.size() && body[at] != '"') {
+    char c = body[at++];
+    if (c == '\\' && at < body.size()) {
+      char e = body[at++];
+      switch (e) {
+        case 'n': c = '\n'; break;
+        case 't': c = '\t'; break;
+        case 'r': c = '\r'; break;
+        case 'b': c = '\b'; break;
+        case 'f': c = '\f'; break;
+        default: c = e; break;   // \" \\ \/ (and \uXXXX passes raw)
+      }
+    }
+    out += c;
+  }
+  return out;
+}
+
+int run_onboard(const char* socket_path, const std::string& token) {
+  for (;;) {
+    std::string resp;
+    int rc = send_request(
+        socket_path,
+        build_request(false, "onboarding.status", "null", token), resp);
+    if (rc != 0) return rc;
+    if (response_exit_code(resp) != 0) {
+      std::fprintf(stderr, "onboarding.status failed: %s\n", resp.c_str());
+      return 1;
+    }
+    if (resp.find("\"complete\": true") != std::string::npos) {
+      std::printf("onboarding complete\n");
+      return 0;
+    }
+    std::string step = extract_json_string(resp, "current");
+    std::string prompt = extract_json_string(resp, "prompt");
+    if (step.empty()) {
+      std::fprintf(stderr, "unexpected status payload: %s\n", resp.c_str());
+      return 1;
+    }
+    std::fprintf(stderr, "[%s] %s\n> ", step.c_str(), prompt.c_str());
+    std::fflush(stderr);
+    char line[4096];
+    if (!std::fgets(line, sizeof line, stdin)) {
+      std::fprintf(stderr, "onboarding aborted (eof); progress saved\n");
+      return 1;
+    }
+    std::string value = line;
+    if (!value.empty() && value.back() != '\n' && !std::feof(stdin)) {
+      // overlong line: drain the remainder so it cannot leak into the
+      // NEXT step's answer, reject this one, re-prompt
+      int ch;
+      while ((ch = std::fgetc(stdin)) != EOF && ch != '\n') {}
+      std::fprintf(stderr, "rejected: answer longer than %zu chars\n",
+                   sizeof line - 2);
+      continue;
+    }
+    while (!value.empty() &&
+           (value.back() == '\n' || value.back() == '\r'))
+      value.pop_back();
+    std::string method = value.empty() ? "onboarding.skip"
+                                       : "onboarding.answer";
+    std::string params =
+        std::string("{\"step\": \"") + json_escape(step) + "\"";
+    if (!value.empty())
+      params += ", \"value\": \"" + json_escape(value) + "\"";
+    params += "}";
+    resp.clear();     // send_request APPENDS; a stale "result" from the
+                      // status read must not mask an error envelope
+    rc = send_request(socket_path,
+                      build_request(false, method, params, token), resp);
+    if (rc != 0) return rc;
+    if (response_exit_code(resp) != 0) {
+      // validator rejection: show the message, re-prompt the same step
+      std::string msg = extract_json_string(resp, "message");
+      std::fprintf(stderr, "rejected: %s\n",
+                   msg.empty() ? resp.c_str() : msg.c_str());
+    }
+  }
+}
+
 int run_tunnel(const char* socket_path, int port, long accept_count) {
   ::signal(SIGCHLD, SIG_IGN);  // auto-reap per-connection children
   ::signal(SIGPIPE, SIG_IGN);
@@ -630,14 +733,6 @@ int run_self_update(const char* new_binary, const char* sha256_hex,
 }
 
 // exit code from a JSON response body: 0 result, 2 error.
-int response_exit_code(const std::string& response) {
-  size_t err_pos = response.find("\"error\":");
-  size_t res_pos = response.find("\"result\":");
-  if (err_pos == std::string::npos) return 0;
-  if (res_pos == std::string::npos) return 2;
-  return err_pos < res_pos ? 2 : 0;
-}
-
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -688,8 +783,8 @@ int main(int argc, char** argv) {
                  "usage: senweaver-ctl [--socket PATH] [--token-file PATH] "
                  "[--msgpack] [--singleton-lock PATH] [--interval S] "
                  "[--accept-count N] [--sha256 HEX] [--target PATH] "
-                 "<ping|status|watch|version|submit|stop|call|tunnel|"
-                 "self-update> [args]\n");
+                 "<ping|status|watch|version|submit|stop|call|onboard|"
+                 "tunnel|self-update> [args]\n");
     return 1;
   }
 
@@ -753,6 +848,9 @@ int main(int argc, char** argv) {
       return 1;
     }
     return run_self_update(argv[argi], sha256_hex, update_target);
+  }
+  if (cmd == "onboard") {
+    return run_onboard(socket_path, token);
   }
   std::string method, params = "null";
   bool watch = false;

@@ -373,3 +373,44 @@ def test_ctl_drives_onboarding(tmp_path, auth_server):
                    token_file=tok)
     assert rc != 0
     assert cfg.get("model.preset") == "qwen3-1.7b"
+
+
+@needs_native
+def test_ctl_onboard_interactive(tmp_path, auth_server):
+    """`senweaver-ctl onboard`: the scripted-stdin wizard walks every
+    step, retries a rejected answer, skips the optional step on an
+    empty line, and exits 0 printing completion."""
+    import os
+    import subprocess
+
+    from senweaver_ide_tpu.services.config import RuntimeConfig
+    from senweaver_ide_tpu.services.onboarding import (
+        OnboardingService, install_onboarding_channel)
+
+    cfg = RuntimeConfig(settings_path=str(tmp_path / "settings.json"))
+    ob = OnboardingService(cfg, state_path=str(tmp_path / "ob.json"),
+                           accelerator_probe=lambda: False)
+    install_onboarding_channel(auth_server, ob)
+    tok = tmp_path / "tok"
+    tok.write_text("sekrit\n")
+
+    answers = "\n".join([
+        str(tmp_path / "ws"),     # workspace
+        "gpt-17",                 # model: rejected, wizard re-prompts
+        "qwen3-1.7b",             # model: accepted
+        "anthropic",              # provider
+        "cpu",                    # accelerator
+        "",                       # metrics: optional -> skip
+    ]) + "\n"
+    env = dict(os.environ)
+    env.pop("SENWEAVER_CTL_TOKEN", None)
+    proc = subprocess.run(
+        [ctl_binary_path(), "--socket", auth_server.socket_path,
+         "--token-file", str(tok), "onboard"],
+        input=answers, capture_output=True, text=True, timeout=30, env=env)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert "onboarding complete" in proc.stdout
+    assert "rejected" in proc.stderr        # the gpt-17 retry happened
+    assert ob.complete
+    assert cfg.get("model.preset") == "qwen3-1.7b"
+    assert ob.status()["answers"]["metrics"] is None     # skipped
