@@ -464,13 +464,43 @@ std::string extract_json_string(const std::string& body, const char* key) {
     char c = body[at++];
     if (c == '\\' && at < body.size()) {
       char e = body[at++];
-      switch (e) {
-        case 'n': c = '\n'; break;
-        case 't': c = '\t'; break;
-        case 'r': c = '\r'; break;
-        case 'b': c = '\b'; break;
-        case 'f': c = '\f'; break;
-        default: c = e; break;   // \" \\ \/ (and \uXXXX passes raw)
+      if (e == 'u' && at + 4 <= body.size()) {
+        // \uXXXX: json.dumps(ensure_ascii=True) emits these for ANY
+        // non-ASCII char — decode to UTF-8 instead of leaking 'uXXXX'
+        unsigned cp = 0;
+        bool okhex = true;
+        for (int i = 0; i < 4; i++) {
+          char h = body[at + i];
+          cp <<= 4;
+          if (h >= '0' && h <= '9') cp |= h - '0';
+          else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+          else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+          else { okhex = false; break; }
+        }
+        if (okhex) {
+          at += 4;
+          if (cp < 0x80) {
+            out += (char)cp;
+          } else if (cp < 0x800) {
+            out += (char)(0xc0 | (cp >> 6));
+            out += (char)(0x80 | (cp & 0x3f));
+          } else {
+            out += (char)(0xe0 | (cp >> 12));
+            out += (char)(0x80 | ((cp >> 6) & 0x3f));
+            out += (char)(0x80 | (cp & 0x3f));
+          }
+          continue;
+        }
+        c = e;               // malformed hex: keep the raw letter
+      } else {
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'b': c = '\b'; break;
+          case 'f': c = '\f'; break;
+          default: c = e; break;   // \" \\ \/
+        }
       }
     }
     out += c;
@@ -732,7 +762,6 @@ int run_self_update(const char* new_binary, const char* sha256_hex,
   return 0;
 }
 
-// exit code from a JSON response body: 0 result, 2 error.
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -850,6 +879,10 @@ int main(int argc, char** argv) {
     return run_self_update(argv[argi], sha256_hex, update_target);
   }
   if (cmd == "onboard") {
+    if (msgpack)
+      std::fprintf(stderr,
+                   "onboard: interactive wizard uses JSON framing; "
+                   "--msgpack ignored\n");
     return run_onboard(socket_path, token);
   }
   std::string method, params = "null";

@@ -132,11 +132,20 @@ def test_mistral_preset_and_guards():
     cfg = get_config("mistral-7b")
     assert cfg.sliding_window == 4096
     assert cfg.num_kv_heads == 8 and cfg.vocab_size == 32_000
+    # flash + SWA is now a real in-kernel band mask (r3 continuation;
+    # parity in tests/test_flash_attention.py) — only the ring/ulysses
+    # kernels still refuse windows, and must keep refusing LOUDLY
+    # (they would silently attend outside the window).
+    swa_flash = dataclasses.replace(tiny_test(), sliding_window=4,
+                                    attn_impl="flash")
+    params = init_params(swa_flash, jax.random.PRNGKey(0))
+    out, _ = forward(params, swa_flash, jnp.ones((1, 8), jnp.int32))
+    assert np.isfinite(np.asarray(out)).all()
     bad = dataclasses.replace(tiny_test(), sliding_window=4,
-                              attn_impl="flash")
-    params = init_params(bad, jax.random.PRNGKey(0))
+                              attn_impl="ring")
     with pytest.raises(NotImplementedError, match="sliding_window"):
-        forward(params, bad, jnp.ones((1, 8), jnp.int32))
+        forward(init_params(bad, jax.random.PRNGKey(0)), bad,
+                jnp.ones((1, 8), jnp.int32))
 
 
 # ---- ring-buffer KV cache (the memory benefit of SWA) ----
