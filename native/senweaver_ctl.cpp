@@ -479,13 +479,38 @@ std::string extract_json_string(const std::string& body, const char* key) {
         }
         if (okhex) {
           at += 4;
+          if (cp >= 0xd800 && cp <= 0xdbff && at + 6 <= body.size() &&
+              body[at] == '\\' && body[at + 1] == 'u') {
+            // UTF-16 surrogate pair (astral chars, e.g. emoji): combine
+            // into the supplementary code point; lone surrogates would
+            // be CESU-8, not valid UTF-8
+            unsigned lo = 0;
+            bool lohex = true;
+            for (int i = 0; i < 4; i++) {
+              char h = body[at + 2 + i];
+              lo <<= 4;
+              if (h >= '0' && h <= '9') lo |= h - '0';
+              else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+              else { lohex = false; break; }
+            }
+            if (lohex && lo >= 0xdc00 && lo <= 0xdfff) {
+              at += 6;
+              cp = 0x10000 + ((cp - 0xd800) << 10) + (lo - 0xdc00);
+            }
+          }
           if (cp < 0x80) {
             out += (char)cp;
           } else if (cp < 0x800) {
             out += (char)(0xc0 | (cp >> 6));
             out += (char)(0x80 | (cp & 0x3f));
-          } else {
+          } else if (cp < 0x10000) {
             out += (char)(0xe0 | (cp >> 12));
+            out += (char)(0x80 | ((cp >> 6) & 0x3f));
+            out += (char)(0x80 | (cp & 0x3f));
+          } else {
+            out += (char)(0xf0 | (cp >> 18));
+            out += (char)(0x80 | ((cp >> 12) & 0x3f));
             out += (char)(0x80 | ((cp >> 6) & 0x3f));
             out += (char)(0x80 | (cp & 0x3f));
           }

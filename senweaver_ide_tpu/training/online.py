@@ -77,7 +77,8 @@ class OnlineImprovementLoop:
                  max_parallel: int = 8,
                  reward_override=None,
                  feedback_fn=outcome_feedback,
-                 metrics_service=None):
+                 metrics_service=None,
+                 anchor_every: int = 0):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -95,6 +96,14 @@ class OnlineImprovementLoop:
         self.reward_override = reward_override
         self.feedback_fn = feedback_fn
         self.metrics_service = metrics_service
+        # anchor_every > 0 (with grpo_config.kl_coef > 0): keep a
+        # rolling snapshot of the policy as the k3-KL reference,
+        # refreshed every anchor_every rounds — the drift stabilizer
+        # proven by the contextual runs (ROUND3_NOTES.md §24).
+        self.anchor_every = anchor_every
+        self._anchor = (state.params
+                        if anchor_every > 0 and grpo_config.kl_coef > 0
+                        else None)
         self._round = 0
         # Atomic id source: sessions are created from the collection
         # pool's worker threads (itertools.count.__next__ is atomic in
@@ -163,8 +172,12 @@ class OnlineImprovementLoop:
             max_len=self.max_len, grpo_config=self.grpo_config,
             ppo_epochs=self.ppo_epochs, max_parallel=self.max_parallel,
             reward_override=reward,
-            metrics_service=self.metrics_service, engine=self.engine)
+            metrics_service=self.metrics_service, engine=self.engine,
+            ref_params=self._anchor)
         self.state = out.state
+        if (self._anchor is not None and self.anchor_every > 0
+                and (self._round + 1) % self.anchor_every == 0):
+            self._anchor = self.state.params
         if self.engine is not None and hasattr(self.engine,
                                                "update_params"):
             self.engine.update_params(self.state.params)

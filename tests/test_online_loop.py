@@ -179,3 +179,20 @@ def test_successive_loops_do_not_collide_on_thread_ids(stack):
                                **kw)
     l2.run_round()
     assert collector.get_stats()["total_feedbacks"] > fb_after_first
+
+
+def test_online_loop_rolling_anchor(stack):
+    """anchor_every + kl_coef: the cycle's first round trains against
+    the init snapshot (kl ~ 0 at round 0), and the anchor refreshes."""
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+    cfg, state, collector, apo, make_session = stack
+    loop = OnlineImprovementLoop(
+        state, cfg, None, make_session, ["task"],
+        apo=apo, collector=collector, group_size=2, max_len=1024,
+        max_parallel=1, grpo_config=GRPOConfig(kl_coef=0.05),
+        anchor_every=1,
+        reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0)
+    r0 = loop.run_round()
+    assert np.isfinite(r0.train_metrics["loss"])
+    assert abs(r0.train_metrics["kl"]) < 1e-3
+    assert loop._anchor is loop.state.params      # refreshed after round
