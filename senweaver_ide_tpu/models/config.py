@@ -96,6 +96,10 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     expert_capacity_factor: float = 1.25
+    # HF checkpoint layout for the expert banks on EXPORT ("mixtral":
+    # block_sparse_moe w1/w3/w2; "qwen3": mlp.experts gate/up/down_proj).
+    # The loader autodetects from the checkpoint keys.
+    moe_layout: str = "mixtral"
 
     @property
     def q_dim(self) -> int:
@@ -226,6 +230,17 @@ def qwen3_8b() -> ModelConfig:
         rope_theta=1_000_000.0, qk_norm=True)
 
 
+def qwen3_30b_a3b() -> ModelConfig:
+    """Qwen3-30B-A3B: the MoE member of the Qwen3 ladder (128 experts,
+    8 active, QK-norm; ~3B active params per token)."""
+    return ModelConfig(
+        name="qwen3-30b-a3b", vocab_size=151_936, hidden_size=2048,
+        intermediate_size=768, num_layers=48, num_heads=32, num_kv_heads=4,
+        head_dim=128, max_seq_len=32_768, rope_theta=1_000_000.0,
+        qk_norm=True, num_experts=128, num_experts_per_tok=8,
+        moe_layout="qwen3")
+
+
 def llama_3_2_1b() -> ModelConfig:
     """Llama-3.2-1B: GQA, tied embeddings, llama3 RoPE scaling (the
     128k-context serving config of an 8k-trained base)."""
@@ -272,6 +287,7 @@ PRESETS = {
     "llama-3.1-8b": llama_3_1_8b,
     "qwen3-1.7b": qwen3_1_7b,
     "qwen3-8b": qwen3_8b,
+    "qwen3-30b-a3b": qwen3_30b_a3b,
     "tiny-test": tiny_test,
     "tiny-moe-test": tiny_moe_test,
     "small-test": small_test,

@@ -16,10 +16,11 @@ Conventions bridged:
   projections need NO row permutation (ops/rotary.py matches HF Qwen2/LLaMA).
 
 Supported families: Qwen2/Qwen2.5 (GQA + QKV bias, optionally tied
-embeddings), LLaMA-architecture DeepSeek-Coder (MHA, no biases),
-Mistral (GQA + sliding window), and Mixtral (block-sparse MoE:
-``block_sparse_moe.gate`` router + per-expert w1/w3/w2) — the same
-coverage as models/config.py PRESETS.
+embeddings), Qwen3 (QK-norm) incl. Qwen3-MoE (``mlp.experts`` layout),
+LLaMA-architecture DeepSeek-Coder (MHA, no biases), Llama-3.x (rope
+scaling), Mistral (GQA + sliding window), and Mixtral (block-sparse
+MoE: ``block_sparse_moe.gate`` router + per-expert w1/w3/w2) — the
+same coverage as models/config.py PRESETS.
 """
 
 from __future__ import annotations
@@ -78,6 +79,13 @@ def _take(raw: Dict[str, np.ndarray], key: str, shape) -> np.ndarray:
     return t
 
 
+# Expert-bank key wiring per HF MoE family: (module base, gate, up, down).
+_MOE_LAYOUTS = {
+    "mixtral": ("block_sparse_moe", "w1", "w3", "w2"),
+    "qwen3": ("mlp", "gate_proj", "up_proj", "down_proj"),
+}
+
+
 def load_hf_params(model_dir: str, config: ModelConfig, *,
                    dtype=None, strict: bool = True) -> Params:
     """Read an HF-layout safetensors dir into the stacked param pytree.
@@ -110,24 +118,28 @@ def load_hf_params(model_dir: str, config: ModelConfig, *,
                             False),
     }
     if c.num_experts > 0:
-        # Mixtral block-sparse layout: gate (router) is (E, D); expert e
-        # carries w1 (gate), w3 (up) as (F, D) and w2 (down) as (D, F).
+        # Two HF MoE layouts, autodetected from the checkpoint keys:
+        #   mixtral: block_sparse_moe.gate + experts.N.{w1,w3,w2}
+        #   qwen3-moe: mlp.gate + experts.N.{gate,up,down}_proj
+        # Router is (E, D) in both; expert matrices (F, D)/(D, F).
         E = c.num_experts
-        layers["router"] = stacked(
-            p + "block_sparse_moe.gate.weight", (E, D), True)
+        qwen3_moe = "model.layers.0.mlp.gate.weight" in raw
+        base, g_key, u_key, d_key = _MOE_LAYOUTS[
+            "qwen3" if qwen3_moe else "mixtral"]
+        layers["router"] = stacked(p + base + ".gate.weight", (E, D), True)
 
         def experts(sub: str, shape) -> np.ndarray:
             per_layer = []
             for i in range(L):
                 per_layer.append(np.stack([
-                    _take(raw, f"model.layers.{i}.block_sparse_moe."
+                    _take(raw, f"model.layers.{i}.{base}."
                                f"experts.{e}.{sub}.weight", shape).T
                     for e in range(E)]))
             return np.stack(per_layer)          # (L, E, in, out)
 
-        layers["w_gate"] = experts("w1", (F, D))
-        layers["w_up"] = experts("w3", (F, D))
-        layers["w_down"] = experts("w2", (D, F))
+        layers["w_gate"] = experts(g_key, (F, D))
+        layers["w_up"] = experts(u_key, (F, D))
+        layers["w_down"] = experts(d_key, (D, F))
     else:
         layers["w_gate"] = stacked(p + "mlp.gate_proj.weight", (F, D), True)
         layers["w_up"] = stacked(p + "mlp.up_proj.weight", (F, D), True)
@@ -211,12 +223,18 @@ def export_hf_params(params: Params, config: ModelConfig,
         out[p + "self_attn.o_proj.weight"] = tt(lp["wo"][i])
         out[p + "post_attention_layernorm.weight"] = t(lp["mlp_norm"][i])
         if c.num_experts > 0:
-            out[p + "block_sparse_moe.gate.weight"] = tt(lp["router"][i])
+            # layout mirrors the loader's autodetected families
+            if c.moe_layout not in _MOE_LAYOUTS:
+                raise ValueError(
+                    f"unknown moe_layout {c.moe_layout!r}; "
+                    f"available: {sorted(_MOE_LAYOUTS)}")
+            base, g_key, u_key, d_key = _MOE_LAYOUTS[c.moe_layout]
+            out[p + base + ".gate.weight"] = tt(lp["router"][i])
             for e in range(c.num_experts):
-                ep = p + f"block_sparse_moe.experts.{e}."
-                out[ep + "w1.weight"] = tt(lp["w_gate"][i, e])
-                out[ep + "w3.weight"] = tt(lp["w_up"][i, e])
-                out[ep + "w2.weight"] = tt(lp["w_down"][i, e])
+                ep = p + f"{base}.experts.{e}."
+                out[ep + g_key + ".weight"] = tt(lp["w_gate"][i, e])
+                out[ep + u_key + ".weight"] = tt(lp["w_up"][i, e])
+                out[ep + d_key + ".weight"] = tt(lp["w_down"][i, e])
         else:
             out[p + "mlp.gate_proj.weight"] = tt(lp["w_gate"][i])
             out[p + "mlp.up_proj.weight"] = tt(lp["w_up"][i])

@@ -258,3 +258,56 @@ def test_moe_roundtrip_mixtral_layout(tmp_path, rng):
     a, _ = forward(params, cfg, toks)
     b, _ = forward(loaded, cfg, toks)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_parity_vs_transformers_qwen3_moe(tmp_path):
+    """Qwen3-MoE: QK-norm + softmax-top-k-renormalized routing + the
+    qwen3 expert layout, parity vs Qwen3MoeForCausalLM. Capacity is set
+    high so our capacity-bounded dispatch drops nothing (HF has no
+    capacity limit); routing weights must then match exactly."""
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen3MoeForCausalLM"):
+        pytest.skip("transformers too old for Qwen3-MoE")
+
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_experts=4, num_experts_per_tok=2, decoder_sparse_step=1,
+        norm_topk_prob=True, max_position_embeddings=128,
+        rope_theta=1_000_000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=False, attention_bias=False,
+        mlp_only_layers=[])
+    model = transformers.Qwen3MoeForCausalLM(hf_cfg)
+    our_cfg = ModelConfig(
+        name="qwen3-moe-parity", vocab_size=512, hidden_size=64,
+        intermediate_size=48, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, rope_theta=1_000_000.0,
+        qkv_bias=False, qk_norm=True, num_experts=4,
+        num_experts_per_tok=2, expert_capacity_factor=8.0,
+        moe_layout="qwen3",
+        dtype=jnp.float32, matmul_precision="highest")
+    _hf_parity(tmp_path, model, our_cfg, 512)
+
+
+def test_qwen3_moe_export_roundtrip(tmp_path):
+    """Export in the qwen3 layout → autodetected load → identical."""
+    cfg = dataclasses.replace(get_config("tiny-moe-test"),
+                              moe_layout="qwen3", qkv_bias=False)
+    params = init_params(cfg, jax.random.PRNGKey(6))
+    export_hf_params(params, cfg, str(tmp_path))
+    keys = available_hf_keys(str(tmp_path))
+    assert any("mlp.experts.0.gate_proj" in k for k in keys)
+    loaded = load_hf_params(str(tmp_path), cfg)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        params, loaded)
+
+
+def test_unknown_moe_layout_rejected(tmp_path):
+    cfg = dataclasses.replace(get_config("tiny-moe-test"),
+                              moe_layout="qwen3-moe")   # typo'd value
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="unknown moe_layout"):
+        export_hf_params(params, cfg, str(tmp_path))
