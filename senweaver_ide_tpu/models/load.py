@@ -196,6 +196,9 @@ def export_hf_params(params: Params, config: ModelConfig,
                         "(models/quantize.py is a serving transform); "
                         "export the full-precision train-state params")
     c = config
+    if c.num_experts > 0 and c.moe_layout not in _MOE_LAYOUTS:
+        raise ValueError(f"unknown moe_layout {c.moe_layout!r}; "
+                         f"available: {sorted(_MOE_LAYOUTS)}")
     os.makedirs(out_dir, exist_ok=True)
     lp = params["layers"]
 
@@ -224,10 +227,7 @@ def export_hf_params(params: Params, config: ModelConfig,
         out[p + "post_attention_layernorm.weight"] = t(lp["mlp_norm"][i])
         if c.num_experts > 0:
             # layout mirrors the loader's autodetected families
-            if c.moe_layout not in _MOE_LAYOUTS:
-                raise ValueError(
-                    f"unknown moe_layout {c.moe_layout!r}; "
-                    f"available: {sorted(_MOE_LAYOUTS)}")
+            # (validated once, before the per-layer loop — see below)
             base, g_key, u_key, d_key = _MOE_LAYOUTS[c.moe_layout]
             out[p + base + ".gate.weight"] = tt(lp["router"][i])
             for e in range(c.num_experts):

@@ -65,6 +65,21 @@ def quantize_weights_int8(params: Dict) -> Dict:
     head = params.get("lm_head")
     if head is not None and head.dtype != jnp.int8:
         out["lm_head"], out["lm_head_scale"] = _quantize_matrix(head)
+    elif head is None and "tied_head_q8" not in params:
+        # Tied embeddings: the head matmul streams the FULL (V, D) table
+        # every decode step (the largest single tensor of the 1.5B
+        # flagship, ~15% of its weight bytes). Keep the bf16 embed for
+        # the GATHER (quality-sensitive, reads only B rows) and store an
+        # int8 SHADOW with per-vocab-row scales for the head matmul —
+        # +50% of embed's footprint, −50% of its per-step traffic.
+        emb = params["embed"].astype(jnp.float32)          # (V, D)
+        absmax = jnp.max(jnp.abs(emb), axis=-1)            # (V,)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        out["tied_head_q8"] = jnp.clip(
+            jnp.round(emb / scale[:, None]), -127, 127).astype(jnp.int8)
+        # _scale suffix on the weight's own key: transformer._dense's
+        # shared int8 epilogue resolves it by name
+        out["tied_head_q8_scale"] = scale
     return out
 
 

@@ -618,7 +618,13 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
     if head is None:  # tied embeddings
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
+        if "tied_head_q8" in params:
+            # int8 shadow of the embed table (models/quantize.py): the
+            # head matmul streams half the bytes; _dense applies the
+            # per-vocab-row scale as the shared fused epilogue
+            logits = _dense(x, params, "tied_head_q8", "bsd,vd->bsv")
+        else:
+            logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
         logits = _dense(x, params, "lm_head", "bsd,dv->bsv")
     return logits.astype(jnp.float32), new_cache, aux_total

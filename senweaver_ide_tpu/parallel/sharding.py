@@ -49,6 +49,10 @@ PARAM_SPECS: Dict[str, P] = {
     # scales shard like their weight's OUTPUT axis, so the epilogue
     # multiply stays local to the shard that produced the output tile.
     "lm_head_scale": P("tp"),
+    # int8 shadow of the tied-embedding head (models/quantize.py):
+    # shards like embed; per-vocab-row scales follow the vocab axis
+    "tied_head_q8": P("tp", "fsdp"),
+    "tied_head_q8_scale": P("tp"),
     "layers/wq_scale": P(None, "tp"),
     "layers/wk_scale": P(None, "tp"),
     "layers/wv_scale": P(None, "tp"),
