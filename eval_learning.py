@@ -183,8 +183,9 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         # space, so bias toward one is the other's loss) — a sustained
         # window of mean near +1 is only reachable by prompt-CONDITIONAL
         # emission. Report the best width-w window and flag > 0.3.
-        peak = max(sum(curve[i:i + w]) / w
-                   for i in range(len(curve) - w + 1))
+        peak = (max(sum(curve[i:i + w]) / w
+                    for i in range(len(curve) - w + 1))
+                if len(curve) >= w else sum(curve) / max(len(curve), 1))
         report["peak_window_mean"] = round(peak, 4)
         report["conditioned"] = bool(peak > 0.3)
         # Conditioning proof #2 (endpoint): BOTH contrastive tasks end

@@ -454,11 +454,26 @@ int response_exit_code(const std::string& response) {
 // validator rejections are shown and retried — the CLI face of the
 // reference's onboarding surface.
 
-std::string extract_json_string(const std::string& body, const char* key) {
-  std::string pat = std::string("\"") + key + "\": \"";
+// Position of the value after '"key":', tolerating any whitespace after
+// the colon — a server-side switch to compact separators must not make
+// field extraction (and the wizard's completion check) silently fail.
+size_t json_value_pos(const std::string& body, const char* key) {
+  std::string pat = std::string("\"") + key + "\":";
   size_t at = body.find(pat);
-  if (at == std::string::npos) return "";
+  if (at == std::string::npos) return std::string::npos;
   at += pat.size();
+  while (at < body.size() &&
+         (body[at] == ' ' || body[at] == '\t' || body[at] == '\n' ||
+          body[at] == '\r'))
+    at++;
+  return at;
+}
+
+std::string extract_json_string(const std::string& body, const char* key) {
+  size_t at = json_value_pos(body, key);
+  if (at == std::string::npos || at >= body.size() || body[at] != '"')
+    return "";
+  at++;
   std::string out;
   while (at < body.size() && body[at] != '"') {
     char c = body[at++];
@@ -544,7 +559,9 @@ int run_onboard(const char* socket_path, const std::string& token) {
       std::fprintf(stderr, "onboarding.status failed: %s\n", resp.c_str());
       return 1;
     }
-    if (resp.find("\"complete\": true") != std::string::npos) {
+    size_t done_at = json_value_pos(resp, "complete");
+    if (done_at != std::string::npos &&
+        resp.compare(done_at, 4, "true") == 0) {
       std::printf("onboarding complete\n");
       return 0;
     }

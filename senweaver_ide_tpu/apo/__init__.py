@@ -9,7 +9,8 @@ from .gradient import (build_apply_edit_prompt, build_textual_gradient_prompt,
                        format_rollout, parse_rules)
 from .segments import SegmentStore
 from .beam import beam_search, corpus_score_fn, propose_candidates
-from .service import APOService, APO_RULES_MAX_CHARS, format_apo_rules_section
+from .service import (APOService, APO_RULES_MAX_CHARS,
+                      format_apo_rules_section, install_apo_channel)
 from .synthetic import (generate_good_traces, generate_pattern_traces,
                         make_six_pattern_corpus)
 from .local import (corpus_score_from_collector, make_local_apo,

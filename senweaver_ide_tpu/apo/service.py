@@ -186,6 +186,65 @@ class APOService:
         }
 
 
+def install_apo_channel(server, apo: "APOService") -> None:
+    """Expose APO operator actions over the control plane (JSON-RPC).
+
+    The reference UI drives its APO service directly from the renderer
+    (suggestion apply/reject buttons, manual analyze — apoService.ts
+    segment lifecycle :1375-1458); here the same operations ride the
+    control socket so BOTH the CLI and the dashboard's action endpoint
+    can drive them, under the server's auth token. Mirrors
+    services.config.install_config_channel's pattern."""
+
+    def _suggestion_row(s) -> dict:
+        return {"id": s.id, "status": s.status, "priority": s.priority,
+                "type": s.type, "category": s.target_category,
+                "description": s.description,
+                "content": s.suggested_content}
+
+    def _stats(_params):
+        out = dict(apo.get_stats())
+        out["optimized_rules"] = apo.get_optimized_rules()
+        return out
+
+    def _analyze(_params):
+        report = apo.analyze()
+        return {"good_rate": report.good_rate,
+                "total_conversations": report.total_conversations,
+                "patterns": len(report.patterns),
+                "suggestions": [_suggestion_row(s)
+                                for s in report.suggestions]}
+
+    def _gradient(_params):
+        tg = apo.request_textual_gradient()
+        if tg is None:
+            return {"requested": False}
+        return {"requested": True, "critique": tg.critique}
+
+    def _suggestions(_params):
+        return [_suggestion_row(s) for s in apo.segments.suggestions]
+
+    def _lifecycle(fn):
+        def handler(params):
+            sid = params.get("id") if isinstance(params, dict) \
+                else (str(params) if params is not None else None)
+            if not sid:
+                raise ValueError("missing suggestion id")
+            ok = fn(sid)
+            if not ok:
+                raise KeyError(f"suggestion not actionable: {sid}")
+            return {"id": sid, "rules": apo.get_optimized_rules()}
+        return handler
+
+    server.register("apo.stats", _stats)
+    server.register("apo.analyze", _analyze)
+    server.register("apo.gradient", _gradient)
+    server.register("apo.suggestions", _suggestions)
+    server.register("apo.apply", _lifecycle(apo.segments.apply_suggestion))
+    server.register("apo.reject", _lifecycle(apo.segments.reject_suggestion))
+    server.register("apo.revert", _lifecycle(apo.segments.revert_suggestion))
+
+
 # APO → system prompt injection budget (convertToLLMMessageService.ts:835).
 APO_RULES_MAX_CHARS = 2000
 

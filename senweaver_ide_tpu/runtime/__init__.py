@@ -8,12 +8,13 @@ via ctypes) and the senweaver-ctl CLI (native/senweaver_ctl.cpp) speaking
 JSON-RPC over a unix socket to ControlServer.
 """
 
-from .control import DEFAULT_SOCKET, ControlServer, Job
+from .control import (DEFAULT_SOCKET, ControlClient, ControlError,
+                      ControlServer, Job)
 from .jobs import JobRunner
 from .native import (TraceRing, build_native, byte_tokenize_batch,
                      ctl_binary_path, native_available)
 
 __all__ = [
-    "DEFAULT_SOCKET", "ControlServer", "Job", "JobRunner", "TraceRing", "build_native",
+    "DEFAULT_SOCKET", "ControlClient", "ControlError", "ControlServer", "Job", "JobRunner", "TraceRing", "build_native",
     "byte_tokenize_batch", "ctl_binary_path", "native_available",
 ]

@@ -24,6 +24,54 @@ from .msgpack_lite import is_msgpack_request, pack, unpack_prefix
 DEFAULT_SOCKET = "/tmp/senweaver-ctl.sock"
 
 
+class ControlError(RuntimeError):
+    """JSON-RPC error response surfaced client-side."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class ControlClient:
+    """Minimal JSON-framing client for :class:`ControlServer`.
+
+    The in-process counterpart of senweaver-ctl's send_request
+    (native/senweaver_ctl.cpp): one connection per call, newline-framed
+    JSON-RPC 2.0, optional auth token. Used by the dashboard's action
+    endpoint and available to tests/tools."""
+
+    def __init__(self, socket_path: str = DEFAULT_SOCKET, *,
+                 token: Optional[str] = None, timeout: float = 10.0):
+        self.socket_path = socket_path
+        self.token = token
+        self.timeout = timeout
+
+    def call(self, method: str, params: Any = None, *,
+             token: Optional[str] = None) -> Any:
+        req: Dict[str, Any] = {"jsonrpc": "2.0", "id": 1, "method": method,
+                               "params": params}
+        auth = token if token is not None else self.token
+        if auth is not None:
+            req["auth"] = auth
+        with socket.socket(socket.AF_UNIX) as c:
+            c.settimeout(self.timeout)
+            c.connect(self.socket_path)
+            c.sendall(json.dumps(req).encode() + b"\n")
+            c.shutdown(socket.SHUT_WR)
+            data = b""
+            while True:
+                chunk = c.recv(65536)
+                if not chunk:
+                    break
+                data += chunk
+        resp = json.loads(data.decode())
+        if "error" in resp:
+            err = resp["error"] or {}
+            raise ControlError(err.get("code", -32000),
+                               err.get("message", "unknown error"))
+        return resp.get("result")
+
+
 @dataclasses.dataclass
 class Job:
     job_id: str

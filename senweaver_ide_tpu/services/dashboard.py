@@ -59,7 +59,8 @@ class DashboardService:
 
     def __init__(self, *, collector=None, apo=None, engine=None,
                  control=None, metrics_path: Optional[str] = None,
-                 onboarding=None, title: str = "senweaver-tpu trainer"):
+                 onboarding=None, title: str = "senweaver-tpu trainer",
+                 control_socket: Optional[str] = None):
         self.collector = collector
         self.apo = apo
         self.engine = engine
@@ -67,6 +68,13 @@ class DashboardService:
         self.metrics_path = metrics_path
         self.onboarding = onboarding
         self.title = title
+        # Operator actions go over the control-plane SOCKET (never by
+        # calling the services directly): the dashboard holds no
+        # credentials — the operator's token travels request → RPC auth
+        # field → ControlServer validation, so the HTTP port grants
+        # nothing the socket wouldn't.
+        self.control_socket = control_socket or getattr(
+            control, "socket_path", None)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -88,6 +96,13 @@ class DashboardService:
                 apo_state: Dict[str, Any] = dict(self.apo.get_stats())
                 apo_state["optimized_rules"] = self.apo.get_optimized_rules()
                 report = self.apo.get_latest_report()
+                # Suggestion rows with IDs: the action buttons need them
+                # (apply/reject/revert go over the control plane by id).
+                apo_state["suggestions"] = [
+                    {"id": s.id, "status": s.status,
+                     "priority": s.priority,
+                     "description": s.description}
+                    for s in self.apo.segments.suggestions]
                 if report is not None:
                     apo_state["latest_report"] = {
                         "good_rate": report.good_rate,
@@ -137,6 +152,48 @@ class DashboardService:
                     return
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):  # noqa: N802 (stdlib casing)
+                if self.path != "/api/action":
+                    self.send_error(404)
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    method = req.get("method", "")
+                    params = req.get("params")
+                except Exception as e:
+                    self._reply(400, {"ok": False,
+                                      "error": f"bad request: {e}"})
+                    return
+                if not service.control_socket:
+                    self._reply(503, {"ok": False,
+                                      "error": "no control socket wired"})
+                    return
+                from ..runtime.control import ControlClient, ControlError
+                token = self.headers.get("X-Auth-Token") or None
+                try:
+                    result = ControlClient(service.control_socket).call(
+                        method, params, token=token)
+                    self._reply(200, {"ok": True, "result": result})
+                except ControlError as e:
+                    status = 401 if e.code == -32001 else 400
+                    self._reply(status, {"ok": False, "code": e.code,
+                                         "error": str(e)})
+                except (OSError, ValueError) as e:
+                    # ValueError covers json.JSONDecodeError from an
+                    # empty/truncated control-plane reply — every failure
+                    # path must return the structured {ok: false} body.
+                    self._reply(502, {"ok": False,
+                                      "error": f"control plane: {e}"})
+
+            def _reply(self, status: int, payload: Dict[str, Any]) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -212,18 +269,51 @@ tr { border-bottom: 1px solid var(--border); }
 .status.running::before, .status.queued::before { color: var(--warn); }
 .muted { color: var(--text-2); }
 .rules li { margin-bottom: 2px; }
+button { font: inherit; font-size: 12px; padding: 2px 10px;
+         border: 1px solid var(--border); border-radius: 6px;
+         background: var(--surface-2); color: inherit; cursor: pointer; }
+button:hover { border-color: var(--text-2); }
+input[type=text], input[type=password], textarea {
+  font: inherit; font-size: 12.5px; color: inherit;
+  background: var(--surface-2); border: 1px solid var(--border);
+  border-radius: 6px; padding: 3px 8px; }
+.actionbar { display: flex; gap: 8px; align-items: center;
+             flex-wrap: wrap; margin: 6px 0; }
+#action-status { font-size: 12px; }
+#action-status.err { color: var(--bad); }
+#action-status.okk { color: var(--good); }
 </style></head><body>
 <header><h1>__TITLE__</h1>
 <div class="sub">operator dashboard · polls /api/state
-<span id="updated" class="muted"></span></div></header>
+<span id="updated" class="muted"></span></div>
+<div class="actionbar">
+<label class="muted" for="tok">auth token</label>
+<input type="password" id="tok" size="18"
+  placeholder="control-plane token">
+<span id="action-status"></span></div></header>
 <main>
 <section><h2>Traces</h2><div id="traces" class="tiles"></div></section>
 <section><h2>Training</h2>
 <div id="charts"></div>
 <div id="rounds-table"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
-<section><h2>APO</h2><div id="apo"></div></section>
-<section><h2>Jobs</h2><div id="jobs"></div></section>
+<section><h2>APO</h2>
+<div class="actionbar">
+<button onclick="act('apo.analyze')">analyze now</button>
+<button onclick="act('apo.gradient')">request gradient</button></div>
+<div id="apo-suggestions"></div>
+<div id="apo"></div></section>
+<section><h2>Jobs</h2>
+<div class="actionbar">
+<input type="text" id="job-params" size="32"
+  placeholder='job params JSON, e.g. {"kind": "grpo"}'>
+<button onclick="submitJob()">submit job</button></div>
+<div id="jobs"></div></section>
+<section><h2>Live config</h2>
+<div class="actionbar">
+<input type="text" id="cfg-json" size="44"
+  placeholder='config JSON, e.g. {"allowed_models": ["tiny-test"]}'>
+<button onclick="pushConfig()">push config</button></div></section>
 <section><h2>Setup</h2><div id="onboarding"></div></section>
 </main>
 <script>
@@ -256,6 +346,62 @@ function table(rows, headers) {
 
 const statusSpan = s =>
   ({html: `<span class="status ${esc(s)}">${esc(s)}</span>`});
+
+// Operator actions: POST /api/action → control-plane JSON-RPC. The
+// token never persists server-side; it rides each request's header and
+// the ControlServer validates it (no token → unauthorized).
+const tokEl = () => document.getElementById("tok");
+window.addEventListener("DOMContentLoaded", () => {
+  tokEl().value = localStorage.getItem("senweaver-token") || "";
+  tokEl().addEventListener("change", () =>
+    localStorage.setItem("senweaver-token", tokEl().value));
+});
+async function act(method, params) {
+  const st = document.getElementById("action-status");
+  st.className = ""; st.textContent = `${method} …`;
+  let body;
+  try {
+    const r = await fetch("/api/action", {
+      method: "POST",
+      headers: {"Content-Type": "application/json",
+                "X-Auth-Token": tokEl().value},
+      body: JSON.stringify({method, params})});
+    body = await r.json();
+  } catch (e) { body = {ok: false, error: String(e)}; }
+  st.className = body.ok ? "okk" : "err";
+  st.textContent = body.ok ? `${method}: ok`
+    : `${method}: ${body.error || "failed"}`;
+  refresh();
+  return body;
+}
+function submitJob() {
+  let p = document.getElementById("job-params").value.trim();
+  try { p = p ? JSON.parse(p) : {}; }
+  catch (e) {
+    const st = document.getElementById("action-status");
+    st.className = "err"; st.textContent = `params: ${e}`; return;
+  }
+  act("submit", p);
+}
+function pushConfig() {
+  let p = document.getElementById("cfg-json").value.trim();
+  try { p = JSON.parse(p || "{}"); }
+  catch (e) {
+    const st = document.getElementById("action-status");
+    st.className = "err"; st.textContent = `config: ${e}`; return;
+  }
+  act("config.push", p);
+}
+// Action buttons carry ids via data- attributes (never inline JS with
+// interpolated data — the id is LLM-adjacent data, same XSS posture).
+document.addEventListener("click", e => {
+  const b = e.target.closest("button[data-act]");
+  if (!b) return;
+  act(b.dataset.act, {id: b.dataset.id, job_id: b.dataset.id});
+});
+const actBtn = (method, id, label) =>
+  ({html: `<button data-act="${esc(method)}" data-id="${esc(id)}">` +
+          `${esc(label)}</button>`});
 
 // Single-series line chart: thin 2px line, recessive grid, hover
 // crosshair + tooltip, no legend (the title names the series).
@@ -354,16 +500,25 @@ async function refresh() {
   if ((a.optimized_rules || []).length)
     apoHtml += "<ul class='rules'>" + a.optimized_rules.map(r =>
       `<li>${esc(r)}</li>`).join("") + "</ul>";
-  if (a.latest_report && a.latest_report.suggestions)
-    apoHtml += table(a.latest_report.suggestions.map(x =>
-      [statusSpan(x.status), x.priority, x.description]),
-      ["status", "priority", "suggestion"]);
+  // (report-snapshot suggestions are NOT rendered here: the live
+  // actionable table above supersedes them, and snapshot statuses go
+  // stale the moment an apply/reject lands.)
   document.getElementById("apo").innerHTML = apoHtml;
+  document.getElementById("apo-suggestions").innerHTML = table(
+    (a.suggestions || []).map(x => [
+      statusSpan(x.status), x.priority, x.description,
+      x.status === "pending" ? actBtn("apo.apply", x.id, "apply") : "",
+      x.status === "pending" ? actBtn("apo.reject", x.id, "reject")
+        : (x.status === "applied" ? actBtn("apo.revert", x.id, "revert")
+                                  : "")]),
+    ["status", "priority", "suggestion", "", ""]);
   document.getElementById("jobs").innerHTML = table(
     (s.jobs || []).map(j =>
       [j.job_id, statusSpan(j.status),
-       new Date(j.submitted_at * 1000).toLocaleTimeString()]),
-    ["job", "status", "submitted"]);
+       new Date(j.submitted_at * 1000).toLocaleTimeString(),
+       ["queued", "running"].includes(j.status)
+         ? actBtn("stop", j.job_id, "stop") : ""]),
+    ["job", "status", "submitted", ""]);
   const ob = s.onboarding;
   document.getElementById("onboarding").innerHTML = !ob ? "" :
     ob.error ? `<p>onboarding source error: ${esc(ob.error)}</p>` :

@@ -193,6 +193,13 @@ def export_peft_adapter(lora: Dict, config: ModelConfig,
                 a[i].T)                                    # (r, in)
             tensors[prefix + ".lora_B.weight"] = np.ascontiguousarray(
                 b[i].T)                                    # (out, r)
+    if not tensors:
+        # An adapter tree with no *_lora_a leaves would otherwise export
+        # an empty safetensors + a config with r=null — unusable in any
+        # PEFT runtime and silent until load time (ADVICE r3).
+        raise ValueError("export_peft_adapter: no LoRA adapter leaves "
+                         "found in lora['layers'] (expected *_lora_a/"
+                         "*_lora_b pairs)")
     path = os.path.join(out_dir, "adapter_model.safetensors")
     save_file(tensors, path)
     with open(os.path.join(out_dir, "adapter_config.json"), "w") as f:
