@@ -1,0 +1,234 @@
+"""OnlineImprovementLoop end to end on REAL weights (no scripted policy).
+
+VERDICT r3 missing #2: weight-learning (LEARNING_r03) and
+prompt-conditioning (LEARNING_CONTEXTUAL_*) each existed in isolation;
+this eval runs them TOGETHER through ``training/online.py`` — the
+reference's coupled cycle (``apoService.ts:435-472`` auto-analysis timer
+feeding ``chatThreadService.ts:1172``'s agent loop) with the TPU build's
+weight-update upgrade — against a real transformer only:
+
+- The policy starts from the rule-following checkpoint the uplift eval
+  pretrains (eval_uplift_real.py): an instruction-follower, the stand-in
+  for the pretrained LLM the reference drives. Its unconditioned prior
+  FAILS the task suite.
+- Each round: real engine rollouts (multi-attempt conversations — a
+  judge-failed output draws a user follow-up in the same trace, the
+  reference's P4/P5 retry shape), symmetric outcome feedback recorded on
+  every trace, a GRPO step on the episodes' real sampled tokens trained
+  on the 9-dim reward head's finalReward, weight publish to the engine,
+  then the APO tick: auto-analysis when the corpus gates open and beam
+  search when goodRate is low.
+- Expected dynamics (the artifact's claim): rounds before the beam fires
+  are flat-low (the judge fails everything; group advantages are ~zero,
+  so weights alone cannot move — the optimizers NEED each other); the
+  beam-found rule conditions the policy onto the target class (step
+  jump); subsequent GRPO rounds consolidate first-attempt success
+  (mean attempts falls, reward_mean keeps rising toward 1.0).
+
+    python eval_online_real.py [--rounds 12] [--ckpt /tmp/uplift_ckpt]
+
+Prints ONE JSON line (the ONLINE_r04 artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import List, Optional, Sequence
+
+from eval_uplift_real import (BankProposer, RULE_BANK, RETRY_FOLLOWUP,
+                              frac_low, make_rule_scorer, minimal_sysmsg,
+                              pretrain_rule_policy, probe_frac_low)
+
+ONLINE_TASKS = ["write the status line", "emit the reply text",
+                "produce the summary"]
+
+
+def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
+                    seed: int = 0, group_size: int = 4,
+                    max_attempts: int = 4, good_threshold: float = 0.75,
+                    lr: float = 0.02, pretrain_rounds: int = 60) -> dict:
+    import jax
+
+    from senweaver_ide_tpu.apo.local import make_local_apo
+    from senweaver_ide_tpu.apo.types import APOConfig
+    from senweaver_ide_tpu.models import get_config
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import (EnginePolicyClient, RolloutEngine,
+                                           RolloutSession)
+    from senweaver_ide_tpu.training import make_train_state
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+    from senweaver_ide_tpu.training.online import OnlineImprovementLoop
+    from senweaver_ide_tpu.traces.collector import TraceCollector
+
+    t0 = time.monotonic()
+    config = get_config("tiny-test")
+    tok = ByteTokenizer()
+    if ckpt and os.path.isdir(ckpt):
+        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
+        template = make_train_state(config, jax.random.PRNGKey(seed), None,
+                                    learning_rate=lr)
+        state, _ = CheckpointManager(ckpt).restore(template)
+        engine = RolloutEngine(state.params, config, num_slots=8,
+                               max_len=4096, eos_id=None, seed=seed)
+        pretrained = {"loaded_from": ckpt}
+    else:
+        state, engine, _tok, _cfg, curve = pretrain_rule_policy(
+            rounds=pretrain_rounds, lr=lr, seed=seed)
+        pretrained = {"rounds": pretrain_rounds, "curve_tail": curve[-5:]}
+
+    # Target the class the instruction-follower does NOT emit unprompted:
+    # the suite must fail until an optimizer moves something.
+    prior = probe_frac_low(engine, tok, [])
+    target_low = prior < 0.5
+
+    workdir = tempfile.mkdtemp(prefix="online_real_")
+    collector = TraceCollector()
+
+    def agreement_of(session) -> float:
+        ids = (session.client.call_log[-1][1]
+               if session.client.call_log else [])
+        f = frac_low(ids)
+        return f if target_low else 1.0 - f
+
+    # Judge with the episode's sampled tokens (2-arg feedback_fn form):
+    # good = on-target output within 2 attempts — same contract as the
+    # frozen uplift eval's scorer.
+    episode_log: List[dict] = []
+
+    def judge(trace, session) -> str:
+        ok = agreement_of(session) >= good_threshold
+        attempts = len(session.client.call_log)
+        fb = "good" if ok and attempts <= 2 else "bad"
+        episode_log.append({"ok": ok, "attempts": attempts, "fb": fb})
+        return fb
+
+    ws = itertools.count()
+
+    class RetrySession(RolloutSession):
+        """run_turn = a multi-attempt conversation: failed attempts draw
+        user follow-ups inside ONE trace (P4/P5 retry shape)."""
+
+        def run_turn(self, user_message: str):
+            def follow_up(_res, _turn):
+                if agreement_of(self) >= good_threshold:
+                    return None
+                return RETRY_FOLLOWUP
+            return self.run_conversation(user_message,
+                                         next_message=follow_up,
+                                         max_turns=max_attempts)
+
+    def make_session(*, rules: List[str], thread_id: str):
+        client = EnginePolicyClient(engine, tok,
+                                    default_max_new_tokens=16,
+                                    record_calls=True, auto_prefix=True)
+        return RetrySession(client, f"{workdir}/ws{next(ws)}",
+                            thread_id=thread_id, collector=collector,
+                            include_tool_definitions=False,
+                            system_message_override=minimal_sysmsg(rules))
+
+    # The APO half: bank-proposer optimizer + the real-rollout scorer
+    # (memoize=False — the engine's weights move between beam passes).
+    apo = make_local_apo(
+        collector, BankProposer(RULE_BANK, seed=seed),
+        config=APOConfig(beam_rounds=2),
+        score_fn=make_rule_scorer(engine, tok, workdir,
+                                  target_low=target_low,
+                                  good_threshold=good_threshold,
+                                  max_attempts=max_attempts,
+                                  memoize=False))
+
+    loop = OnlineImprovementLoop(
+        state, config, None, make_session, ONLINE_TASKS,
+        apo=apo, collector=collector, engine=engine,
+        group_size=group_size, pad_id=tok.pad_id, max_len=1024,
+        grpo_config=GRPOConfig(kl_coef=0.02, entropy_coef=0.02),
+        ppo_epochs=2, max_parallel=8, feedback_fn=judge, anchor_every=5)
+
+    per_round: List[dict] = []
+    ep_per_round = len(ONLINE_TASKS) * group_size
+    for r in range(rounds):
+        res = loop.run_round()
+        round_eps = episode_log[r * ep_per_round:(r + 1) * ep_per_round]
+        per_round.append({
+            "round": r,
+            "reward_mean": round(res.reward_mean, 4),
+            "rules_active": list(res.rules),
+            "analyzed": res.analyzed,
+            "beam_ran": res.beam_ran,
+            "good_rate": round(sum(e["fb"] == "good" for e in round_eps)
+                               / max(len(round_eps), 1), 3),
+            "mean_attempts": round(sum(e["attempts"] for e in round_eps)
+                                   / max(len(round_eps), 1), 2),
+            "loss": res.train_metrics.get("loss"),
+        })
+
+    curve = [p["reward_mean"] for p in per_round]
+    first_beam = next((p["round"] for p in per_round if p["beam_ran"]),
+                      None)
+    post_beam = ([p for p in per_round
+                  if first_beam is not None and p["round"] > first_beam]
+                 or [])
+    final_no_rule_prior = probe_frac_low(engine, tok, [])
+    report = {
+        "metric": "online_improvement_realpolicy",
+        "rounds": rounds,
+        "curve": curve,
+        "per_round": per_round,
+        "reward_initial": curve[0] if curve else None,
+        "reward_final": (round(sum(curve[-2:]) / 2, 4)
+                         if len(curve) >= 2 else None),
+        "first_beam_round": first_beam,
+        "rules_final": per_round[-1]["rules_active"] if per_round else [],
+        "improved": bool(curve and curve[-1] > curve[0] + 0.5),
+        "weights_refined_post_beam": bool(
+            len(post_beam) >= 2
+            and post_beam[-1]["reward_mean"]
+            > post_beam[0]["reward_mean"] + 1e-9),
+        "prior_frac_low_initial": round(prior, 4),
+        "prior_frac_low_final": round(final_no_rule_prior, 4),
+        "target_class": "low" if target_low else "high",
+        "pretrained": pretrained,
+        "policy": "real transformer (tiny-test); no scripted policy "
+                  "anywhere in the loop",
+        "reward_source": "9-dim reward head finalReward (no override)",
+        "config": {"group_size": group_size, "tasks": len(ONLINE_TASKS),
+                   "max_attempts": max_attempts,
+                   "good_threshold": good_threshold, "lr": lr,
+                   "seed": seed},
+        "wall_s": round(time.monotonic() - t0, 1),
+    }
+    return report
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=12)
+    ap.add_argument("--ckpt", default="/tmp/uplift_ckpt",
+                    help="rule-following checkpoint dir (missing → "
+                         "pretrain from scratch)")
+    ap.add_argument("--pretrain-rounds", type=int, default=60)
+    ap.add_argument("--group-size", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # tiny-model CPU work
+
+    report = run_online_eval(rounds=args.rounds, ckpt=args.ckpt,
+                             seed=args.seed, group_size=args.group_size,
+                             pretrain_rounds=args.pretrain_rounds)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:   # always leave a JSON line for the driver
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

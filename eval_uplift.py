@@ -35,6 +35,11 @@ def main() -> None:
                     help="run only the first N pattern tasks (smoke runs)")
     ap.add_argument("--engine-max-len", type=int, default=4096,
                     help="serving context bound for the real policy")
+    ap.add_argument("--holdout", action="store_true",
+                    help="scripted optimizer proposes from the hold-out "
+                         "rule bank (beam must search, not be handed the "
+                         "winner)")
+    ap.add_argument("--proposal-seed", type=int, default=0)
     args = ap.parse_args()
 
     if not args.model_dir or args.config.startswith("tiny"):
@@ -67,7 +72,9 @@ def main() -> None:
                   else SIX_PATTERN_TASKS)
     with tempfile.TemporaryDirectory() as workdir:
         report = run_uplift_eval(workdir, client=client, tasks=tasks,
-                                 beam_rounds=args.beam_rounds)
+                                 beam_rounds=args.beam_rounds,
+                                 holdout=args.holdout,
+                                 proposal_seed=args.proposal_seed)
     if args.model_dir:
         report["policy"] = {"model_dir": args.model_dir,
                             "config": args.config,

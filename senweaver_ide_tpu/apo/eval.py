@@ -42,15 +42,44 @@ SIX_PATTERN_TASKS: List[str] = [
     "Search the web for the API docs (pattern: slow tools)",         # P6
 ]
 
-# The behavior contract between rules and the scripted policy: a rule-set
-# "wins" iff it demands verified, minimal tool use. A real policy has the
-# same structure statistically; the markers make it exact for tests.
-CAREFUL_MARKERS = ("verify", "read the file before", "minimal tool",
-                   "minimum number of tool calls")
+# The behavior contract between rules and the scripted policy, GRADED
+# over two rule classes (the 6 problem patterns split the same way:
+# failure-type patterns P1/P2 respond to VERIFICATION, waste-type
+# P3-P6 to EFFICIENCY): a verification rule alone fixes the failures
+# but leaves churn; an efficiency rule alone trims calls but leaves
+# them unverified; only BOTH yield fully careful behavior. A real
+# policy has the same structure statistically; the markers make it
+# exact for tests — and graded, so beam search must COMPOSE the right
+# pair, not merely hit any one marker (VERDICT r3 weak #3).
+VERIFY_MARKERS = ("verify", "read the file before")
+EFFICIENCY_MARKERS = ("minimal tool", "minimum number of tool calls",
+                      "never retry")
+CAREFUL_MARKERS = VERIFY_MARKERS + EFFICIENCY_MARKERS
 
 GOOD_RULESET = [
     "Verify inputs and read the target file before any other tool call.",
     "Use the minimum number of tool calls needed; never retry blindly.",
+]
+
+# Hold-out proposal bank (VERDICT r3 weak #3): rule phrasings the
+# OPTIMIZER can propose, of which only SOME satisfy the policy's behavior
+# contract (CAREFUL_MARKERS) — and nothing in the proposer encodes which.
+# With this bank the beam must discover the steering subset by scored
+# search instead of being handed GOOD_RULESET in one shot; near-miss
+# paraphrases ("check your work", "act deliberately") read equally
+# plausible to a human but do NOT match the contract, exactly like rules
+# a real policy happens not to respond to.
+HOLDOUT_RULE_BANK = [
+    GOOD_RULESET[0],                                        # steers
+    GOOD_RULESET[1],                                        # steers
+    "Always verify inputs before taking any action.",       # steers
+    "Re-read the task description before editing.",         # near-miss
+    "Check your work carefully at every step.",             # near-miss
+    "Act deliberately; avoid unnecessary repetition.",      # near-miss
+    "Plan before acting and summarize after.",              # decoy
+    "Prefer small, reviewable changes.",                    # decoy
+    "Keep responses short and direct.",                     # decoy
+    "Escalate to the user when uncertain.",                 # decoy
 ]
 
 
@@ -149,6 +178,16 @@ class RuleSensitivePolicy:
     good_file: str = "app.py"
     sloppy_calls: int = 3
     improved_rules: Sequence[str] = tuple(GOOD_RULESET)
+    # Hold-out mode: apply-edit calls SAMPLE 2-rule subsets from this
+    # bank (seeded) instead of returning improved_rules outright — the
+    # optimizer no longer knows the answer, so the beam has to find the
+    # steering subset by scoring (VERDICT r3 weak #3).
+    proposal_bank: Optional[Sequence[str]] = None
+    proposal_seed: int = 0
+
+    def __post_init__(self):
+        import random
+        self._rng = random.Random(self.proposal_seed)
 
     def chat(self, messages: List[ChatMessage], *, temperature=None,
              max_tokens=None, on_text=None) -> LLMResponse:
@@ -158,9 +197,10 @@ class RuleSensitivePolicy:
             return self._optimizer_call(messages[-1].content if messages
                                         else "")
         rules_text = self._apo_rules_text(sysmsg.content).lower()
-        careful = any(m in rules_text for m in CAREFUL_MARKERS)
+        has_verify = any(m in rules_text for m in VERIFY_MARKERS)
+        has_eff = any(m in rules_text for m in EFFICIENCY_MARKERS)
         tool_msgs = sum(1 for m in messages if m.role == "tool")
-        if careful:
+        if has_verify and has_eff:         # fully careful: 1 good read
             if tool_msgs == 0:
                 return LLMResponse(
                     text="Checking the file first.",
@@ -168,6 +208,24 @@ class RuleSensitivePolicy:
                                               {"uri": self.good_file}),
                     usage=LLMUsage(300, 40), model="scripted")
             return LLMResponse(text="Done: verified and fixed.",
+                               usage=LLMUsage(300, 40), model="scripted")
+        if has_verify:                     # verified but churny: no
+            if tool_msgs < 4:              # failures, 4 re-reads → the
+                return LLMResponse(        # efficiency dims still drag
+                    text="Verifying the file again.",
+                    tool_call=ToolCallRequest("read_file",
+                                              {"uri": self.good_file}),
+                    usage=LLMUsage(600, 80), model="scripted")
+            return LLMResponse(text="Done after double-checking.",
+                               usage=LLMUsage(600, 80), model="scripted")
+        if has_eff:                        # minimal but unverified: one
+            if tool_msgs == 0:             # failed read, then answers —
+                return LLMResponse(        # the failure dims drag
+                    text="Acting without checking.",
+                    tool_call=ToolCallRequest(
+                        "read_file", {"uri": "missing_guess.py"}),
+                    usage=LLMUsage(300, 40), model="scripted")
+            return LLMResponse(text="Done, hopefully.",
                                usage=LLMUsage(300, 40), model="scripted")
         return self._sloppy_call(task_pattern(messages), tool_msgs)
 
@@ -216,9 +274,40 @@ class RuleSensitivePolicy:
         return fail_read() if tool_msgs < self.sloppy_calls else done()
 
     # -- optimizer-side scripted responses --------------------------------
+    @staticmethod
+    def _parent_rules(prompt: str) -> List[str]:
+        """Current rules from the apply-edit prompt's own section
+        (gradient.build_apply_edit_prompt) — what a real optimizer LLM
+        would read and revise."""
+        from .gradient import NO_RULES_PLACEHOLDER
+        if "## Current Prompt Rules" not in prompt:
+            return []
+        section = prompt.split("## Current Prompt Rules", 1)[1]
+        section = section.split("## Critique", 1)[0]
+        return [ln.strip().lstrip("- ").strip()
+                for ln in section.splitlines()
+                if ln.strip()
+                and NO_RULES_PLACEHOLDER.lower() not in ln.lower()]
+
+    def _holdout_proposal(self, prompt: str) -> List[str]:
+        """Hold-out mode: MUTATE the parent rule-set — keep one rule,
+        swap in a bank draw. The proposer encodes no knowledge of which
+        rules steer; composition quality emerges only through scored
+        selection across rounds (the graded contract needs a
+        verify+efficiency PAIR, so single-class parents improve
+        incrementally)."""
+        bank = list(self.proposal_bank)
+        parent = [r for r in self._parent_rules(prompt) if r]
+        keep = [self._rng.choice(parent)] if parent else []
+        draw = self._rng.choice([r for r in bank if r not in keep])
+        return keep + [draw] if keep else [draw,
+                                           self._rng.choice(bank)]
+
     def _optimizer_call(self, prompt: str) -> LLMResponse:
         if "## Critique" in prompt:      # apply-edit prompt
-            text = "\n".join(f"- {r}" for r in self.improved_rules)
+            rules = (self._holdout_proposal(prompt)
+                     if self.proposal_bank else self.improved_rules)
+            text = "\n".join(f"- {r}" for r in rules)
         else:                            # textual-gradient critique prompt
             text = ("- Tool calls fail because inputs are never verified; "
                     "require reading the target file before acting.\n"
@@ -260,7 +349,9 @@ def outcome_feedback(turn_result) -> Optional[str]:
 
 def run_uplift_eval(workdir: str, *, client=None,
                     tasks: Sequence[str] = tuple(SIX_PATTERN_TASKS),
-                    beam_rounds: int = 3) -> dict:
+                    beam_rounds: int = 3,
+                    holdout: bool = False,
+                    proposal_seed: int = 0) -> dict:
     """Baseline-vs-optimized finalReward on the pattern task suite (the
     north-star ≥2× comparison, BASELINE configs 2-3), fully offline.
 
@@ -276,7 +367,16 @@ def run_uplift_eval(workdir: str, *, client=None,
     from .local import make_local_apo
     from .types import APOConfig
 
-    client = client or RuleSensitivePolicy()
+    # holdout: the scripted optimizer proposes sampled subsets from the
+    # hold-out bank instead of handing over GOOD_RULESET — beam search
+    # must FIND the steering rules by score (VERDICT r3 weak #3). The
+    # bank only wires into the SCRIPTED client; a caller-supplied client
+    # (real policy) keeps its own optimizer behavior, and the report's
+    # holdout flag must say what actually ran.
+    holdout_wired = holdout and client is None
+    client = client or RuleSensitivePolicy(
+        proposal_bank=HOLDOUT_RULE_BANK if holdout else None,
+        proposal_seed=proposal_seed)
     ws_counter = [0]
 
     def make_session(rules, collector=None):
@@ -305,10 +405,17 @@ def run_uplift_eval(workdir: str, *, client=None,
 
     apo = make_local_apo(
         corpus, client,
-        config=APOConfig(beam_rounds=beam_rounds),
+        config=APOConfig(beam_rounds=1),
         score_fn=make_rollout_score_fn(make_session, tasks,
                                        feedback_fn=feedback_fn))
-    state = apo.run_beam_search(seed_prompt="")
+    # One visible round at a time: the per-round best progression is the
+    # "search matters" record — in holdout mode round 1 need not contain
+    # the winner, so later rounds must beat it for the ratio to appear.
+    round_best: List[float] = []
+    state = None
+    for _ in range(beam_rounds):
+        state = apo.run_beam_search(seed_prompt="")
+        round_best.append(round(state.history_best_score, 4))
     optimized_rules = apo.get_optimized_rules()
     optimized = evaluate_rules(optimized_rules, make_session, tasks,
                                feedback_fn=feedback_fn)
@@ -324,6 +431,10 @@ def run_uplift_eval(workdir: str, *, client=None,
                                       / max(baseline + 1.0, 1e-6), 4),
         "optimized_rules": list(optimized_rules),
         "beam_rounds": state.current_round,
+        "beam_round_best_scores": round_best,
+        "searched": bool(round_best
+                         and round_best[0] < round_best[-1] - 1e-9),
+        "holdout_bank": holdout_wired,
         "tasks": len(tasks),
         "evaluator": "outcome_feedback (symmetric)",
     }

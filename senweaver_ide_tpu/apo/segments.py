@@ -129,9 +129,20 @@ class SegmentStore:
     # --- beam best-prompt application (ref :1219-1264) ---
 
     def apply_beam_best_prompt(self, best: PromptVersion) -> None:
+        """Install the beam winner as the ACTIVE optimized rule-set.
+
+        The winner is a COMPLETE rule-set, not a delta: previously
+        beam-applied segments that are not part of it retire, so
+        repeated ``run_beam_search`` calls (resumed searches, the online
+        loop's auto-gradient ticks) converge on the current best instead
+        of accumulating every past round's winner into the prompt."""
         rules = [line for line in best.content.splitlines()
                  if line.strip().startswith("- ")]
-        if not rules:
+        if not rules and best.content.strip():
+            # Freeform winner (no '- ' lines): one core_behavior segment
+            # carries the whole prompt text, updated in place; other
+            # beam-applied segments retire (the winner is complete here
+            # too — leaving old bullets active would mix rule-sets).
             existing = next((s for s in self.segments
                              if s.category == "core_behavior" and s.is_active),
                             None)
@@ -143,19 +154,33 @@ class SegmentStore:
                 existing.version += 1
                 existing.updated_at = _now_ms()
             else:
-                self.segments.append(PromptSegment(
+                existing = PromptSegment(
                     id=new_id(), category="core_behavior", content=best.content,
+                    is_active=True, is_optimized=True)
+                self.segments.append(existing)
+            for s in self.segments:
+                if (s is not existing and s.is_active and s.is_optimized
+                        and s.category == "core_behavior"):
+                    s.is_active = False
+                    s.updated_at = _now_ms()
+            self._save()
+            return
+        new_contents = {r.strip()[2:].strip() for r in rules}
+        new_contents.discard("")
+        for s in self.segments:
+            if (s.is_active and s.is_optimized
+                    and s.category == "core_behavior"
+                    and s.content not in new_contents):
+                s.is_active = False
+                s.updated_at = _now_ms()
+        for content in [r.strip()[2:].strip() for r in rules]:
+            if not content:
+                continue
+            if not any(s.is_active and s.content == content
+                       for s in self.segments):
+                self.segments.append(PromptSegment(
+                    id=new_id(), category="core_behavior", content=content,
                     is_active=True, is_optimized=True))
-        else:
-            for rule in rules:
-                content = rule.strip()[2:].strip()
-                if not content:
-                    continue
-                if not any(s.is_active and s.content == content
-                           for s in self.segments):
-                    self.segments.append(PromptSegment(
-                        id=new_id(), category="core_behavior", content=content,
-                        is_active=True, is_optimized=True))
         self._save()
 
     # --- persistence ---

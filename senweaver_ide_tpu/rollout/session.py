@@ -188,19 +188,47 @@ class RolloutSession:
     # -- turns -------------------------------------------------------------
     def run_turn(self, user_message: str) -> TurnResult:
         """One user turn: checkpoint → trace → agent loop → reward."""
-        self.checkpoints.add_checkpoint(self._message_idx, "user_turn")
+        return self.run_conversation(user_message)
+
+    def run_conversation(self, first_message: str, *,
+                         next_message=None,
+                         max_turns: int = 1) -> TurnResult:
+        """Up to ``max_turns`` user turns inside ONE conversation trace.
+
+        The reference's traces span a whole thread — its P4/P5 problem
+        patterns count LLM calls and USER MESSAGES per trace ("poor
+        first-attempt resolution" needs ≥4 user messages in one trace,
+        apoService.ts:712-750) — so eval harnesses that model a user
+        retrying must keep the trace open across the follow-ups;
+        per-turn traces can never express those patterns.
+
+        ``next_message(turn_result, turn_idx)`` supplies each follow-up
+        (return None to stop early, e.g. once an evaluator passes the
+        output). The trace ends once, after the last turn."""
         trace_id = self.collector.start_trace(
             self.thread_id, metadata={"chatMode": self.chat_mode})
         comp = get_composition(self.chat_mode)
-        result = self.loop.run(comp.primary_agent, user_message,
-                               system_message=self.system_message(),
-                               history=self.history)
-        self.history.append(ChatMessage("user", user_message))
-        if result.final_text:
-            self.history.append(ChatMessage("assistant",
-                                            result.final_text))
-        self._message_idx = len(self.history)
-        self.checkpoints.add_checkpoint(self._message_idx, "stream_end")
+        msg: Optional[str] = first_message
+        result = None
+        for turn in range(max(1, max_turns)):
+            # Every user message gets its rewind point, follow-ups
+            # included (same granularity run_turn always had).
+            self.checkpoints.add_checkpoint(self._message_idx, "user_turn")
+            result = self.loop.run(comp.primary_agent, msg,
+                                   system_message=self.system_message(),
+                                   history=self.history)
+            self.history.append(ChatMessage("user", msg))
+            if result.final_text:
+                self.history.append(ChatMessage("assistant",
+                                                result.final_text))
+            self._message_idx = len(self.history)
+            self.checkpoints.add_checkpoint(self._message_idx,
+                                            "stream_end")
+            if next_message is None or turn == max_turns - 1:
+                break
+            msg = next_message(TurnResult(loop=result, trace=None), turn)
+            if msg is None:
+                break
         self.collector.end_trace_for_thread(self.thread_id)
         trace = self.collector.get_trace(trace_id)
         return TurnResult(loop=result, trace=trace)

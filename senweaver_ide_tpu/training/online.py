@@ -132,6 +132,20 @@ class OnlineImprovementLoop:
                 "collection (max_parallel > 1) would cross-attribute "
                 "episode traces — extend the factory or pass "
                 "max_parallel=1")
+        # feedback_fn may take (trace) — the reference's outcome shape —
+        # or (trace, session) for judges that need the episode's sampled
+        # token ids (EnginePolicyClient.call_log), e.g. real-policy
+        # output-style evaluators.
+        self._feedback_takes_session = False
+        if feedback_fn is not None:
+            try:
+                sig = inspect.signature(feedback_fn)
+                self._feedback_takes_session = len([
+                    p for p in sig.parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY,
+                                  p.POSITIONAL_OR_KEYWORD)]) >= 2
+            except (TypeError, ValueError):
+                pass
 
     def current_rules(self) -> List[str]:
         return self.apo.get_optimized_rules()
@@ -157,7 +171,9 @@ class OnlineImprovementLoop:
             # feedback dim) or the caller's override scores the episode.
             trace = self.collector.get_active_trace(session.thread_id)
             if self.feedback_fn is not None and trace is not None:
-                fb = self.feedback_fn(trace)
+                fb = (self.feedback_fn(trace, session)
+                      if self._feedback_takes_session
+                      else self.feedback_fn(trace))
                 if fb:
                     session.record_feedback(fb)
             if self.reward_override is not None:

@@ -110,3 +110,35 @@ def test_real_policy_uplift_path_end_to_end(tmp_path):
     assert "error" not in report, report
     assert report["policy"]["config"] == "tiny-test"
     assert "baseline_final_reward" in report
+
+
+def test_graded_contract_single_class_is_partial(harness):
+    """The behavior contract is GRADED (VERDICT r3 weak #3): one rule
+    class alone lands strictly between sloppy and fully careful, so the
+    beam must COMPOSE a verify+efficiency pair rather than hit any
+    single marker."""
+    _, make_session = harness
+    tasks = SIX_PATTERN_TASKS[:3]
+    base = evaluate_rules([], make_session, tasks)
+    verify_only = evaluate_rules(
+        ["Always verify inputs before taking any action."],
+        make_session, tasks)
+    eff_only = evaluate_rules(
+        ["Use the minimum number of tool calls needed."],
+        make_session, tasks)
+    full = evaluate_rules(GOOD_RULESET, make_session, tasks)
+    assert base < verify_only < full
+    assert base < eff_only < full
+
+
+def test_holdout_uplift_searches_across_rounds(tmp_path):
+    """Hold-out proposer + graded contract: the beam's best must IMPROVE
+    across rounds (round 1 is not handed the winner) and still reach the
+    >=2x shifted ratio."""
+    from senweaver_ide_tpu.apo.eval import run_uplift_eval
+
+    report = run_uplift_eval(str(tmp_path), beam_rounds=4, holdout=True)
+    assert report["holdout_bank"] is True
+    bests = report["beam_round_best_scores"]
+    assert report["searched"] and bests[0] < bests[-1]
+    assert report["uplift_ratio_shifted"] >= 2.0
