@@ -259,8 +259,56 @@ def _measure(model_name: str, batch: int, prompt_len: int,
     return batch * decode_tokens * TIMED_ITERS / (t_hi - t_lo)
 
 
+def _init_int8_params(config, key):
+    """Random int8 serving params built DIRECTLY in int8 on device.
+
+    The honest route (bf16 init → models/quantize) needs the 13.4 GB
+    bf16 tree plus a 5.8 GB fp32 transient for w_gate's absmax pass —
+    past one 16 GB chip at 6.7B. Decode throughput is weight-HBM-bound,
+    so random int8 values with constant per-channel scales stream
+    exactly the same bytes through the same ``transformer._dense`` int8
+    epilogue; only the sampled text is meaningless (fine for a bench).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from senweaver_ide_tpu.models.quantize import dense_family_shapes
+
+    c = config
+    L, D, V = c.num_layers, c.hidden_size, c.vocab_size
+    q_dim, kv_dim = c.q_dim, c.kv_dim
+    shapes = dense_family_shapes(config)   # raises on MoE configs
+    keys = jax.random.split(key, len(shapes) + 2)
+    layers = {"attn_norm": jnp.ones((L, D), c.dtype),
+              "mlp_norm": jnp.ones((L, D), c.dtype)}
+    for k, (name, (fan_in, out)) in zip(keys, shapes.items()):
+        layers[name] = jax.random.randint(k, (L, fan_in, out), -127, 128,
+                                          jnp.int8)
+        layers[name + "_scale"] = jnp.full(
+            (L, out), 1.0 / (127.0 * fan_in ** 0.5), jnp.float32)
+    if c.qkv_bias:
+        layers["bq"] = jnp.zeros((L, q_dim), c.dtype)
+        layers["bk"] = jnp.zeros((L, kv_dim), c.dtype)
+        layers["bv"] = jnp.zeros((L, kv_dim), c.dtype)
+    if c.qk_norm:
+        layers["q_norm"] = jnp.ones((L, c.head_dim), c.dtype)
+        layers["k_norm"] = jnp.ones((L, c.head_dim), c.dtype)
+    params = {
+        "embed": jax.random.normal(keys[-2], (V, D), c.dtype) * 0.02,
+        "layers": layers,
+        "final_norm": jnp.ones((D,), c.dtype),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = jax.random.randint(keys[-1], (D, V), -127, 128,
+                                               jnp.int8)
+        params["lm_head_scale"] = jnp.full(
+            (V,), 1.0 / (127.0 * D ** 0.5), jnp.float32)
+    return params
+
+
 def _measure_steps(model_name: str, batch: int, prompt_len: int,
-                   decode_tokens: int, *, quantized: bool = False) -> float:
+                   decode_tokens: int, *, quantized: bool = False,
+                   weight_quant: bool = False) -> float:
     """Decode tokens/sec via pipelined per-step dispatch (the `generate`
     / rollout-engine serving path): prefill once, then ``decode_tokens``
     back-to-back ``decode_step`` dispatches, blocking only at the end.
@@ -282,7 +330,9 @@ def _measure_steps(model_name: str, batch: int, prompt_len: int,
                                                    prefill)
 
     config = get_config(model_name)
-    params = jax.block_until_ready(init_params(config, jax.random.PRNGKey(0)))
+    params = jax.block_until_ready(
+        _init_int8_params(config, jax.random.PRNGKey(0)) if weight_quant
+        else init_params(config, jax.random.PRNGKey(0)))
     sample = SampleParams(temperature=0.8, top_k=0, top_p=0.0)
     cache = init_kv_cache(config, batch, prompt_len + decode_tokens + 1,
                           quantized=quantized)
@@ -410,22 +460,28 @@ def main() -> None:
 
     extra = {}
     if on_accel:
-        for name, b, p, n, key, quant, mode in (
+        for name, b, p, n, key, quant, wq, mode in (
                 ("qwen2.5-coder-1.5b", 32, 512, 128, "qwen1.5b_b32",
-                 False, "scan"),
+                 False, False, "scan"),
                 # int8 KV cache + donated cache buffers are what fit b16
                 # next to 13.4 GB of bf16 weights (bf16 cache tops out at
                 # b8 ≈ 166 tok/s); the AOT helper rejects this model's
                 # prefill+scan graphs, so measure via the per-step serving
                 # path directly.
                 ("deepseek-coder-6.7b", 16, 128, 96,
-                 "deepseek6.7b_b16_int8kv", True, "steps"),
+                 "deepseek6.7b_b16_int8kv", True, False, "steps"),
+                # The SEVENB_r04 serving plan on silicon: int8 weights
+                # (6.4 GB, built directly in int8 — _init_int8_params)
+                # + int8 KV. Streams half the bytes of the bf16 row;
+                # expected ~2x its tok/s if decode stays HBM-bound.
+                ("deepseek-coder-6.7b", 16, 128, 96,
+                 "deepseek6.7b_b16_int8w_int8kv", True, True, "steps"),
                 # The SWA family (mistral-7b). At this shape the cache
                 # (193 < window) runs the absolute short-cache SWA path;
                 # a full 4096-slot ring at b4 would be 4.3 GB of cache
                 # next to 14.5 GB of bf16 weights — past one 16 GB chip.
                 ("mistral-7b", 4, 128, 64, "mistral7b_b4_swa",
-                 False, "steps"),
+                 False, False, "steps"),
         ):
             if mode == "scan":
                 try:
@@ -441,7 +497,8 @@ def main() -> None:
                 key += "_hostloop"
             try:
                 extra[key] = round(
-                    _measure_steps(name, b, p, n, quantized=quant), 2)
+                    _measure_steps(name, b, p, n, quantized=quant,
+                                   weight_quant=wq), 2)
             except Exception as e:
                 extra[key] = f"error: {type(e).__name__}: {e}"[:200]
 

@@ -78,8 +78,11 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
                                max_len=4096, eos_id=None, seed=seed)
         pretrained = {"loaded_from": ckpt}
     else:
+        # Explicit recipe kwargs (the proven 2-group x 16 regime) so a
+        # default change upstream cannot silently alter this eval.
         state, engine, _tok, _cfg, curve = pretrain_rule_policy(
-            rounds=pretrain_rounds, lr=lr, seed=seed)
+            rounds=pretrain_rounds, lr=lr, seed=seed, group_size=16,
+            tasks_per_class=1)
         pretrained = {"rounds": pretrain_rounds, "curve_tail": curve[-5:]}
 
     # Target the class the instruction-follower does NOT emit unprompted:

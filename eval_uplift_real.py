@@ -71,8 +71,11 @@ RULE_BANK = [
     "Prefer structured output over prose.",
 ]
 
-# Pretraining user texts (two, so the policy cannot key on one exact user
-# string) and held-out eval texts (never seen during pretraining).
+# Pretraining user texts. The default recipe (tasks_per_class=1) trains
+# on the FIRST text only — the user text is identical across contrastive
+# classes either way, so it carries no class signal, and held-out probes
+# verify generalization; pass tasks_per_class=2 to add text variety at
+# 2x the per-round episode cost. EVAL_TEXTS are never seen in training.
 PRETRAIN_TEXTS = ["write an output record", "emit the data bytes"]
 EVAL_TEXTS = ["write the log line", "emit the payload",
               "produce the message body", "write the record",
@@ -81,18 +84,33 @@ EVAL_TEXTS = ["write the log line", "emit the payload",
 LOW_CLASS = frozenset(range(0, 128))
 
 
-def minimal_sysmsg(rules: Sequence[str]) -> str:
-    """Short system message with the REAL APO-rules rendering.
+def realistic_prefix(n_bytes: int) -> str:
+    """First ``n_bytes`` of the REAL assembled agent system message —
+    the filler for prompt-length frontier experiments (VERDICT r3 #4:
+    conditioning proven at ~30 bytes, unproven under the ~1.8k-byte
+    production prompt; the frontier measures where it breaks)."""
+    from senweaver_ide_tpu.prompts.system import chat_system_message
 
-    Prompt length is pinned near the proven-conditioning regime
-    (eval_learning --short-prompt; the full ~1.8k-byte assembled prompt
-    is the separate capacity frontier tracked by
-    LEARNING_CONTEXTUAL_FULLPROMPT) while the rules still ride
-    ``render_apo_rules`` — the same injection semantics as production
-    sessions (prompts/system.py)."""
+    text = chat_system_message(
+        chat_mode="agent", workspace_folders=("/workspace",),
+        directory_str="src/\n  app.py\n  lib.py\n  tests/\n    test_app.py",
+        include_tool_definitions=True)
+    return text[:max(0, n_bytes)]
+
+
+def minimal_sysmsg(rules: Sequence[str], *, prefix_bytes: int = 0) -> str:
+    """System message with the REAL APO-rules rendering.
+
+    ``prefix_bytes == 0``: a ~25-byte base — the proven-conditioning
+    regime (eval_learning --short-prompt). ``prefix_bytes > 0``: that
+    many bytes of the REAL assembled prompt precede the rules section
+    (rules stay LAST, exactly where production assembly puts them —
+    prompts/system.py chat_system_message), so the frontier varies
+    prefix LENGTH alone."""
     from senweaver_ide_tpu.prompts.system import render_apo_rules
 
-    base = "You are a byte emitter."
+    base = (realistic_prefix(prefix_bytes) if prefix_bytes > 0
+            else "You are a byte emitter.")
     apo = render_apo_rules(list(rules))
     return base + ("\n\n" + apo if apo else "")
 
@@ -137,10 +155,12 @@ class BankProposer:
 # ---------------------------------------------------------------------------
 
 def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
-                         group_size: int = 8, max_new_tokens: int = 16,
+                         group_size: int = 16, max_new_tokens: int = 16,
                          seed: int = 0, max_parallel: int = 8,
                          anchor_kl: float = 0.02, anchor_every: int = 5,
                          stop_mean: float = 0.9, stop_window: int = 4,
+                         tasks_per_class: int = 1, prefix_bytes: int = 0,
+                         model: str = "tiny-test",
                          state=None, engine=None):
     """GRPO-pretrain rule-conditional byte emission; returns
     (state, engine, tok, config, curve).
@@ -151,7 +171,15 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
     non-deterministic even at a fixed seed — some runs see-saw in the
     contrastive phase far longer than others (observed r4) — so callers
     should check the final window and retry with a fresh seed rather
-    than assume convergence."""
+    than assume convergence.
+
+    ``tasks_per_class`` defaults to 1: the r3 contextual recipe's
+    proven regime is 2 contrastive groups x group 16 (splitting the
+    episode budget over more groups thins per-group advantages and
+    drops the convergence rate to ~1 in 4, observed r4). Rule-vs-user-
+    text disentanglement does not need text variety — the user text is
+    IDENTICAL across classes either way — and generalization to unseen
+    texts is verified by the held-out probes afterwards."""
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -161,7 +189,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
     from senweaver_ide_tpu.training import grpo_round, make_train_state
     from senweaver_ide_tpu.training.grpo import GRPOConfig
 
-    config = get_config("tiny-test")
+    config = get_config(model)
     tok = ByteTokenizer()
     if state is None:
         state = make_train_state(config, jax.random.PRNGKey(seed), None,
@@ -175,14 +203,15 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
     # before the user message reaches the policy, so both groups see the
     # SAME user text and only the rules section differs.
     rule_of_key = {"low": [RULE_LOW], "high": [RULE_HIGH]}
-    tasks = [f"{key}|{text}" for text in PRETRAIN_TEXTS
+    tasks = [f"{key}|{text}"
+             for text in PRETRAIN_TEXTS[:max(1, tasks_per_class)]
              for key in ("low", "high")]
 
     class RuleTaskSession(RolloutSession):
         def run_turn(self, user_message: str):
             key, _, text = user_message.partition("|")
             self.system_message_override = minimal_sysmsg(
-                rule_of_key.get(key, []))
+                rule_of_key.get(key, []), prefix_bytes=prefix_bytes)
             return super().run_turn(text)
 
     ws = itertools.count()
@@ -218,10 +247,42 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
             anchor = state.params
         ep = [e.reward for e in out.episodes]
         curve.append(round(sum(ep) / len(ep), 4))
+        print(f"[pretrain seed={seed}] round {r + 1}/{rounds} "
+              f"reward {curve[-1]}", file=sys.stderr, flush=True)
         if (len(curve) >= stop_window
                 and sum(curve[-stop_window:]) / stop_window >= stop_mean):
             break
     return state, engine, tok, config, curve
+
+
+def pretrain_with_retries(*, max_attempts: int = 3, seed: int = 0,
+                          seed_stride: int = 1, accept_tail: float = 0.75,
+                          tail_window: int = 4, **pretrain_kw):
+    """Run ``pretrain_rule_policy`` up to ``max_attempts`` times with
+    strided seeds, keeping the BEST attempt by final-window reward mean
+    (concurrent collection makes convergence stochastic; the frozen
+    phase must never run on a policy that cannot follow rules).
+
+    Returns (state, engine, tok, config, curve, seed_used, attempts_log).
+    """
+    best = None
+    attempts = []
+    for a in range(max_attempts):
+        s = seed + seed_stride * a
+        state, engine, tok, config, curve = pretrain_rule_policy(
+            seed=s, **pretrain_kw)
+        tail = (sum(curve[-tail_window:])
+                / max(len(curve[-tail_window:]), 1))
+        attempts.append({"seed": s, "rounds_run": len(curve),
+                         "final_window_mean": round(tail, 4)})
+        print(f"[pretrain] attempt seed={s} tail={tail:.3f}",
+              file=sys.stderr, flush=True)
+        if best is None or tail > best[0]:
+            best = (tail, state, engine, tok, config, curve, s)
+        if tail >= accept_tail:
+            break
+    _tail, state, engine, tok, config, curve, seed_used = best
+    return state, engine, tok, config, curve, seed_used, attempts
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +290,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
 # ---------------------------------------------------------------------------
 
 def probe_frac_low(engine, tok, rules: Sequence[str], *, episodes: int = 8,
-                   max_new_tokens: int = 16,
+                   max_new_tokens: int = 16, prefix_bytes: int = 0,
                    user_text: str = "write the response bytes") -> float:
     """Mean low-byte fraction of real sampled episodes under ``rules``."""
     from senweaver_ide_tpu.rollout import EnginePolicyClient, RolloutSession
@@ -242,7 +303,8 @@ def probe_frac_low(engine, tok, rules: Sequence[str], *, episodes: int = 8,
                                     record_calls=True, auto_prefix=True)
         sess = RolloutSession(client, f"{workdir}/p{i}",
                               include_tool_definitions=False,
-                              system_message_override=minimal_sysmsg(rules))
+                              system_message_override=minimal_sysmsg(
+                                  rules, prefix_bytes=prefix_bytes))
         try:
             sess.run_turn(user_text)
             ids = client.call_log[-1][1] if client.call_log else []
@@ -427,7 +489,7 @@ def run_real_uplift(engine, tok, *, beam_rounds: int = 3,
                       f"(agreement >= {good_threshold}; judge-failed "
                       "attempts draw user follow-ups in the same trace, "
                       "good requires success within 2 attempts)"),
-        "policy": "real transformer (tiny-test), frozen after pretraining",
+        "policy": "real transformer, frozen after pretraining",
         "uplift_wall_s": round(time.monotonic() - t0, 1),
     }
 
@@ -436,10 +498,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=80,
                     help="pretraining GRPO rounds")
-    ap.add_argument("--group-size", type=int, default=8)
+    ap.add_argument("--group-size", type=int, default=16)
     ap.add_argument("--lr", type=float, default=0.02)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--beam-rounds", type=int, default=3)
+    ap.add_argument("--model", default="tiny-test",
+                    help="pretrain model preset (small-test = the "
+                         "capacity fallback when tiny cannot condition)")
     ap.add_argument("--save-dir", default=None,
                     help="save the pretrained checkpoint here")
     ap.add_argument("--load-dir", default=None,
@@ -460,7 +525,7 @@ def main() -> None:
         from senweaver_ide_tpu.training import make_train_state
         from senweaver_ide_tpu.training.checkpoint import CheckpointManager
 
-        config = get_config("tiny-test")
+        config = get_config(args.model)
         template = make_train_state(config, jax.random.PRNGKey(args.seed),
                                     None, learning_rate=args.lr)
         state, _meta = CheckpointManager(args.load_dir).restore(template)
@@ -473,18 +538,10 @@ def main() -> None:
         # fresh seeds until the final window shows conditioning, so the
         # frozen-policy phase never runs on a policy that cannot follow
         # rules (that measures nothing).
-        attempts = []
-        seed = args.seed
-        for attempt in range(3):
-            seed = args.seed + attempt
-            state, engine, tok, config, curve = pretrain_rule_policy(
-                rounds=args.rounds, lr=args.lr,
-                group_size=args.group_size, seed=seed)
-            tail = sum(curve[-4:]) / max(len(curve[-4:]), 1)
-            attempts.append({"seed": seed, "rounds_run": len(curve),
-                             "final_window_mean": round(tail, 4)})
-            if tail >= 0.75:
-                break
+        state, engine, tok, config, curve, seed, attempts = \
+            pretrain_with_retries(seed=args.seed, rounds=args.rounds,
+                                  lr=args.lr, group_size=args.group_size,
+                                  model=args.model)
         if args.save_dir:
             from senweaver_ide_tpu.training.checkpoint import \
                 CheckpointManager

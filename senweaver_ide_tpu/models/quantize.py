@@ -36,6 +36,21 @@ import jax.numpy as jnp
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def dense_family_shapes(config) -> Dict[str, tuple]:
+    """(fan_in, out) per dense family for a NON-MoE config — the one
+    source of truth for sizing tables and direct-int8 initializers
+    (bench/eval scripts otherwise each restate this table and drift)."""
+    c = config
+    if c.num_experts > 0:
+        raise ValueError("dense_family_shapes: MoE configs carry (L, E, "
+                         "in, out) expert banks — size those explicitly")
+    D, F = c.hidden_size, c.intermediate_size
+    q_dim, kv_dim = c.q_dim, c.kv_dim
+    return {"wq": (D, q_dim), "wk": (D, kv_dim), "wv": (D, kv_dim),
+            "wo": (q_dim, D), "w_gate": (D, F), "w_up": (D, F),
+            "w_down": (F, D)}
+
+
 def _quantize_matrix(w: jax.Array):
     """(…, in, out) → int8 values + fp32 (…, out) per-channel scales."""
     wf = w.astype(jnp.float32)
