@@ -34,6 +34,7 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       contextual: bool = False,
                       model: str = "tiny-test",
                       lora_rank: int = 0,
+                      qlora: bool = False,
                       short_prompt: bool = False,
                       anchor_kl: float = 0.0,
                       anchor_every: int = 5) -> dict:
@@ -48,6 +49,9 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                                             materialize_lora)
     from senweaver_ide_tpu.training.grpo import GRPOConfig
 
+    if qlora and lora_rank <= 0:
+        raise ValueError("qlora requires lora_rank > 0 (adapters over an "
+                         "int8 base); a full-FT run cannot be QLoRA")
     config = get_config(model)
     # lora_rank > 0: the adapter-only variant of the same proof — the
     # frozen base plus rank-r factors must STILL climb the curve (the
@@ -56,6 +60,14 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
     if lora_rank > 0:
         from senweaver_ide_tpu.models import init_params
         lora_base = init_params(config, jax.random.PRNGKey(seed))
+        if qlora:
+            # QLoRA: the frozen base is int8 (models/quantize.py) and
+            # stays int8 through serving — materialize_lora folds the
+            # trained adapters back into an int8 tree, so the engine
+            # runs the same weight-quantized path the 6.7B plan uses.
+            from senweaver_ide_tpu.models.quantize import \
+                quantize_weights_int8
+            lora_base = quantize_weights_int8(lora_base)
         state = make_lora_train_state(config, lora_base,
                                      jax.random.PRNGKey(seed + 1),
                                      rank=lora_rank, learning_rate=lr)
@@ -172,7 +184,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                    "max_new_tokens": max_new_tokens,
                    "ppo_epochs": ppo_epochs, "seed": seed,
                    "contextual": contextual, "model": model,
-                   "lora_rank": lora_rank, "short_prompt": short_prompt,
+                   "lora_rank": lora_rank, "qlora": qlora,
+                   "short_prompt": short_prompt,
                    "anchor_kl": anchor_kl, "anchor_every": anchor_every},
         "wall_s": round(time.monotonic() - t0, 1),
     }
@@ -223,6 +236,10 @@ def main() -> None:
     ap.add_argument("--lora-rank", type=int, default=0,
                     help="train rank-r LoRA adapters on a frozen base "
                          "instead of full fine-tuning (0 = full)")
+    ap.add_argument("--qlora", action="store_true",
+                    help="int8-quantize the frozen LoRA base (requires "
+                         "--lora-rank > 0): adapters train bf16, the "
+                         "engine serves the int8 fold")
     ap.add_argument("--model", default="tiny-test",
                     help="model preset (small-test for the contextual "
                          "capacity run)")
@@ -245,6 +262,7 @@ def main() -> None:
                                ppo_epochs=args.ppo_epochs, seed=args.seed,
                                contextual=args.contextual,
                                model=args.model, lora_rank=args.lora_rank,
+                               qlora=args.qlora,
                                short_prompt=args.short_prompt,
                                anchor_kl=args.anchor_kl,
                                anchor_every=args.anchor_every)

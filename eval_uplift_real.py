@@ -83,6 +83,10 @@ EVAL_TEXTS = ["write the log line", "emit the payload",
 
 LOW_CLASS = frozenset(range(0, 128))
 
+# User-patience bound per episode (see --max-attempts help): one source
+# of truth for the argparse default and both scorer entry points.
+DEFAULT_MAX_ATTEMPTS = 8
+
 
 def realistic_prefix(n_bytes: int) -> str:
     """First ``n_bytes`` of the REAL assembled agent system message —
@@ -320,7 +324,7 @@ RETRY_FOLLOWUP = "That is not right. Follow the required style and emit again."
 def make_rule_scorer(engine, tok, workdir: str, *, target_low: bool,
                      eval_tasks: Sequence[str] = tuple(EVAL_TEXTS),
                      max_new_tokens: int = 16, good_threshold: float = 0.75,
-                     max_attempts: int = 6,
+                     max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                      corpus=None, score_log: Optional[list] = None,
                      memoize: bool = True):
     """Prompt-conditioned ScoreFn on the REAL policy: re-roll the held-out
@@ -414,7 +418,7 @@ def run_real_uplift(engine, tok, *, beam_rounds: int = 3,
                     proposer_seed: int = 0,
                     good_threshold: float = 0.75,
                     eval_tasks: Sequence[str] = tuple(EVAL_TEXTS),
-                    max_attempts: int = 6,
+                    max_attempts: int = DEFAULT_MAX_ATTEMPTS,
                     probe_episodes: int = 8) -> dict:
     """Probes + full APO cycle on the frozen engine params; returns the
     report dict (no weight update happens anywhere in here)."""
@@ -489,6 +493,10 @@ def run_real_uplift(engine, tok, *, beam_rounds: int = 3,
                       f"(agreement >= {good_threshold}; judge-failed "
                       "attempts draw user follow-ups in the same trace, "
                       "good requires success within 2 attempts)"),
+        "evaluator_config": {"max_attempts": max_attempts,
+                             "good_threshold": good_threshold,
+                             "probe_episodes": probe_episodes,
+                             "beam_rounds": beam_rounds},
         "policy": "real transformer, frozen after pretraining",
         "uplift_wall_s": round(time.monotonic() - t0, 1),
     }
@@ -502,6 +510,15 @@ def main() -> None:
     ap.add_argument("--lr", type=float, default=0.02)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--beam-rounds", type=int, default=3)
+    ap.add_argument("--max-attempts", type=int,
+                    default=DEFAULT_MAX_ATTEMPTS,
+                    help="user-patience bound per episode: a judge-"
+                         "failed output draws follow-ups in the same "
+                         "trace until this many attempts; 8 puts an "
+                         "un-steered episode's llm-call count at the "
+                         "agent-mode response-efficiency floor (T=3, "
+                         "-0.4/extra call) — the severity band the "
+                         "reference's P4 retries pattern describes")
     ap.add_argument("--model", default="tiny-test",
                     help="pretrain model preset (small-test = the "
                          "capacity fallback when tiny cannot condition)")
@@ -550,7 +567,8 @@ def main() -> None:
     pretrain_wall = time.monotonic() - t0
 
     report = run_real_uplift(engine, tok, beam_rounds=args.beam_rounds,
-                             proposer_seed=args.seed)
+                             proposer_seed=args.seed,
+                             max_attempts=args.max_attempts)
     report["pretrain"] = {
         "rounds": len(curve), "curve": curve,
         "group_size": args.group_size, "lr": args.lr,
