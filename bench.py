@@ -109,9 +109,11 @@ def _artifact_summaries() -> dict:
     if learn and "uplift" in learn:
         out["grpo_learning_uplift"] = learn["uplift"]
         out["grpo_learning_final"] = learn.get("reward_final")
-    up = read("UPLIFT_r03.json")
-    if up and "uplift_ratio_shifted" in up:
+    up = next((d for d in (read("UPLIFT_r04.json"), read("UPLIFT_r03.json"))
+               if d and "uplift_ratio_shifted" in d), None)
+    if up:
         out["apo_uplift_ratio_shifted"] = up["uplift_ratio_shifted"]
+        out["apo_uplift_searched"] = up.get("searched")
     spec = read("SPEC_r03.json")
     if spec and "gain" in spec:
         out["speculative_acceptance_gain"] = spec["gain"]
@@ -122,9 +124,30 @@ def _artifact_summaries() -> dict:
         out["contextual_peak_window_mean"] = ctx["peak_window_mean"]
         out["contextual_conditioned"] = ctx.get("conditioned")
         out["contextual_final"] = ctx.get("reward_final")
-    lora = read("LEARNING_LORA_r03.json")
-    if lora and "uplift" in lora:
+    lora = next((d for d in (read("LEARNING_LORA_r04.json"),
+                             read("LEARNING_LORA_r03.json"))
+                 if d and "uplift" in d), None)
+    if lora:
         out["lora_learning_uplift"] = lora["uplift"]
+        out["lora_learning_final"] = lora.get("reward_final")
+    qlora = read("LEARNING_QLORA_r04.json")
+    if qlora and "uplift" in qlora:
+        out["qlora_learning_uplift"] = qlora["uplift"]
+    # round-4 headline artifacts: the north star on REAL weights
+    real = read("UPLIFT_REALPOLICY_r04.json")
+    if real and "uplift_ratio_shifted" in real:
+        out["apo_uplift_realpolicy_ratio"] = real["uplift_ratio_shifted"]
+        out["realpolicy_conditioning_delta"] = real.get(
+            "conditioning_delta")
+    online = read("ONLINE_r04.json")
+    if online and "curve" in online and online["curve"]:
+        out["online_loop_reward_first"] = online["curve"][0]
+        out["online_loop_reward_final"] = online["curve"][-1]
+    sevenb = read("SEVENB_r04.json")
+    if sevenb and isinstance(sevenb.get("sizing"), dict):
+        plans = sevenb["sizing"].get("plans_gb")
+        if isinstance(plans, dict):
+            out["sevenb_qlora_plan_gb"] = plans.get("qlora_int8_base")
     return out
 
 

@@ -37,7 +37,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                       qlora: bool = False,
                       short_prompt: bool = False,
                       anchor_kl: float = 0.0,
-                      anchor_every: int = 5) -> dict:
+                      anchor_every: int = 5,
+                      capture: dict = None) -> dict:
     import jax
 
     from senweaver_ide_tpu.models import get_config
@@ -168,6 +169,11 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         curve.append(round(sum(means) / len(means), 4))
         per_task.append([round(m, 4) for m in means])
 
+    if capture is not None:
+        # Downstream evals (e.g. eval_moe_int8's trained-router int8
+        # comparison) need the TRAINED policy itself, not just the
+        # curve: hand back the final serving view.
+        capture["params"] = serving_params(state.params)
     w = max(1, min(window, len(curve) // 2))
     initial = sum(curve[:w]) / w
     final = sum(curve[-w:]) / w
