@@ -124,3 +124,32 @@ def test_onboarding_panel_in_state(tmp_path):
     assert s["onboarding"]["current"] == "model"
     assert s["onboarding"]["steps"][0]["done"] is True
     json.dumps(s)
+
+
+def test_page_script_element_and_handler_consistency():
+    """No browser exists in this environment to execute the page, so pin
+    the failure modes a typo would cause: every getElementById target
+    exists in the markup, every onclick handler is defined in the
+    script, and bracket nesting is balanced."""
+    import re
+
+    from senweaver_ide_tpu.services.dashboard import _PAGE
+
+    ids_referenced = set(re.findall(r"getElementById\(\"([\w-]+)\"\)",
+                                    _PAGE))
+    ids_referenced |= set(re.findall(r"getElementById\('([\w-]+)'\)",
+                                     _PAGE))
+    ids_defined = set(re.findall(r'id="([\w-]+)"', _PAGE))
+    missing = ids_referenced - ids_defined
+    assert not missing, f"script references undefined ids: {missing}"
+
+    handlers = set(re.findall(r'onclick="(\w+)\(', _PAGE))
+    assert handlers, "action buttons missing from page"
+    for fn in handlers:
+        assert re.search(rf"function {fn}\(|const {fn} =", _PAGE), \
+            f"onclick handler {fn} not defined in page script"
+
+    script = _PAGE.split("<script>", 1)[1].split("</script>", 1)[0]
+    for open_c, close_c in (("{", "}"), ("(", ")"), ("[", "]")):
+        assert script.count(open_c) == script.count(close_c), \
+            f"unbalanced {open_c}{close_c} in page script"
