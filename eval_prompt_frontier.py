@@ -63,7 +63,10 @@ def run_frontier(lengths, *, rounds: int = 60, attempts: int = 2,
             "wall_s": round(time.monotonic() - t0, 1),
         }
         points.append(point)
-        print(f"[frontier] N={n}: tail={tail:.3f} delta={delta:.3f}",
+        # Full per-point record to stderr as soon as it exists: a
+        # multi-hour frontier run must not lose finished points to a
+        # crash/timeout of a later one.
+        print(f"[frontier] point {json.dumps(point)}",
               file=sys.stderr, flush=True)
     conditioned_up_to = max((p["prefix_bytes"] for p in points
                              if p["conditioned"]), default=None)
