@@ -1,0 +1,38 @@
+"""Prompt-length frontier machinery (eval_prompt_frontier.py).
+
+PROMPT_FRONTIER_r04.json carries the measured curve; this pins the
+harness at test budget plus the committed artifact's invariants."""
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from eval_prompt_frontier import run_frontier
+
+
+def test_run_frontier_point_structure():
+    rep = run_frontier([0], rounds=1, attempts=1, group_size=2)
+    assert rep["metric"].startswith("prompt_length_conditioning_frontier")
+    (p,) = rep["points"]
+    assert p["prefix_bytes"] == 0
+    assert set(p) >= {"sysmsg_bytes", "train_tail_mean", "attempt_tails",
+                      "probe_frac_low", "conditioning_delta",
+                      "conditioned"}
+    assert rep["full_prompt_bytes"] > 1500   # the real assembled prompt
+
+
+def test_committed_frontier_artifact_invariants():
+    root = Path(__file__).resolve().parent.parent
+    d = json.loads((root / "PROMPT_FRONTIER_r04.json").read_text())
+    lengths = [p["prefix_bytes"] for p in d["points"]]
+    assert lengths == sorted(lengths)
+    assert d["first_unconditioned_bytes"] == min(
+        p["prefix_bytes"] for p in d["points"] if not p["conditioned"])
+    # the measured story: strong partial conditioning at 64B, noise by
+    # 256B — the capacity wall the chip's small-test run addresses
+    by_len = {p["prefix_bytes"]: p["conditioning_delta"]
+              for p in d["points"]}
+    assert by_len[64] >= 0.3
+    assert abs(by_len[256]) < 0.15 and abs(by_len[768]) < 0.15
