@@ -1,0 +1,172 @@
+"""Capacity x prefix-length conditioning: curriculum + model-size grid.
+
+VERDICT r4 missing #2 / next-round #1: every RL artifact lives at tiny
+scale, and PROMPT_FRONTIER_r04 shows tiny-test's rule-conditioning
+decaying to noise by a 256-byte realistic prefix while production
+prompts are ~1.8k bytes (``convertToLLMMessageService.ts:834-856``
+renders the rules at the END of a long assembled system message). The
+capacity hypothesis ("a bigger model conditions under the full prompt")
+had zero datapoints. This eval puts datapoints on BOTH axes that could
+rescue the product premise:
+
+- **Curriculum over prefix length** (VERDICT #7's suggestion): pretrain
+  rule-following at prefix 0 (the proven regime), then GROW the
+  realistic prefix in stages, reusing the state — each stage only has
+  to preserve an attention pattern that already exists, not discover it
+  ~2k tokens from the completion. Direct-at-length training is what the
+  r4 frontier measured failing; the curriculum is the recipe a
+  production system would actually use (it mirrors how the reference's
+  rules section rides on top of an ever-growing prompt).
+- **Model size**: the same recipe (direct or curriculum) on
+  ``small-test`` (4L x d128, 8 heads) vs ``tiny-test`` (2L x d64) —
+  does the frontier move right with capacity alone?
+
+Probes are held-out (user text never seen in training) at the TARGET
+prefix: delta = frac_low(rule_low) - frac_low(rule_high) > 0.5 counts
+as conditioned — same bar as PROMPT_FRONTIER_r04.
+
+    python eval_capacity.py --model tiny-test --schedule 0,64,192,448,960,1792
+    python eval_capacity.py --model small-test --schedule 256      # direct point
+
+Prints ONE JSON line (the CAPACITY_r05 artifact).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from eval_uplift_real import (DECOY_RULE, RULE_HIGH, RULE_LOW,
+                              minimal_sysmsg, pretrain_rule_policy,
+                              pretrain_with_retries, probe_frac_low,
+                              realistic_prefix)
+
+PROBE_TEXT = "write the response bytes"   # held out from PRETRAIN_TEXTS
+
+
+def probe_suite(engine, tok, prefix_bytes: int, *, episodes: int = 8) -> dict:
+    out = {}
+    for name, rules in (("rule_low", [RULE_LOW]), ("rule_high", [RULE_HIGH]),
+                        ("no_rules", []), ("decoy", [DECOY_RULE])):
+        out[name] = round(probe_frac_low(
+            engine, tok, rules, prefix_bytes=prefix_bytes,
+            episodes=episodes, user_text=PROBE_TEXT), 4)
+    out["delta"] = round(out["rule_low"] - out["rule_high"], 4)
+    return out
+
+
+def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
+                 stage_rounds: int = 30, attempts: int = 3, seed: int = 0,
+                 group_size: int = 16, stop_mean: float = 0.9,
+                 lr: float = 0.02):
+    """Returns (report_dict, final_state, engine, tok)."""
+    t_all = time.monotonic()
+    stages = []
+
+    # Stage 0: the proven short-prefix regime, with seed retries (the
+    # flagship recipe's convergence is stochastic — ROUND4_NOTES).
+    t0 = time.monotonic()
+    state, engine, tok, _cfg, curve, seed_used, tried = \
+        pretrain_with_retries(max_attempts=attempts, seed=seed,
+                              seed_stride=7, rounds=stage0_rounds,
+                              group_size=group_size, lr=lr, model=model,
+                              prefix_bytes=int(schedule[0]), max_len=4096,
+                              stop_mean=stop_mean)
+    stages.append({
+        "prefix_bytes": int(schedule[0]), "rounds_run": len(curve),
+        "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4),
+        "curve": curve,
+        "attempts": tried, "seed_used": seed_used,
+        "wall_s": round(time.monotonic() - t0, 1),
+    })
+    print(f"[capacity] stage {json.dumps(stages[-1])}",
+          file=sys.stderr, flush=True)
+
+    # Later stages: grow the prefix, REUSING the trained state — no
+    # retries (continuation), generous cap with the same early stop.
+    for n in schedule[1:]:
+        t0 = time.monotonic()
+        state, engine, tok, _cfg, curve = pretrain_rule_policy(
+            rounds=stage_rounds, lr=lr, seed=seed_used,
+            group_size=group_size, model=model, prefix_bytes=int(n),
+            max_len=4096, stop_mean=stop_mean,
+            state=state, engine=engine)
+        stages.append({
+            "prefix_bytes": int(n), "rounds_run": len(curve),
+            "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4),
+            "curve": curve,
+            "wall_s": round(time.monotonic() - t0, 1),
+        })
+        print(f"[capacity] stage {json.dumps(stages[-1])}",
+              file=sys.stderr, flush=True)
+
+    target = int(schedule[-1])
+    probes = probe_suite(engine, tok, target)
+    # Bonus: does the curriculum preserve short-prompt conditioning?
+    probes_at_0 = probe_suite(engine, tok, 0, episodes=4) \
+        if target > 0 else None
+    report = {
+        "metric": f"capacity_conditioning[{model}]",
+        "model": model,
+        "curriculum": len(schedule) > 1,
+        "schedule": [int(n) for n in schedule],
+        "stages": stages,
+        "target_prefix_bytes": target,
+        "target_sysmsg_bytes": len(minimal_sysmsg([RULE_LOW],
+                                                  prefix_bytes=target)),
+        "full_prompt_bytes": len(realistic_prefix(10 ** 9)),
+        "probes_frac_low": probes,
+        "conditioning_delta": probes["delta"],
+        "conditioned": bool(probes["delta"] > 0.5),
+        "probes_at_prefix0": probes_at_0,
+        "probe_user_text": PROBE_TEXT,
+        "config": {"stage0_rounds": stage0_rounds,
+                   "stage_rounds": stage_rounds, "attempts": attempts,
+                   "group_size": group_size, "lr": lr, "seed": seed,
+                   "stop_mean": stop_mean},
+        "total_wall_s": round(time.monotonic() - t_all, 1),
+    }
+    return report, state, engine, tok
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny-test")
+    ap.add_argument("--schedule", default="0,64,192,448,960,1792",
+                    help="comma-separated prefix-byte stages; a single "
+                         "value = direct (no-curriculum) training at "
+                         "that prefix")
+    ap.add_argument("--stage0-rounds", type=int, default=40)
+    ap.add_argument("--stage-rounds", type=int, default=30)
+    ap.add_argument("--attempts", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--group-size", type=int, default=16)
+    ap.add_argument("--save-dir", default=None,
+                    help="checkpoint the final state here")
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")   # CPU-sized; wedged-tunnel safe
+
+    schedule = [int(x) for x in args.schedule.split(",") if x.strip()]
+    report, state, _engine, _tok = run_capacity(
+        model=args.model, schedule=schedule,
+        stage0_rounds=args.stage0_rounds, stage_rounds=args.stage_rounds,
+        attempts=args.attempts, seed=args.seed, group_size=args.group_size)
+    if args.save_dir:
+        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
+        CheckpointManager(args.save_dir).save(
+            state, extra_meta={"eval": "capacity", "model": args.model,
+                               "schedule": schedule})
+        report["checkpoint_dir"] = args.save_dir
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:   # always leave a JSON line for the driver
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"}))
+        sys.exit(1)

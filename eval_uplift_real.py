@@ -164,7 +164,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
                          anchor_kl: float = 0.02, anchor_every: int = 5,
                          stop_mean: float = 0.9, stop_window: int = 4,
                          tasks_per_class: int = 1, prefix_bytes: int = 0,
-                         model: str = "tiny-test",
+                         model: str = "tiny-test", max_len: int = 2048,
                          state=None, engine=None):
     """GRPO-pretrain rule-conditional byte emission; returns
     (state, engine, tok, config, curve).
@@ -200,7 +200,8 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
                                  learning_rate=lr)
     if engine is None:
         engine = RolloutEngine(state.params, config, num_slots=8,
-                               max_len=4096, eos_id=None, seed=seed)
+                               max_len=max(4096, max_len), eos_id=None,
+                               seed=seed)
     workdir = tempfile.mkdtemp(prefix="uplift_pretrain_")
 
     # 'low|<text>' → RULE_LOW in the system message; the key is stripped
@@ -241,7 +242,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
     for r in range(rounds):
         out = grpo_round(state, config, None, make_session, tasks,
                          group_size=group_size, pad_id=tok.pad_id,
-                         max_len=2048, grpo_config=gcfg, ppo_epochs=2,
+                         max_len=max_len, grpo_config=gcfg, ppo_epochs=2,
                          max_parallel=max_parallel,
                          reward_override=reward, ref_params=anchor)
         state = out.state
