@@ -145,10 +145,14 @@ def main() -> None:
     ap.add_argument("--group-size", type=int, default=16)
     ap.add_argument("--save-dir", default=None,
                     help="checkpoint the final state here")
+    ap.add_argument("--accel", action="store_true",
+                    help="run on the default accelerator platform (chip "
+                         "queue); default forces CPU, wedged-tunnel safe")
     args = ap.parse_args()
 
     import jax
-    jax.config.update("jax_platforms", "cpu")   # CPU-sized; wedged-tunnel safe
+    if not args.accel:
+        jax.config.update("jax_platforms", "cpu")
 
     schedule = [int(x) for x in args.schedule.split(",") if x.strip()]
     report, state, _engine, _tok = run_capacity(

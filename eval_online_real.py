@@ -42,7 +42,8 @@ import time
 from typing import List, Optional, Sequence
 
 from eval_uplift_real import (BankProposer, RULE_BANK, RETRY_FOLLOWUP,
-                              frac_low, make_rule_scorer, minimal_sysmsg,
+                              RULE_HIGH, RULE_LOW, frac_low,
+                              make_rule_scorer, minimal_sysmsg,
                               pretrain_rule_policy, probe_frac_low)
 
 ONLINE_TASKS = ["write the status line", "emit the reply text",
@@ -52,7 +53,10 @@ ONLINE_TASKS = ["write the status line", "emit the reply text",
 def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
                     seed: int = 0, group_size: int = 4,
                     max_attempts: int = 4, good_threshold: float = 0.75,
-                    lr: float = 0.02, pretrain_rounds: int = 60) -> dict:
+                    lr: float = 0.02, pretrain_rounds: int = 60,
+                    shift_round: Optional[int] = None,
+                    analyze_interval_ms: Optional[float] = None,
+                    analyze_every: Optional[int] = None) -> dict:
     import jax
 
     from senweaver_ide_tpu.apo.local import make_local_apo
@@ -86,9 +90,13 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
         pretrained = {"rounds": pretrain_rounds, "curve_tail": curve[-5:]}
 
     # Target the class the instruction-follower does NOT emit unprompted:
-    # the suite must fail until an optimizer moves something.
+    # the suite must fail until an optimizer moves something. A mutable
+    # holder, not a bool: --shift-round flips the demanded class mid-run
+    # (the task-shift that re-opens the APO gates — the reference's
+    # analysis timer is RECURRING, apoService.ts:435-472, so one-shot
+    # gate-opening was the r4 evidence gap).
     prior = probe_frac_low(engine, tok, [])
-    target_low = prior < 0.5
+    target = {"low": prior < 0.5}
 
     workdir = tempfile.mkdtemp(prefix="online_real_")
     collector = TraceCollector()
@@ -97,7 +105,7 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
         ids = (session.client.call_log[-1][1]
                if session.client.call_log else [])
         f = frac_low(ids)
-        return f if target_low else 1.0 - f
+        return f if target["low"] else 1.0 - f
 
     # Judge with the episode's sampled tokens (2-arg feedback_fn form):
     # good = on-target output within 2 attempts — same contract as the
@@ -136,12 +144,18 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
                             system_message_override=minimal_sysmsg(rules))
 
     # The APO half: bank-proposer optimizer + the real-rollout scorer
-    # (memoize=False — the engine's weights move between beam passes).
+    # (memoize=False — the engine's weights move between beam passes;
+    # target_low as a callable — the scorer must judge candidates
+    # against the CURRENT demanded class after a task shift).
+    apo_cfg = (APOConfig(beam_rounds=2)
+               if analyze_interval_ms is None
+               else APOConfig(beam_rounds=2,
+                              auto_analyze_interval_ms=analyze_interval_ms))
     apo = make_local_apo(
         collector, BankProposer(RULE_BANK, seed=seed),
-        config=APOConfig(beam_rounds=2),
+        config=apo_cfg,
         score_fn=make_rule_scorer(engine, tok, workdir,
-                                  target_low=target_low,
+                                  target_low=lambda: target["low"],
                                   good_threshold=good_threshold,
                                   max_attempts=max_attempts,
                                   memoize=False))
@@ -151,15 +165,34 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
         apo=apo, collector=collector, engine=engine,
         group_size=group_size, pad_id=tok.pad_id, max_len=1024,
         grpo_config=GRPOConfig(kl_coef=0.02, entropy_coef=0.02),
-        ppo_epochs=2, max_parallel=8, feedback_fn=judge, anchor_every=5)
+        ppo_epochs=2, max_parallel=8, feedback_fn=judge, anchor_every=5,
+        analyze_every=analyze_every)
 
     per_round: List[dict] = []
+    shift_probes = None
     ep_per_round = len(ONLINE_TASKS) * group_size
     for r in range(rounds):
+        if shift_round is not None and r == shift_round:
+            # TASK SHIFT: the demanded byte class flips. The judge and
+            # the beam scorer read the holder, so from this round on
+            # the installed rules are WRONG for the task — good rate
+            # collapses, the cumulative corpus good-rate decays below
+            # the gradient threshold, and the gates re-open (beam #2
+            # must install the opposite rule for reward to recover).
+            target["low"] = not target["low"]
+            shift_probes = {
+                "frac_low_rule_low": round(
+                    probe_frac_low(engine, tok, [RULE_LOW]), 4),
+                "frac_low_rule_high": round(
+                    probe_frac_low(engine, tok, [RULE_HIGH]), 4),
+                "frac_low_no_rules": round(
+                    probe_frac_low(engine, tok, []), 4),
+            }
         res = loop.run_round()
         round_eps = episode_log[r * ep_per_round:(r + 1) * ep_per_round]
         per_round.append({
             "round": r,
+            "target_class": "low" if target["low"] else "high",
             "reward_mean": round(res.reward_mean, 4),
             "rules_active": list(res.rules),
             "analyzed": res.analyzed,
@@ -170,6 +203,8 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
                                    / max(len(round_eps), 1), 2),
             "loss": res.train_metrics.get("loss"),
         })
+        print(f"[online] {json.dumps(per_round[-1])}",
+              file=sys.stderr, flush=True)
 
     curve = [p["reward_mean"] for p in per_round]
     first_beam = next((p["round"] for p in per_round if p["beam_ran"]),
@@ -187,11 +222,31 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
         tail = vals[-2:] if len(vals) >= 2 else vals
         return sum(tail) / max(len(tail), 1)
     final_no_rule_prior = probe_frac_low(engine, tok, [])
+    beam_rounds_ran = [p["round"] for p in per_round if p["beam_ran"]]
+    rule_sets = []
+    for p in per_round:
+        if not rule_sets or rule_sets[-1][1] != p["rules_active"]:
+            rule_sets.append((p["round"], p["rules_active"]))
+    post_shift = ([p for p in per_round if p["round"] >= shift_round]
+                  if shift_round is not None else [])
     report = {
         "metric": "online_improvement_realpolicy",
         "rounds": rounds,
         "curve": curve,
         "per_round": per_round,
+        "shift_round": shift_round,
+        "shift_probes_frac_low": shift_probes,
+        "beam_rounds_ran": beam_rounds_ran,
+        "beam_invocations": len(beam_rounds_ran),
+        "rules_timeline": [{"from_round": r, "rules": rs}
+                           for r, rs in rule_sets],
+        "rules_changed_after_shift": bool(
+            shift_round is not None
+            and any(r > shift_round for r, _ in rule_sets[1:])),
+        "post_shift_recovered": bool(
+            post_shift and len(post_shift) >= 3
+            and w2([p["reward_mean"] for p in post_shift])
+            > post_shift[0]["reward_mean"] + 0.4),
         "reward_initial": curve[0] if curve else None,
         "reward_final": round(w2(curve), 4) if curve else None,
         "first_beam_round": first_beam,
@@ -203,7 +258,10 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
             > post_beam[0]["reward_mean"] + 1e-9),
         "prior_frac_low_initial": round(prior, 4),
         "prior_frac_low_final": round(final_no_rule_prior, 4),
-        "target_class": "low" if target_low else "high",
+        "target_class_initial": per_round[0]["target_class"]
+        if per_round else None,
+        "target_class_final": per_round[-1]["target_class"]
+        if per_round else None,
         "pretrained": pretrained,
         "policy": "real transformer (tiny-test); no scripted policy "
                   "anywhere in the loop",
@@ -211,7 +269,9 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
         "config": {"group_size": group_size, "tasks": len(ONLINE_TASKS),
                    "max_attempts": max_attempts,
                    "good_threshold": good_threshold, "lr": lr,
-                   "seed": seed},
+                   "seed": seed, "shift_round": shift_round,
+                   "analyze_interval_ms": analyze_interval_ms,
+                   "analyze_every": analyze_every},
         "wall_s": round(time.monotonic() - t0, 1),
     }
     return report
@@ -226,6 +286,18 @@ def main() -> None:
     ap.add_argument("--pretrain-rounds", type=int, default=60)
     ap.add_argument("--group-size", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shift-round", type=int, default=None,
+                    help="flip the demanded byte class at this round "
+                         "(task shift → APO gates re-open → beam #2)")
+    ap.add_argument("--analyze-interval-ms", type=float, default=None,
+                    help="override the 1h analysis interval — the "
+                         "reference's timer is hourly-RECURRING; an "
+                         "eval compressing hours into minutes scales "
+                         "the interval with it")
+    ap.add_argument("--analyze-every", type=int, default=None,
+                    help="consult the APO gates every N rounds (round-"
+                         "based translation of the recurring timer; "
+                         "use with --analyze-interval-ms 0)")
     args = ap.parse_args()
 
     import jax
@@ -233,7 +305,10 @@ def main() -> None:
 
     report = run_online_eval(rounds=args.rounds, ckpt=args.ckpt,
                              seed=args.seed, group_size=args.group_size,
-                             pretrain_rounds=args.pretrain_rounds)
+                             pretrain_rounds=args.pretrain_rounds,
+                             shift_round=args.shift_round,
+                             analyze_interval_ms=args.analyze_interval_ms,
+                             analyze_every=args.analyze_every)
     print(json.dumps(report))
 
 

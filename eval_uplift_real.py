@@ -162,6 +162,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
                          group_size: int = 16, max_new_tokens: int = 16,
                          seed: int = 0, max_parallel: int = 8,
                          anchor_kl: float = 0.02, anchor_every: int = 5,
+                         entropy_coef: float = 0.02,
                          stop_mean: float = 0.9, stop_window: int = 4,
                          tasks_per_class: int = 1, prefix_bytes: int = 0,
                          model: str = "tiny-test", max_len: int = 2048,
@@ -236,7 +237,7 @@ def pretrain_rule_policy(*, rounds: int = 80, lr: float = 0.02,
         want_low = tasks[task_idx].startswith("low|")
         return 2.0 * (f if want_low else 1.0 - f) - 1.0
 
-    gcfg = GRPOConfig(kl_coef=anchor_kl, entropy_coef=0.02)
+    gcfg = GRPOConfig(kl_coef=anchor_kl, entropy_coef=entropy_coef)
     anchor = state.params if anchor_kl > 0 else None
     curve: List[float] = []
     for r in range(rounds):
@@ -345,7 +346,11 @@ def make_rule_scorer(engine, tok, workdir: str, *, target_low: bool,
     beam search re-proposes duplicate candidates across rounds and a
     frozen policy's score estimate does not change. Callers whose
     engine weights move between scoring passes (the online loop) must
-    pass ``memoize=False``."""
+    pass ``memoize=False``.
+
+    ``target_low`` may be a bool or a 0-arg callable returning one —
+    the callable form serves task-shift evals where the demanded byte
+    class changes mid-run (the scorer re-reads it on every call)."""
     import jax.numpy as jnp
 
     from senweaver_ide_tpu.rewards.head import reward_head_batch
@@ -356,7 +361,8 @@ def make_rule_scorer(engine, tok, workdir: str, *, target_low: bool,
     cache: dict = {}
 
     def score(rules: Sequence[str]) -> float:
-        key = tuple(rules)
+        tl = target_low() if callable(target_low) else target_low
+        key = (tuple(rules), tl)   # class flips invalidate cached scores
         if memoize and key in cache:
             return cache[key]
         traces = []
@@ -375,7 +381,7 @@ def make_rule_scorer(engine, tok, workdir: str, *, target_low: bool,
             def agreement() -> float:
                 ids = client.call_log[-1][1] if client.call_log else []
                 f = frac_low(ids)
-                return f if target_low else 1.0 - f
+                return f if tl else 1.0 - f
 
             attempts = [1]
 
@@ -420,7 +426,8 @@ def run_real_uplift(engine, tok, *, beam_rounds: int = 3,
                     good_threshold: float = 0.75,
                     eval_tasks: Sequence[str] = tuple(EVAL_TEXTS),
                     max_attempts: int = DEFAULT_MAX_ATTEMPTS,
-                    probe_episodes: int = 8) -> dict:
+                    probe_episodes: int = 8,
+                    proposer=None) -> dict:
     """Probes + full APO cycle on the frozen engine params; returns the
     report dict (no weight update happens anywhere in here)."""
     from senweaver_ide_tpu.apo.local import make_local_apo
@@ -459,7 +466,7 @@ def run_real_uplift(engine, tok, *, beam_rounds: int = 3,
                                 max_attempts=max_attempts,
                                 score_log=score_log)
     apo = make_local_apo(
-        corpus, BankProposer(RULE_BANK, seed=proposer_seed),
+        corpus, proposer or BankProposer(RULE_BANK, seed=proposer_seed),
         config=APOConfig(beam_rounds=1), score_fn=score_fn)
     # One visible round at a time: the per-round best-score progression is
     # the "search matters" evidence (VERDICT r3 weak #3).

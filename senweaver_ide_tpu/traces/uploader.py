@@ -87,3 +87,29 @@ class TraceUploader:
             os.replace(tmp, self._path)
         except OSError:
             pass
+
+
+def http_trace_transport(url: str, *, timeout: float = 10.0,
+                         headers: Optional[Dict[str, str]] = None
+                         ) -> Callable[[List[Dict]], bool]:
+    """Real HTTP transport for the uploader: POST the batch as JSON to
+    ``url`` (the reference's ``POST /api/traces`` shape,
+    traceCollectorService.ts:797-899). 2xx → True; any error or non-2xx
+    → False (the uploader's retry-next-cycle contract). Stdlib urllib —
+    no SDK dependency for the fleet ingest path."""
+    import urllib.error
+    import urllib.request
+
+    def transport(batch: List[Dict]) -> bool:
+        body = json.dumps({"traces": batch}).encode("utf-8")
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json",
+                     **(headers or {})})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return 200 <= resp.status < 300
+        except (urllib.error.URLError, OSError, ValueError):
+            return False
+
+    return transport

@@ -78,7 +78,8 @@ class OnlineImprovementLoop:
                  reward_override=None,
                  feedback_fn=outcome_feedback,
                  metrics_service=None,
-                 anchor_every: int = 0):
+                 anchor_every: int = 0,
+                 analyze_every: Optional[int] = None):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -96,6 +97,13 @@ class OnlineImprovementLoop:
         self.reward_override = reward_override
         self.feedback_fn = feedback_fn
         self.metrics_service = metrics_service
+        # Round-based analysis cadence: the reference's auto-analysis is
+        # a RECURRING timer (apoService.ts:435-472, hourly); this loop
+        # drives rounds, so the natural translation is "every N rounds".
+        # None = every round (the service's own time/size gates still
+        # apply either way — this only throttles how often they are
+        # consulted).
+        self.analyze_every = analyze_every
         # anchor_every > 0 (with grpo_config.kl_coef > 0): keep a
         # rolling snapshot of the policy as the k3-KL reference,
         # refreshed every anchor_every rounds — the drift stabilizer
@@ -201,7 +209,9 @@ class OnlineImprovementLoop:
         # APO side of the cycle (the reference's timer tick, driven at
         # round boundaries here): analysis when gates open; prompt beam
         # search when the corpus shows a low good-rate.
-        report = self.apo.maybe_auto_analyze()
+        due = (self.analyze_every is None
+               or self._round % self.analyze_every == 0)
+        report = self.apo.maybe_auto_analyze() if due else None
         beam_ran = False
         if report is not None and self.apo.should_auto_gradient() \
                 and self.apo.generate_fn is not None:

@@ -196,3 +196,28 @@ def test_online_loop_rolling_anchor(stack):
     assert np.isfinite(r0.train_metrics["loss"])
     assert abs(r0.train_metrics["kl"]) < 1e-3
     assert loop._anchor is loop.state.params      # refreshed after round
+
+
+def test_online_loop_analyze_every_cadence(stack):
+    """analyze_every=2: the APO gates are consulted only on rounds 0,
+    2, 4... — the round-based translation of the reference's RECURRING
+    analysis timer (apoService.ts:435-472). Off-cadence rounds never
+    analyze even with the corpus gates wide open."""
+    import dataclasses
+
+    cfg, state, collector, apo, make_session = stack
+    # disable the ms interval so the ROUND cadence is the only throttle
+    apo.config = dataclasses.replace(apo.config,
+                                     auto_analyze_interval_ms=0.0)
+    loop = OnlineImprovementLoop(
+        state, cfg, None, make_session, SIX_PATTERN_TASKS[:2],
+        apo=apo, collector=collector, group_size=2, max_len=1024,
+        max_parallel=1, analyze_every=2)
+    r0 = loop.run_round()
+    assert r0.analyzed                     # round 0 is on-cadence
+    r1 = loop.run_round()
+    assert not r1.analyzed                 # round 1 throttled
+    r2 = loop.run_round()
+    # round 2 on-cadence again; the service's own gates decide whether
+    # analysis actually fires (trace/feedback counts are satisfied here)
+    assert r2.analyzed
