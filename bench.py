@@ -143,11 +143,43 @@ def _artifact_summaries() -> dict:
     if online and "curve" in online and online["curve"]:
         out["online_loop_reward_first"] = online["curve"][0]
         out["online_loop_reward_final"] = online["curve"][-1]
-    sevenb = read("SEVENB_r04.json")
-    if sevenb and isinstance(sevenb.get("sizing"), dict):
+    sevenb = next((d for d in (read("SEVENB_r05.json"),
+                               read("SEVENB_r04.json"))
+                   if d and isinstance(d.get("sizing"), dict)), None)
+    if sevenb:
         plans = sevenb["sizing"].get("plans_gb")
         if isinstance(plans, dict):
             out["sevenb_qlora_plan_gb"] = plans.get("qlora_int8_base")
+        upd = sevenb.get("qlora_update")
+        if isinstance(upd, dict):
+            out["sevenb_qlora_update_step_wall_s"] = upd.get("step_wall_s")
+    # round-5 headline artifacts: capacity/curriculum conditioning, the
+    # generative optimizer, the task-shift online loop, scale steps
+    cap = read("CAPACITY_r05.json")
+    if cap and "conditioning_delta" in cap:
+        out["capacity_curriculum_delta"] = cap["conditioning_delta"]
+        out["capacity_curriculum_prefix_bytes"] = cap.get(
+            "target_prefix_bytes")
+        out["capacity_curriculum_conditioned"] = cap.get("conditioned")
+    gen = read("UPLIFT_GENERATIVE_r05.json")
+    if gen and "uplift_ratio_shifted" in gen:
+        out["generative_uplift_ratio"] = gen["uplift_ratio_shifted"]
+        out["generative_searched"] = gen.get("searched")
+    online5 = read("ONLINE_r05.json")
+    if online5 and online5.get("beam_invocations") is not None:
+        out["online_shift_beam_invocations"] = online5["beam_invocations"]
+        out["online_shift_recovered"] = online5.get("post_shift_recovered")
+    b15 = read("ONEPOINTFIVEB_r05.json")
+    if b15 and isinstance(b15.get("phases"), dict):
+        tr = b15["phases"].get("train")
+        if isinstance(tr, dict):
+            out["onepointfiveb_step_walls_s"] = tr.get("step_walls_s")
+    hf = read("HF_ROUNDTRIP_r05.json")
+    if hf and "ok" in hf:
+        out["hf_roundtrip_ok"] = hf["ok"]
+    robust = read("SEED_ROBUSTNESS_r05.json")
+    if robust and isinstance(robust.get("by_config"), dict):
+        out["seed_robustness_best"] = robust.get("best_config")
     return out
 
 
