@@ -82,9 +82,9 @@ def main() -> None:
     serve_params = params
     lora_base = None
     if args.mode == "qlora":
-        from senweaver_ide_tpu.models.quantize import quantize_params
+        from senweaver_ide_tpu.models.quantize import quantize_weights_int8
         t0 = time.monotonic()
-        lora_base = quantize_params(params)
+        lora_base = quantize_weights_int8(params)
         del params            # the fp32 tree is not part of this posture
         serve_params = lora_base
         report["phases"]["quantize"] = {
