@@ -147,7 +147,6 @@ def main() -> None:
 
     from senweaver_ide_tpu.models import get_config
     from senweaver_ide_tpu.models.transformer import init_params
-    from senweaver_ide_tpu.training import make_train_state
 
     t_all = time.monotonic()
     tok_dir = build_hf_tokenizer_dir(tempfile.mkdtemp(prefix="hf_tok_"))
@@ -155,10 +154,9 @@ def main() -> None:
     # Leg 1: TRAINED tiny weights.
     tiny_cfg = get_config("tiny-test")
     if os.path.isdir(args.ckpt):
-        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
-        template = make_train_state(tiny_cfg, jax.random.PRNGKey(args.seed),
-                                    None, learning_rate=0.02)
-        state, _ = CheckpointManager(args.ckpt).restore(template)
+        from eval_uplift_real import load_policy
+        state, _engine, _tok, _cfg = load_policy(args.ckpt,
+                                                 seed=args.seed)
         tiny_params, tiny_src = state.params, args.ckpt
     else:
         from eval_uplift_real import pretrain_rule_policy

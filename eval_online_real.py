@@ -63,9 +63,7 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
     from senweaver_ide_tpu.apo.types import APOConfig
     from senweaver_ide_tpu.models import get_config
     from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
-    from senweaver_ide_tpu.rollout import (EnginePolicyClient, RolloutEngine,
-                                           RolloutSession)
-    from senweaver_ide_tpu.training import make_train_state
+    from senweaver_ide_tpu.rollout import EnginePolicyClient, RolloutSession
     from senweaver_ide_tpu.training.grpo import GRPOConfig
     from senweaver_ide_tpu.training.online import OnlineImprovementLoop
     from senweaver_ide_tpu.traces.collector import TraceCollector
@@ -74,12 +72,8 @@ def run_online_eval(*, rounds: int = 12, ckpt: Optional[str] = None,
     config = get_config("tiny-test")
     tok = ByteTokenizer()
     if ckpt and os.path.isdir(ckpt):
-        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
-        template = make_train_state(config, jax.random.PRNGKey(seed), None,
-                                    learning_rate=lr)
-        state, _ = CheckpointManager(ckpt).restore(template)
-        engine = RolloutEngine(state.params, config, num_slots=8,
-                               max_len=4096, eos_id=None, seed=seed)
+        from eval_uplift_real import load_policy
+        state, engine, tok, config = load_policy(ckpt, seed=seed, lr=lr)
         pretrained = {"loaded_from": ckpt}
     else:
         # Explicit recipe kwargs (the proven 2-group x 16 regime) so a

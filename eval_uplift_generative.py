@@ -82,19 +82,9 @@ def main() -> None:
     t0 = time.monotonic()
     # ---- frozen policy --------------------------------------------------
     if args.load_dir:
-        from senweaver_ide_tpu.models import get_config
-        from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
-        from senweaver_ide_tpu.rollout import RolloutEngine
-        from senweaver_ide_tpu.training import make_train_state
-        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
-
-        config = get_config("tiny-test")
-        template = make_train_state(config, jax.random.PRNGKey(args.seed),
-                                    None, learning_rate=0.02)
-        state, _ = CheckpointManager(args.load_dir).restore(template)
-        tok = ByteTokenizer()
-        engine = RolloutEngine(state.params, config, num_slots=8,
-                               max_len=4096, eos_id=None, seed=args.seed)
+        from eval_uplift_real import load_policy
+        state, engine, tok, _config = load_policy(args.load_dir,
+                                                  seed=args.seed)
         pretrain_info = {"loaded_from": args.load_dir}
     else:
         state, engine, tok, _cfg, curve, seed_used, tried = \

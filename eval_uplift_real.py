@@ -154,6 +154,29 @@ class BankProposer:
             "try explicit response-style rules with alternative phrasings.")
 
 
+def load_policy(load_dir: str, *, model: str = "tiny-test", seed: int = 0,
+                lr: float = 0.02, num_slots: int = 8, max_len: int = 4096):
+    """Restore a pretrained policy checkpoint into a serving stack:
+    (state, engine, tok, config). One definition for the load-and-serve
+    boilerplate every eval shares (uplift/online/generative/probe)."""
+    import jax
+
+    from senweaver_ide_tpu.models import get_config
+    from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    from senweaver_ide_tpu.training import make_train_state
+    from senweaver_ide_tpu.training.checkpoint import CheckpointManager
+
+    config = get_config(model)
+    template = make_train_state(config, jax.random.PRNGKey(seed), None,
+                                learning_rate=lr)
+    state, _meta = CheckpointManager(load_dir).restore(template)
+    tok = ByteTokenizer()
+    engine = RolloutEngine(state.params, config, num_slots=num_slots,
+                           max_len=max_len, eos_id=None, seed=seed)
+    return state, engine, tok, config
+
+
 # ---------------------------------------------------------------------------
 # Phase 1: pretrain rule-following on the real stack
 # ---------------------------------------------------------------------------
@@ -544,19 +567,8 @@ def main() -> None:
 
     t0 = time.monotonic()
     if args.load_dir:
-        from senweaver_ide_tpu.models import get_config
-        from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
-        from senweaver_ide_tpu.rollout import RolloutEngine
-        from senweaver_ide_tpu.training import make_train_state
-        from senweaver_ide_tpu.training.checkpoint import CheckpointManager
-
-        config = get_config(args.model)
-        template = make_train_state(config, jax.random.PRNGKey(args.seed),
-                                    None, learning_rate=args.lr)
-        state, _meta = CheckpointManager(args.load_dir).restore(template)
-        tok = ByteTokenizer()
-        engine = RolloutEngine(state.params, config, num_slots=8,
-                               max_len=4096, eos_id=None, seed=args.seed)
+        state, engine, tok, config = load_policy(
+            args.load_dir, model=args.model, seed=args.seed, lr=args.lr)
         curve = []
     else:
         # Pretraining is stochastic (concurrent collection): retry with

@@ -99,3 +99,16 @@ def test_seed_robustness_artifact():
     for name, agg in d["by_config"].items():
         assert agg["of"] == len(d["seeds"])
         assert 0 <= agg["converged"] <= agg["of"]
+
+
+def test_capacity_probe_artifact():
+    d = _load("CAPACITY_PROBE_r05.json")
+    prefixes = [p["prefix_bytes"] for p in d["points"]]
+    assert prefixes == sorted(prefixes)
+    for p in d["points"]:
+        assert p["conditioned"] == (p["delta"] > 0.5)
+        assert abs(p["delta"] - (p["rule_low"] - p["rule_high"])) < 1e-6
+    conditioned = [p["prefix_bytes"] for p in d["points"]
+                   if p["conditioned"]]
+    expect = max(conditioned) if conditioned else None
+    assert d["conditioned_up_to_bytes"] == expect
