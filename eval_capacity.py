@@ -60,10 +60,36 @@ def probe_suite(engine, tok, prefix_bytes: int, *, episodes: int = 8) -> dict:
 def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
                  stage_rounds: int = 30, attempts: int = 3, seed: int = 0,
                  group_size: int = 16, stop_mean: float = 0.9,
-                 lr: float = 0.02):
-    """Returns (report_dict, final_state, engine, tok)."""
+                 lr: float = 0.02, save_dir=None,
+                 stop_on_unconditioned: bool = False,
+                 stage_probe_episodes: int = 4):
+    """Returns (report_dict, final_state, engine, tok).
+
+    Each stage ends with a HELD-OUT probe at its own prefix (cheap,
+    ``stage_probe_episodes`` per rule-set) and, when ``save_dir`` is
+    given, a per-stage checkpoint under ``save_dir/stage<prefix>`` —
+    the r05 tiny run showed a later FAILED stage erases earlier
+    conditioning (catastrophic forgetting through 30 unconverged 1792B
+    rounds), so evidence and state must be banked as the curriculum
+    climbs, not only at the end. ``stop_on_unconditioned`` aborts the
+    remaining schedule when a stage's probe delta falls below 0.3
+    (churning past a failed stage only destroys what was learned)."""
     t_all = time.monotonic()
     stages = []
+
+    def bank_stage(stage: dict, state) -> dict:
+        n = stage["prefix_bytes"]
+        p = probe_suite(engine, tok, n, episodes=stage_probe_episodes)
+        stage["probe_frac_low"] = p
+        stage["probe_delta"] = p["delta"]
+        stage["probe_conditioned"] = bool(p["delta"] > 0.5)
+        if save_dir:
+            from senweaver_ide_tpu.training.checkpoint import \
+                CheckpointManager
+            CheckpointManager(f"{save_dir}/stage{n}").save(
+                state, extra_meta={"eval": "capacity_stage",
+                                   "prefix_bytes": n})
+        return stage
 
     # Stage 0: the proven short-prefix regime, with seed retries (the
     # flagship recipe's convergence is stochastic — ROUND4_NOTES).
@@ -74,36 +100,48 @@ def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
                               group_size=group_size, lr=lr, model=model,
                               prefix_bytes=int(schedule[0]), max_len=4096,
                               stop_mean=stop_mean)
-    stages.append({
+    stages.append(bank_stage({
         "prefix_bytes": int(schedule[0]), "rounds_run": len(curve),
         "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4),
         "curve": curve,
         "attempts": tried, "seed_used": seed_used,
         "wall_s": round(time.monotonic() - t0, 1),
-    })
+    }, state))
     print(f"[capacity] stage {json.dumps(stages[-1])}",
           file=sys.stderr, flush=True)
 
     # Later stages: grow the prefix, REUSING the trained state — no
     # retries (continuation), generous cap with the same early stop.
+    skipped = []
     for n in schedule[1:]:
+        if stop_on_unconditioned and stages \
+                and stages[-1].get("probe_delta", 1.0) < 0.3:
+            skipped.append(int(n))
+            continue
         t0 = time.monotonic()
         state, engine, tok, _cfg, curve = pretrain_rule_policy(
             rounds=stage_rounds, lr=lr, seed=seed_used,
             group_size=group_size, model=model, prefix_bytes=int(n),
             max_len=4096, stop_mean=stop_mean,
             state=state, engine=engine)
-        stages.append({
+        stages.append(bank_stage({
             "prefix_bytes": int(n), "rounds_run": len(curve),
             "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4),
             "curve": curve,
             "wall_s": round(time.monotonic() - t0, 1),
-        })
+        }, state))
         print(f"[capacity] stage {json.dumps(stages[-1])}",
               file=sys.stderr, flush=True)
 
-    target = int(schedule[-1])
-    probes = probe_suite(engine, tok, target)
+    target = int(stages[-1]["prefix_bytes"]) if skipped \
+        else int(schedule[-1])
+    # bank_stage already probed this prefix on this exact state (at the
+    # stage budget); the headline probe re-measures at 8 episodes for a
+    # tighter estimate only when the budgets differ.
+    if stage_probe_episodes >= 8:
+        probes = dict(stages[-1]["probe_frac_low"])
+    else:
+        probes = probe_suite(engine, tok, target)
     # Bonus: does the curriculum preserve short-prompt conditioning?
     probes_at_0 = probe_suite(engine, tok, 0, episodes=4) \
         if target > 0 else None
@@ -121,11 +159,18 @@ def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
         "conditioning_delta": probes["delta"],
         "conditioned": bool(probes["delta"] > 0.5),
         "probes_at_prefix0": probes_at_0,
+        "stages_skipped": skipped,
+        "stage_conditioned_up_to": max(
+            (s["prefix_bytes"] for s in stages
+             if s.get("probe_conditioned")), default=None),
         "probe_user_text": PROBE_TEXT,
         "config": {"stage0_rounds": stage0_rounds,
                    "stage_rounds": stage_rounds, "attempts": attempts,
                    "group_size": group_size, "lr": lr, "seed": seed,
-                   "stop_mean": stop_mean},
+                   "stop_mean": stop_mean,
+                   "stop_on_unconditioned": stop_on_unconditioned,
+                   "stage_probe_episodes": stage_probe_episodes,
+                   "save_dir": save_dir},
         "total_wall_s": round(time.monotonic() - t_all, 1),
     }
     return report, state, engine, tok
@@ -148,6 +193,9 @@ def main() -> None:
     ap.add_argument("--accel", action="store_true",
                     help="run on the default accelerator platform (chip "
                          "queue); default forces CPU, wedged-tunnel safe")
+    ap.add_argument("--stop-on-unconditioned", action="store_true",
+                    help="abort remaining stages when a stage's held-out "
+                         "probe delta < 0.3 (don't churn past failure)")
     args = ap.parse_args()
 
     import jax
@@ -158,7 +206,9 @@ def main() -> None:
     report, state, _engine, _tok = run_capacity(
         model=args.model, schedule=schedule,
         stage0_rounds=args.stage0_rounds, stage_rounds=args.stage_rounds,
-        attempts=args.attempts, seed=args.seed, group_size=args.group_size)
+        attempts=args.attempts, seed=args.seed, group_size=args.group_size,
+        save_dir=args.save_dir,
+        stop_on_unconditioned=args.stop_on_unconditioned)
     if args.save_dir:
         from senweaver_ide_tpu.training.checkpoint import CheckpointManager
         CheckpointManager(args.save_dir).save(
