@@ -39,9 +39,9 @@ import sys
 import time
 
 from eval_uplift_real import (DECOY_RULE, RULE_HIGH, RULE_LOW,
-                              minimal_sysmsg, pretrain_rule_policy,
-                              pretrain_with_retries, probe_frac_low,
-                              realistic_prefix)
+                              load_policy, minimal_sysmsg,
+                              pretrain_rule_policy, pretrain_with_retries,
+                              probe_frac_low, realistic_prefix)
 
 PROBE_TEXT = "write the response bytes"   # held out from PRETRAIN_TEXTS
 
@@ -62,7 +62,8 @@ def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
                  group_size: int = 16, stop_mean: float = 0.9,
                  lr: float = 0.02, save_dir=None,
                  stop_on_unconditioned: bool = False,
-                 stage_probe_episodes: int = 4):
+                 stage_probe_episodes: int = 4,
+                 init_from=None):
     """Returns (report_dict, final_state, engine, tok).
 
     Each stage ends with a HELD-OUT probe at its own prefix (cheap,
@@ -91,18 +92,28 @@ def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
                                    "prefix_bytes": n})
         return stage
 
-    # Stage 0: the proven short-prefix regime, with seed retries (the
-    # flagship recipe's convergence is stochastic — ROUND4_NOTES).
+    # Stage 0: the proven short-prefix regime — either a pre-converged
+    # rule-following checkpoint (``init_from``, e.g. the flagship uplift
+    # pretrain: skips the seed lottery entirely) or a fresh pretrain
+    # with seed retries (convergence is stochastic — ROUND4_NOTES).
     t0 = time.monotonic()
-    state, engine, tok, _cfg, curve, seed_used, tried = \
-        pretrain_with_retries(max_attempts=attempts, seed=seed,
-                              seed_stride=7, rounds=stage0_rounds,
-                              group_size=group_size, lr=lr, model=model,
-                              prefix_bytes=int(schedule[0]), max_len=4096,
-                              stop_mean=stop_mean)
+    if init_from:
+        state, engine, tok, _cfg = load_policy(init_from, model=model,
+                                               seed=seed, lr=lr)
+        curve, seed_used = [], seed
+        tried = [{"loaded_from": init_from}]
+    else:
+        state, engine, tok, _cfg, curve, seed_used, tried = \
+            pretrain_with_retries(max_attempts=attempts, seed=seed,
+                                  seed_stride=7, rounds=stage0_rounds,
+                                  group_size=group_size, lr=lr,
+                                  model=model,
+                                  prefix_bytes=int(schedule[0]),
+                                  max_len=4096, stop_mean=stop_mean)
     stages.append(bank_stage({
         "prefix_bytes": int(schedule[0]), "rounds_run": len(curve),
-        "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4),
+        "tail_mean": round(sum(curve[-4:]) / max(len(curve[-4:]), 1), 4)
+        if curve else None,
         "curve": curve,
         "attempts": tried, "seed_used": seed_used,
         "wall_s": round(time.monotonic() - t0, 1),
@@ -170,7 +181,7 @@ def run_capacity(*, model: str, schedule, stage0_rounds: int = 40,
                    "stop_mean": stop_mean,
                    "stop_on_unconditioned": stop_on_unconditioned,
                    "stage_probe_episodes": stage_probe_episodes,
-                   "save_dir": save_dir},
+                   "save_dir": save_dir, "init_from": init_from},
         "total_wall_s": round(time.monotonic() - t_all, 1),
     }
     return report, state, engine, tok
@@ -196,6 +207,10 @@ def main() -> None:
     ap.add_argument("--stop-on-unconditioned", action="store_true",
                     help="abort remaining stages when a stage's held-out "
                          "probe delta < 0.3 (don't churn past failure)")
+    ap.add_argument("--init-from", default=None,
+                    help="stage-0 checkpoint dir (a pre-converged rule "
+                         "follower, e.g. /tmp/uplift_ckpt) — skips the "
+                         "stage-0 pretrain and its seed lottery")
     args = ap.parse_args()
 
     import jax
@@ -208,7 +223,8 @@ def main() -> None:
         stage0_rounds=args.stage0_rounds, stage_rounds=args.stage_rounds,
         attempts=args.attempts, seed=args.seed, group_size=args.group_size,
         save_dir=args.save_dir,
-        stop_on_unconditioned=args.stop_on_unconditioned)
+        stop_on_unconditioned=args.stop_on_unconditioned,
+        init_from=args.init_from)
     if args.save_dir:
         from senweaver_ide_tpu.training.checkpoint import CheckpointManager
         CheckpointManager(args.save_dir).save(

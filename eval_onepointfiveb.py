@@ -53,7 +53,6 @@ def main() -> None:
     jax.config.update("jax_platforms", "cpu")
 
     import jax.numpy as jnp
-    import numpy as np
 
     from senweaver_ide_tpu.models import get_config
     from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
@@ -111,15 +110,17 @@ def main() -> None:
                 prompt, max_new_tokens=args.max_new_tokens)))
     engine.run()
     trajs = []
-    rng = np.random.default_rng(args.seed)
     for ti, rid in rids:
         out = engine.result(rid)
         prompt = tok.encode(f"User: {tasks[ti]}\nAssistant:", add_bos=True)
-        # Outcome judge at shape: low-byte fraction (the random-init
-        # policy emits a mix, so group advantages are non-degenerate).
-        low = sum(1 for t in out if 0 <= t < 128) / max(len(out), 1)
+        # Outcome judge at shape: token-id parity — exactly half of ANY
+        # vocab qualifies, so a random-init policy's samples vary and
+        # group advantages are non-degenerate (a byte-class judge
+        # collapses on a 151k-entry vocab: every reward -1, advantage 0,
+        # loss identically 0 — observed on the first 1.5B run).
+        even = sum(1 for t in out if t % 2 == 0) / max(len(out), 1)
         trajs.append(Trajectory(prompt_ids=prompt, completion_ids=out,
-                                reward=2.0 * low - 1.0, group_id=ti))
+                                reward=2.0 * even - 1.0, group_id=ti))
     report["phases"]["rollout"] = {
         "wall_s": round(time.monotonic() - t0, 1),
         "episodes": len(trajs),
