@@ -79,6 +79,10 @@ def test_onepointfiveb_artifact():
     tr = d["phases"]["train"]
     assert len(tr["losses"]) >= 2
     assert all(isinstance(x, float) for x in tr["losses"])
+    # the honest signal: ratio-1 surrogate loss is ~0 by construction,
+    # gradient norm is not
+    assert tr["update_signal"] is True
+    assert all(g > 0 for g in tr["grad_norms"])
     assert d["phases"]["rollout"]["episodes"] >= 4
 
 
