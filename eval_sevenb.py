@@ -268,8 +268,12 @@ def main() -> None:
         trajs = []
         prompt = tok.encode("def main():", add_bos=True)
         budget = max(args.update_seq - len(prompt) - 1, 8)
-        for g in range(2):
-            comp = rng.integers(0, 256, size=budget).tolist()
+        # Contrastive BY CONSTRUCTION: one low-byte and one high-byte
+        # completion → rewards +1/−1, so the group advantage (and the
+        # gradient) cannot degenerate (two same-distribution random
+        # draws can tie on the judge — observed: grad_norm exactly 0).
+        for g, (lo, hi) in enumerate(((0, 128), (128, 256))):
+            comp = rng.integers(lo, hi, size=budget).tolist()
             low = sum(1 for t in comp if t < 128) / len(comp)
             trajs.append(Trajectory(prompt_ids=list(prompt),
                                     completion_ids=comp,
