@@ -1,0 +1,106 @@
+#!/usr/bin/env python3
+"""Summarize a span JSONL (obs.Tracer stream/export) into a per-stage
+latency table.
+
+Usage::
+
+    python scripts/obs_report.py spans.jsonl [--top 20] [--sort total]
+
+Columns: count, total ms, mean, p50, p95, max — the quick answer to
+"where did the round go?" without loading the Chrome trace into
+Perfetto. Reads the same JSONL that ``obs.enable(span_jsonl=...)``
+streams live, so it works mid-run on a partially written file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+# Allow running from a source checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from senweaver_ide_tpu.obs import load_span_jsonl  # noqa: E402
+
+SORT_KEYS = ("total", "count", "mean", "max", "name")
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize(path: str) -> List[Dict[str, float]]:
+    by_name: Dict[str, List[float]] = {}
+    for span in load_span_jsonl(path):
+        by_name.setdefault(span.name, []).append(span.duration_ms)
+    rows = []
+    for name, durs in by_name.items():
+        durs.sort()
+        total = sum(durs)
+        rows.append({
+            "name": name, "count": len(durs), "total": total,
+            "mean": total / len(durs), "p50": percentile(durs, 0.50),
+            "p95": percentile(durs, 0.95), "max": durs[-1],
+        })
+    return rows
+
+
+def render(rows: List[Dict[str, float]]) -> str:
+    headers = ("stage", "count", "total_ms", "mean_ms", "p50_ms",
+               "p95_ms", "max_ms")
+    table = [headers] + [
+        (str(r["name"]), str(r["count"]), f"{r['total']:.1f}",
+         f"{r['mean']:.2f}", f"{r['p50']:.2f}", f"{r['p95']:.2f}",
+         f"{r['max']:.2f}") for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(
+            cell.ljust(widths[j]) if j == 0 else cell.rjust(widths[j])
+            for j, cell in enumerate(row)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Per-stage latency summary of an obs span JSONL.")
+    parser.add_argument("path", help="span JSONL from obs.enable("
+                        "span_jsonl=...) or Tracer.export_jsonl()")
+    parser.add_argument("--top", type=int, default=0,
+                        help="show only the first N stages (0 = all)")
+    parser.add_argument("--sort", choices=SORT_KEYS, default="total",
+                        help="sort column (default: total)")
+    args = parser.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"obs_report: no such file: {args.path}", file=sys.stderr)
+        return 2
+    rows = summarize(args.path)
+    if not rows:
+        print("obs_report: no spans found (empty or torn file)")
+        return 0
+    reverse = args.sort != "name"
+    rows.sort(key=lambda r: r[args.sort], reverse=reverse)
+    if args.top > 0:
+        rows = rows[: args.top]
+    print(render(rows))
+    total_ms = sum(r["total"] for r in rows)
+    total_spans = sum(r["count"] for r in rows)
+    print(f"\n{total_spans} spans, {total_ms:.1f} ms total "
+          f"(sorted by {args.sort})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,7 @@ import dataclasses
 import time
 from typing import Callable, List, Optional
 
+from ..obs import get_tracer
 from ..tools.service import ToolsService
 from ..traces.collector import TraceCollector
 from .llm import (ChatMessage, ContextLengthError, LLMResponse,
@@ -141,6 +142,16 @@ class AgentLoop:
     def run(self, agent_id: str, user_message: str, *,
             system_message: str = "",
             history: Optional[List[ChatMessage]] = None) -> AgentLoopResult:
+        with get_tracer().span("agent.turn", agent=agent_id,
+                               thread=self.thread_id):
+            return self._run_impl(agent_id, user_message,
+                                  system_message=system_message,
+                                  history=history)
+
+    def _run_impl(self, agent_id: str, user_message: str, *,
+                  system_message: str = "",
+                  history: Optional[List[ChatMessage]] = None
+                  ) -> AgentLoopResult:
         agent = get_agent(agent_id)
         if agent is None:
             raise KeyError(f"unknown agent: {agent_id}")
@@ -165,7 +176,9 @@ class AgentLoop:
                 aborted = "max_steps"
                 break
             try:
-                resp, messages = self._call_with_retries(agent, messages)
+                with get_tracer().span("agent.llm_call", step=steps):
+                    resp, messages = self._call_with_retries(agent,
+                                                             messages)
             except Exception as e:
                 if tc:
                     tc.record_error(tid, steps, str(e))
@@ -201,7 +214,9 @@ class AgentLoop:
                               f"to use tool '{call.name}'")
                 ok, duration_ms = False, 0.0
             else:
-                tr = self.tools.call_tool(call.name, dict(call.params))
+                with get_tracer().span("agent.tool", tool=call.name,
+                                       step=steps):
+                    tr = self.tools.call_tool(call.name, dict(call.params))
                 result_str = self.tools.string_of_result(tr)
                 ok, duration_ms = tr.ok, tr.duration_ms
             if not ok:

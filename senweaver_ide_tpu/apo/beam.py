@@ -21,6 +21,7 @@ from typing import Callable, List, Optional, Sequence
 
 import jax.numpy as jnp
 
+from ..obs import get_tracer
 from ..rewards.head import reward_head_batch
 from ..traces.schema import Trace
 from ..traces.features import batch_features
@@ -64,6 +65,19 @@ def propose_candidates(
 ) -> List[PromptVersion]:
     """Textual-gradient branch expansion: critique the parent against a batch
     of rollouts, then apply-edit to produce ``branch_factor`` children."""
+    with get_tracer().span("apo.propose", parent=parent.version,
+                           branch_factor=branch_factor):
+        return _propose_candidates_impl(parent, rollouts, generate_fn,
+                                        branch_factor, state)
+
+
+def _propose_candidates_impl(
+    parent: PromptVersion,
+    rollouts: Sequence[RolloutResult],
+    generate_fn: GenerateFn,
+    branch_factor: int,
+    state: BeamState,
+) -> List[PromptVersion]:
     parent_rules = parse_rules(parent.content) or (
         [parent.content] if parent.content else [])
     children: List[PromptVersion] = []
@@ -117,18 +131,22 @@ def beam_search(
             st.history_best_score = seed.score
             st.history_best_prompt = seed
 
+    tracer = get_tracer()
     while st.current_round < st.total_rounds:
         st.current_round += 1
-        candidates: List[PromptVersion] = list(st.beam)
-        for parent in st.beam:
-            candidates.extend(propose_candidates(
-                parent, rollouts, generate_fn, cfg.branch_factor, st))
-        for cand in candidates:
-            if cand.score is None:
-                cand.score = score_fn(parse_rules(cand.content)
-                                      or [cand.content])
-        candidates.sort(key=lambda c: c.score if c.score is not None
-                        else float("-inf"), reverse=True)
+        with tracer.span("apo.beam_round", round=st.current_round,
+                         beam=len(st.beam)):
+            candidates: List[PromptVersion] = list(st.beam)
+            for parent in st.beam:
+                candidates.extend(propose_candidates(
+                    parent, rollouts, generate_fn, cfg.branch_factor, st))
+            with tracer.span("apo.score", candidates=len(candidates)):
+                for cand in candidates:
+                    if cand.score is None:
+                        cand.score = score_fn(parse_rules(cand.content)
+                                              or [cand.content])
+            candidates.sort(key=lambda c: c.score if c.score is not None
+                            else float("-inf"), reverse=True)
         st.beam = candidates[: cfg.beam_width]
         if st.beam and st.beam[0].score is not None \
                 and st.beam[0].score > st.history_best_score:

@@ -1,0 +1,131 @@
+"""Training telemetry: tokens/sec, step-time breakdown, analytic MFU.
+
+RLAX (arxiv 2512.06392) and the Podracer architectures (arxiv
+2104.06272) treat actor/learner throughput counters as load-bearing
+infrastructure for distributed RL; this module is that layer for the
+GRPO loop. ``StepTelemetry.record_round`` is called once per round from
+``training/rl_loop.py`` (a handful of dict writes — cheap enough to run
+unconditionally, so the dashboard tile is live without span tracing) and
+publishes:
+
+- ``senweaver_tokens_per_sec{phase=train|collect}`` gauges,
+- ``senweaver_train_step_ms`` histogram (plus collect/batch_build stage
+  gauges ``senweaver_stage_seconds{stage=...}``),
+- ``senweaver_rounds_total`` / ``senweaver_episodes_total`` /
+  ``senweaver_trajectories_total`` counters,
+- ``senweaver_step_flops_per_sec`` and, when a peak-FLOPs figure is
+  known, ``senweaver_mfu``.
+
+MFU is the standard analytic estimate: a dense decoder step costs
+``6 * params * tokens`` FLOPs (fwd 2x + bwd 4x), so
+``mfu = 6 * N * tokens / (step_s * peak_flops)``. Peak FLOPs comes from
+the constructor or the ``SENWEAVER_PEAK_FLOPS`` env var (e.g. 1.97e14
+for a v5e chip in bf16); without it the absolute achieved FLOP/s gauge
+still publishes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry
+
+TRAIN_STEP_MS_BUCKETS = (10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1_000.0,
+                         2_500.0, 5_000.0, 10_000.0, 30_000.0, 60_000.0,
+                         120_000.0, 300_000.0)
+
+
+def estimate_mfu(param_count: int, tokens: int, step_s: float,
+                 peak_flops: float) -> float:
+    """Model-FLOPs utilization of one train step (6N FLOPs/token)."""
+    if step_s <= 0 or peak_flops <= 0:
+        return 0.0
+    return (6.0 * param_count * tokens) / (step_s * peak_flops)
+
+
+class StepTelemetry:
+    """Per-round throughput/MFU publisher over a metrics registry.
+
+    Constructing one per round is fine: registry instruments are
+    idempotent lookups. ``param_count`` enables the FLOPs estimate
+    (``models.count_params`` of the trained tree); for LoRA states pass
+    the FULL policy's count if an honest MFU is wanted — the adapter
+    tree alone undercounts the forward cost.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None, *,
+                 param_count: Optional[int] = None,
+                 peak_flops: Optional[float] = None):
+        if registry is None:
+            from . import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.param_count = param_count
+        if peak_flops is None:
+            env = os.environ.get("SENWEAVER_PEAK_FLOPS")
+            peak_flops = float(env) if env else None
+        self.peak_flops = peak_flops
+        r = registry
+        self._tps = r.gauge(
+            "senweaver_tokens_per_sec",
+            "Token throughput per phase (train: batch tokens x ppo "
+            "epochs / update time; collect: sampled completion tokens / "
+            "collection time).", labelnames=("phase",))
+        self._step_ms = r.histogram(
+            "senweaver_train_step_ms",
+            "Wall time of the GRPO update (all ppo epochs) per round.",
+            buckets=TRAIN_STEP_MS_BUCKETS)
+        self._stage_s = r.gauge(
+            "senweaver_stage_seconds",
+            "Last round's wall time per loop stage.",
+            labelnames=("stage",))
+        self._rounds = r.counter(
+            "senweaver_rounds_total", "Completed GRPO rounds.")
+        self._episodes = r.counter(
+            "senweaver_episodes_total", "Episodes collected.")
+        self._trajectories = r.counter(
+            "senweaver_trajectories_total",
+            "Trajectories (one per LLM call) collected.")
+        self._flops = r.gauge(
+            "senweaver_step_flops_per_sec",
+            "Achieved model FLOP/s of the last train step (6N/token "
+            "analytic estimate).")
+        self._mfu = r.gauge(
+            "senweaver_mfu",
+            "Model-FLOPs utilization of the last train step "
+            "(vs. peak_flops).")
+
+    def record_round(self, *, collect_s: float, batch_build_s: float,
+                     train_s: float, batch_tokens: int,
+                     completion_tokens: int = 0, episodes: int = 0,
+                     trajectories: int = 0,
+                     ppo_epochs: int = 1) -> Dict[str, Any]:
+        """Publish one round's telemetry; returns the derived values so
+        the caller can also feed them to MetricsService captures."""
+        train_tokens = batch_tokens * max(1, ppo_epochs)
+        out: Dict[str, Any] = {}
+        if train_s > 0:
+            out["tokens_per_sec"] = train_tokens / train_s
+            self._tps.set(out["tokens_per_sec"], phase="train")
+        if collect_s > 0 and completion_tokens > 0:
+            out["collect_tokens_per_sec"] = completion_tokens / collect_s
+            self._tps.set(out["collect_tokens_per_sec"], phase="collect")
+        self._step_ms.observe(train_s * 1000.0)
+        self._stage_s.set(collect_s, stage="collect")
+        self._stage_s.set(batch_build_s, stage="batch_build")
+        self._stage_s.set(train_s, stage="train_step")
+        self._rounds.inc()
+        if episodes:
+            self._episodes.inc(episodes)
+        if trajectories:
+            self._trajectories.inc(trajectories)
+        if self.param_count and train_s > 0:
+            flops_per_sec = 6.0 * self.param_count * train_tokens / train_s
+            out["step_flops_per_sec"] = flops_per_sec
+            self._flops.set(flops_per_sec)
+            if self.peak_flops:
+                out["mfu"] = estimate_mfu(self.param_count, train_tokens,
+                                          train_s, self.peak_flops)
+                self._mfu.set(out["mfu"])
+        return out

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..models.config import ModelConfig
 from ..models.transformer import KVCache, Params, forward, init_kv_cache
+from ..obs import get_registry, get_tracer
 from ..ops.sampling import sample_token, sampled_logprob
 from .sampler import SampleParams
 
@@ -442,16 +443,28 @@ class RolloutEngine:
         active_list = [r is not None for r in self._slot_req]
         if not any(active_list):
             return emitted
-        active = jnp.asarray(active_list)
-        self._key, step_key = jax.random.split(self._key)
-        next_tok, logp, self.cache = _pool_decode_step(
-            self.params, self.config, self.cur_tok, active, self.cache,
-            step_key, self.sample)
-        self.cur_tok = next_tok
-        self._stats["decode_steps"] += 1
-        toks = np.asarray(next_tok)
-        logps = np.asarray(logp)
-        lengths = np.asarray(self.cache.length)
+        tracer = get_tracer()
+        with tracer.span("engine.decode_step",
+                         active=sum(active_list)):
+            active = jnp.asarray(active_list)
+            self._key, step_key = jax.random.split(self._key)
+            next_tok, logp, self.cache = _pool_decode_step(
+                self.params, self.config, self.cur_tok, active, self.cache,
+                step_key, self.sample)
+            self.cur_tok = next_tok
+            self._stats["decode_steps"] += 1
+            # np.asarray blocks on the device step, so the span spans the
+            # actual decode, not just its dispatch.
+            toks = np.asarray(next_tok)
+            logps = np.asarray(logp)
+            lengths = np.asarray(self.cache.length)
+        if tracer.enabled:
+            reg = get_registry()
+            reg.counter("senweaver_engine_decode_steps_total",
+                        "Pool decode steps executed.").inc()
+            reg.counter("senweaver_engine_tokens_total",
+                        "Tokens emitted by the rollout engine."
+                        ).inc(sum(active_list))
         for slot, req in enumerate(self._slot_req):
             if req is None:
                 continue
@@ -540,8 +553,10 @@ class RolloutEngine:
         self._slot_held[slot] = None
         self._slot_req[slot] = req
         slot_arr = jnp.asarray(slot, jnp.int32)
-        last_logits = self._prefill_chunks(slot_arr, delta,
-                                           fresh_first=False)
+        with get_tracer().span("engine.prefill_continuation", slot=slot,
+                               delta_tokens=len(delta)):
+            last_logits = self._prefill_chunks(slot_arr, delta,
+                                               fresh_first=False)
         self._stats["continuations"] += 1
         self._stats["continuation_delta_tokens"] += len(delta)
         self._emit_first_token(req, slot, last_logits)
@@ -747,6 +762,12 @@ class RolloutEngine:
                 self._schedule_batch(group, free[:len(group)], bucket)
 
     def _schedule_single(self, req: "_Request", slot: int) -> None:
+        with get_tracer().span("engine.prefill", slot=slot,
+                               tokens=len(req.prompt),
+                               prefix=req.prefix_id is not None):
+            self._schedule_single_impl(req, slot)
+
+    def _schedule_single_impl(self, req: "_Request", slot: int) -> None:
         req.slot = slot
         self._slot_req[slot] = req
         true_len = len(req.prompt)
@@ -796,6 +817,12 @@ class RolloutEngine:
         padded to a power of two by REPEATING row 0 (duplicate slot +
         identical data = benign scatter), bounding the compile set to
         (log2 slots × bucket ladder) shapes."""
+        with get_tracer().span("engine.prefill_batch", slots=len(group),
+                               bucket=bucket):
+            self._schedule_batch_impl(group, slots, bucket)
+
+    def _schedule_batch_impl(self, group: List["_Request"],
+                             slots: List[int], bucket: int) -> None:
         n = len(group)
         n_pad = 1
         while n_pad < n:

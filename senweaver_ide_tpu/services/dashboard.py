@@ -60,7 +60,8 @@ class DashboardService:
     def __init__(self, *, collector=None, apo=None, engine=None,
                  control=None, metrics_path: Optional[str] = None,
                  onboarding=None, title: str = "senweaver-tpu trainer",
-                 control_socket: Optional[str] = None):
+                 control_socket: Optional[str] = None,
+                 tracer=None, registry=None):
         self.collector = collector
         self.apo = apo
         self.engine = engine
@@ -68,6 +69,15 @@ class DashboardService:
         self.metrics_path = metrics_path
         self.onboarding = onboarding
         self.title = title
+        # Observability plane: defaults to the process-global tracer +
+        # registry (obs/), so an instrumented trainer's spans and
+        # telemetry show up with zero wiring; tests pass their own.
+        if tracer is None or registry is None:
+            from ..obs import get_registry, get_tracer
+            tracer = tracer or get_tracer()
+            registry = registry or get_registry()
+        self.tracer = tracer
+        self.registry = registry
         # Operator actions go over the control-plane SOCKET (never by
         # calling the services directly): the dashboard holds no
         # credentials — the operator's token travels request → RPC auth
@@ -132,7 +142,30 @@ class DashboardService:
             except Exception as e:
                 out["onboarding"] = {"error": str(e)}
         out["training"] = _training_curves(self.metrics_path)
+        out["obs"] = self._obs_summary()
         return out
+
+    def _obs_summary(self) -> Dict[str, Any]:
+        """Span counts, top-5 slowest spans, and the live throughput
+        gauges — the obs tile's data (and /api/state's view of what the
+        /metrics endpoint serves in full)."""
+        try:
+            summary = self.tracer.summary(top=5)
+            tps = self.registry.get("senweaver_tokens_per_sec")
+            if tps is not None:
+                summary["tokens_per_sec"] = tps.value(phase="train")
+                summary["collect_tokens_per_sec"] = \
+                    tps.value(phase="collect")
+            else:
+                summary["tokens_per_sec"] = None
+            mfu = self.registry.get("senweaver_mfu")
+            summary["mfu"] = mfu.value() if mfu is not None else None
+            rounds = self.registry.get("senweaver_rounds_total")
+            summary["rounds_total"] = (rounds.value()
+                                       if rounds is not None else 0)
+            return summary
+        except Exception as e:
+            return {"error": str(e)}
 
     # -- http --------------------------------------------------------------
     def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
@@ -144,6 +177,11 @@ class DashboardService:
                 if self.path.startswith("/api/state"):
                     body = json.dumps(service.state()).encode()
                     ctype = "application/json"
+                elif self.path == "/metrics":
+                    # Prometheus text exposition of the obs registry —
+                    # scrape-ready (format v0.0.4).
+                    body = service.registry.render().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path == "/" or self.path.startswith("/index"):
                     body = _PAGE.replace("__TITLE__", service.title).encode()
                     ctype = "text/html; charset=utf-8"
@@ -296,6 +334,9 @@ input[type=text], input[type=password], textarea {
 <section><h2>Training</h2>
 <div id="charts"></div>
 <div id="rounds-table"></div></section>
+<section><h2>Observability</h2>
+<div id="obs" class="tiles"></div>
+<div id="obs-spans"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2>
 <div class="actionbar">
@@ -490,6 +531,17 @@ async function refresh() {
                         fmt((tr.episodes || [])[p]),
                         fmt((tr.collect_s || [])[p])]),
     ["round", "reward_mean", "loss", "episodes", "collect_s"]);
+  const ob_ = s.obs || {};
+  tiles(document.getElementById("obs"), [
+    ["tracing", ob_.enabled ? "on" : "off"],
+    ["spans", ob_.total_spans],
+    ["rounds", ob_.rounds_total],
+    ["tokens/s train", ob_.tokens_per_sec],
+    ["tokens/s collect", ob_.collect_tokens_per_sec],
+    ["mfu", ob_.mfu]]);
+  document.getElementById("obs-spans").innerHTML = table(
+    (ob_.slowest || []).map(x => [x.name, x.duration_ms]),
+    ["slowest span", "ms"]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

@@ -5,6 +5,13 @@ Mirrors `common/metricsService.ts` + `electron-main/metricsMainService.ts`
 reference, :30-40) unless the user opted out (OPT_OUT_KEY). Here the
 default sink is a JSONL file; any callable(dict) works (e.g. a real
 telemetry client).
+
+The JSONL sink keeps a cached append handle (flushed per capture so
+tails/readers see live data; ``close()`` releases it) instead of
+reopening the file per event, and an optional ``registry``
+(obs.MetricsRegistry) additionally counts every capture into
+``senweaver_events_total{event=...}`` — the bridge that lets legacy
+captures show up on the new ``/metrics`` endpoint.
 """
 
 from __future__ import annotations
@@ -20,7 +27,8 @@ class MetricsService:
     def __init__(self, sink: Optional[Callable[[Dict[str, Any]], None]]
                  = None, *, jsonl_path: Optional[str] = None,
                  opted_out: bool = False,
-                 common_properties: Optional[Dict[str, Any]] = None):
+                 common_properties: Optional[Dict[str, Any]] = None,
+                 registry=None):
         self._sink = sink
         self._jsonl_path = jsonl_path
         self.opted_out = opted_out
@@ -28,6 +36,17 @@ class MetricsService:
         self._lock = threading.Lock()
         self.captured_count = 0
         self._buffer: List[Dict[str, Any]] = []   # kept when no sink set
+        # Cached append handle for the JSONL sink — opened lazily on
+        # first capture, flushed per event, closed via close(). Its own
+        # lock so slow disk I/O never serializes capture bookkeeping.
+        self._fh = None
+        self._io_lock = threading.Lock()
+        self._events_counter = None
+        if registry is not None:
+            self._events_counter = registry.counter(
+                "senweaver_events_total",
+                "Events captured by MetricsService.",
+                labelnames=("event",))
 
     def set_opt_out(self, opted_out: bool) -> None:
         self.opted_out = opted_out
@@ -44,19 +63,45 @@ class MetricsService:
         # (or reentrant) sink must not serialize or deadlock capturers.
         with self._lock:
             self.captured_count += 1
-            if self._sink is None and not self._jsonl_path:
+            buffered = self._sink is None and not self._jsonl_path
+            if buffered:
                 self._buffer.append(record)
                 if len(self._buffer) > 10_000:
                     del self._buffer[:5_000]
-                return
         try:
+            if self._events_counter is not None:
+                self._events_counter.inc(event=event)
+            if buffered:
+                return
             if self._sink is not None:
                 self._sink(record)
             elif self._jsonl_path:
-                with open(self._jsonl_path, "a") as f:
-                    f.write(json.dumps(record) + "\n")
+                self._write_jsonl(record)
         except Exception:
             pass
+
+    def _write_jsonl(self, record: Dict[str, Any]) -> None:
+        with self._io_lock:
+            if self._fh is None:
+                self._fh = open(self._jsonl_path, "a")
+            self._fh.write(json.dumps(record) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        """Release the cached JSONL handle (captures after close simply
+        reopen it)."""
+        with self._io_lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                finally:
+                    self._fh = None
+
+    def __enter__(self) -> "MetricsService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def drain(self) -> List[Dict[str, Any]]:
         with self._lock:

@@ -29,18 +29,35 @@ DEFAULT_TOKEN_THRESHOLDS: Dict[str, int] = {
 
 
 class PerformanceMonitor:
-    """Stage timing with threshold warnings, optionally metric-captured."""
+    """Stage timing with threshold warnings, optionally metric-captured.
+
+    ``registry`` (obs.MetricsRegistry) bridges every recorded stage into
+    the new metrics plane: ``senweaver_stage_ms{stage=...}`` histograms
+    and a ``senweaver_perf_warnings_total{stage=...}`` counter — legacy
+    callers keep their snapshot()/warnings surface and the /metrics
+    endpoint sees the same data."""
 
     def __init__(self, metrics=None,
                  thresholds_ms: Optional[Dict[str, float]] = None,
-                 token_thresholds: Optional[Dict[str, int]] = None):
+                 token_thresholds: Optional[Dict[str, int]] = None,
+                 registry=None):
         self.metrics = metrics
         self.thresholds_ms = {**DEFAULT_THRESHOLDS_MS,
                               **(thresholds_ms or {})}
         self.token_thresholds = {**DEFAULT_TOKEN_THRESHOLDS,
                                  **(token_thresholds or {})}
-        self.timings: Dict[str, float] = {}       # last duration per stage
+        self.timings: Dict[str, float] = {}       # last value per stage
         self.warnings: list = []
+        self._stage_hist = self._warn_counter = None
+        if registry is not None:
+            self._stage_hist = registry.histogram(
+                "senweaver_stage_ms",
+                "PerformanceMonitor stage wall times.",
+                labelnames=("stage",))
+            self._warn_counter = registry.counter(
+                "senweaver_perf_warnings_total",
+                "Stages observed over their threshold.",
+                labelnames=("stage",))
 
     @contextlib.contextmanager
     def stage(self, name: str, **extra: Any) -> Iterator[None]:
@@ -53,11 +70,16 @@ class PerformanceMonitor:
 
     def record_ms(self, name: str, ms: float, **extra: Any) -> None:
         self.timings[name] = ms
+        if self._stage_hist is not None:
+            self._stage_hist.observe(ms, stage=name)
         limit = self.thresholds_ms.get(name)
         if limit is not None and ms > limit:
             self._warn(name, ms, limit, "ms", extra)
 
     def record_tokens(self, name: str, tokens: int, **extra: Any) -> None:
+        # Token stages land in timings too — snapshot() must show every
+        # recorded stage, not silently omit the token-threshold ones.
+        self.timings[name] = float(tokens)
         limit = self.token_thresholds.get(name)
         if limit is not None and tokens > limit:
             self._warn(name, float(tokens), float(limit), "tokens", extra)
@@ -68,6 +90,8 @@ class PerformanceMonitor:
                   "threshold": limit, "unit": unit, **extra}
         self.warnings.append(record)
         del self.warnings[:-100]
+        if self._warn_counter is not None:
+            self._warn_counter.inc(stage=name)
         if self.metrics is not None:
             self.metrics.capture("Performance Threshold Exceeded", record)
 

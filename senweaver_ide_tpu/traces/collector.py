@@ -307,6 +307,17 @@ class TraceCollector:
         if len(tr.spans) >= self._max_spans:  # ref :275-277 overflow guard
             return
         tr.spans.append(span)
+        # obs bridge (gated on tracing being enabled — this is a per-span
+        # hot path): conversation-span volume by type on /metrics.
+        from ..obs import get_registry, is_enabled
+        if is_enabled():
+            try:
+                get_registry().counter(
+                    "senweaver_trace_spans_total",
+                    "Conversation spans accepted by TraceCollector.",
+                    labelnames=("type",)).inc(type=span.type.value)
+            except Exception:
+                pass
         if self._span_sink is not None:
             try:
                 self._span_sink(

@@ -35,6 +35,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..apo.eval import outcome_feedback
 from ..apo.service import APOService
+from ..obs import get_tracer
 from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
 from .rl_loop import grpo_round
@@ -170,6 +171,10 @@ class OnlineImprovementLoop:
         return self.make_session(rules=list(rules), thread_id=tid)
 
     def run_round(self) -> OnlineRoundResult:
+        with get_tracer().span("online.round", round=self._round):
+            return self._run_round_impl()
+
+    def _run_round_impl(self) -> OnlineRoundResult:
         rules = self.current_rules()
 
         def reward(ti, g, session):
@@ -204,19 +209,21 @@ class OnlineImprovementLoop:
             self._anchor = self.state.params
         if self.engine is not None and hasattr(self.engine,
                                                "update_params"):
-            self.engine.update_params(self.state.params)
+            with get_tracer().span("online.publish_params"):
+                self.engine.update_params(self.state.params)
 
         # APO side of the cycle (the reference's timer tick, driven at
         # round boundaries here): analysis when gates open; prompt beam
         # search when the corpus shows a low good-rate.
         due = (self.analyze_every is None
                or self._round % self.analyze_every == 0)
-        report = self.apo.maybe_auto_analyze() if due else None
-        beam_ran = False
-        if report is not None and self.apo.should_auto_gradient() \
-                and self.apo.generate_fn is not None:
-            self.apo.run_beam_search()
-            beam_ran = True
+        with get_tracer().span("online.apo", due=due):
+            report = self.apo.maybe_auto_analyze() if due else None
+            beam_ran = False
+            if report is not None and self.apo.should_auto_gradient() \
+                    and self.apo.generate_fn is not None:
+                self.apo.run_beam_search()
+                beam_ran = True
 
         ep_rewards = [e.reward for e in out.episodes]
         result = OnlineRoundResult(

@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
 
+from ..obs import StepTelemetry, get_registry, get_tracer
 from ..rollout.session import RolloutSession
 from .data import (Trajectory, make_batch, make_batch_logps,
                    place_batch_for_mesh)
@@ -96,17 +97,27 @@ def collect_group_trajectories(
     (task_idx, g) order regardless of completion order."""
     import concurrent.futures as _fut
 
+    # Span context must cross the pool explicitly (contextvars don't):
+    # each episode span re-attaches the caller's context so the whole
+    # group nests under the round's "collect" span in the flamegraph.
+    tracer = get_tracer()
+    parent_ctx = tracer.capture()
+
+    def _episode_job(ti: int, task: str, g: int):
+        with tracer.attach(parent_ctx):
+            with tracer.span("episode", task_idx=ti, g=g):
+                return _run_episode(make_session, ti, task, g,
+                                    reward_override)
+
     jobs = [(ti, task, g) for ti, task in enumerate(tasks)
             for g in range(group_size)]
     results: Dict[tuple, tuple] = {}
     if max_parallel <= 1 or len(jobs) <= 1:
         for ti, task, g in jobs:
-            results[(ti, g)] = _run_episode(make_session, ti, task, g,
-                                            reward_override)
+            results[(ti, g)] = _episode_job(ti, task, g)
     else:
         with _fut.ThreadPoolExecutor(max_workers=max_parallel) as pool:
-            futs = {pool.submit(_run_episode, make_session, ti, task, g,
-                                reward_override): (ti, g)
+            futs = {pool.submit(_episode_job, ti, task, g): (ti, g)
                     for ti, task, g in jobs}
             for f in _fut.as_completed(futs):
                 results[futs[f]] = f.result()
@@ -151,7 +162,9 @@ def grpo_round(state: TrainState, model_config, mesh,
         raise ValueError(f"ppo_epochs must be >= 1, got {ppo_epochs}")
 
     from ..services.perf_monitor import profile_capture
-    with profile_capture(profile_dir):
+    with profile_capture(profile_dir), \
+            get_tracer().span("grpo_round", tasks=len(tasks),
+                              group_size=group_size):
         return _grpo_round_impl(
             state, model_config, mesh, make_session, tasks,
             accum_steps=accum_steps, ppo_epochs=ppo_epochs,
@@ -169,10 +182,12 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      perf_monitor=None, engine=None,
                      lora_base=None, ref_params=None) -> RoundResult:
     import time as _time
+    tracer = get_tracer()
     t0 = _time.monotonic()
-    trajectories, episodes = collect_group_trajectories(
-        make_session, tasks, group_size=group_size,
-        reward_override=reward_override, max_parallel=max_parallel)
+    with tracer.span("collect", tasks=len(tasks), group_size=group_size):
+        trajectories, episodes = collect_group_trajectories(
+            make_session, tasks, group_size=group_size,
+            reward_override=reward_override, max_parallel=max_parallel)
     collect_s = _time.monotonic() - t0
     if perf_monitor is not None:
         perf_monitor.record_ms("rollout_collect", collect_s * 1000.0,
@@ -185,18 +200,20 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         return RoundResult(state=state, metrics={}, episodes=episodes,
                            trajectories=[])
     t_b = _time.monotonic()
-    tokens, mask, rewards, group_ids = make_batch(
-        trajectories, pad_id=pad_id, max_len=max_len)
-    if perf_monitor is not None:
-        perf_monitor.record_ms("batch_build",
-                               (_time.monotonic() - t_b) * 1000.0,
-                               batch=len(trajectories))
-    # Recorded behavior logps align on the UNPADDED batch (padding
-    # appends rows/columns, leaving existing positions fixed).
-    old_logp = make_batch_logps(trajectories, tokens, mask)
-    tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
-        mesh, tokens, mask, rewards, group_ids, old_logp, pad_id=pad_id,
-        accum_steps=accum_steps)
+    with tracer.span("batch_build", trajectories=len(trajectories)):
+        tokens, mask, rewards, group_ids = make_batch(
+            trajectories, pad_id=pad_id, max_len=max_len)
+        if perf_monitor is not None:
+            perf_monitor.record_ms("batch_build",
+                                   (_time.monotonic() - t_b) * 1000.0,
+                                   batch=len(trajectories))
+        # Recorded behavior logps align on the UNPADDED batch (padding
+        # appends rows/columns, leaving existing positions fixed).
+        old_logp = make_batch_logps(trajectories, tokens, mask)
+        tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
+            mesh, tokens, mask, rewards, group_ids, old_logp,
+            pad_id=pad_id, accum_steps=accum_steps)
+    batch_build_s = _time.monotonic() - t_b
     # Multi-epoch (PPO-style) updates need the BEHAVIOR policy's logps
     # frozen across epochs — the clipped ratio is what bounds the drift.
     # Recorded sample-time logps are already exactly that; without them,
@@ -205,12 +222,13 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if ppo_epochs > 1 and old_logp is None:
         from .async_loop import behavior_logp_batched
         t_b = _time.monotonic()
-        logp_params = state.params
-        if lora_base is not None:
-            from .lora import merge_lora
-            logp_params = merge_lora(lora_base, state.params)
-        old_logp = behavior_logp_batched(logp_params, model_config,
-                                         tokens, accum_steps)
+        with tracer.span("behavior_logp"):
+            logp_params = state.params
+            if lora_base is not None:
+                from .lora import merge_lora
+                logp_params = merge_lora(lora_base, state.params)
+            old_logp = behavior_logp_batched(logp_params, model_config,
+                                             tokens, accum_steps)
         if perf_monitor is not None:
             perf_monitor.record_ms("behavior_logp",
                                    (_time.monotonic() - t_b) * 1000.0)
@@ -225,22 +243,41 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if ref_params is not None and grpo_config.kl_coef > 0.0:
         from .async_loop import behavior_logp_batched
         t_r = _time.monotonic()
-        ref = behavior_logp_batched(ref_params, model_config, tokens,
-                                    accum_steps)
+        with tracer.span("ref_logp"):
+            ref = behavior_logp_batched(ref_params, model_config, tokens,
+                                        accum_steps)
         if perf_monitor is not None:
             perf_monitor.record_ms("ref_logp",
                                    (_time.monotonic() - t_r) * 1000.0)
     t1 = _time.monotonic()
-    for _ in range(ppo_epochs):
-        state, metrics = train_step(
-            state, model_config, mesh, tokens, mask, rewards, group_ids,
-            old_logp=old, ref_logp=ref, grpo_config=grpo_config,
-            accum_steps=accum_steps, lora_base=lora_base)
-    out_metrics = {k: float(v) for k, v in metrics.items()}
+    with tracer.span("train_step", epochs=ppo_epochs,
+                     batch_tokens=int(tokens.size)):
+        for _ in range(ppo_epochs):
+            state, metrics = train_step(
+                state, model_config, mesh, tokens, mask, rewards,
+                group_ids, old_logp=old, ref_logp=ref,
+                grpo_config=grpo_config, accum_steps=accum_steps,
+                lora_base=lora_base)
+        # float() forces device completion, so the span/timer close on
+        # the finished update, not on async dispatch.
+        out_metrics = {k: float(v) for k, v in metrics.items()}
+    train_s = _time.monotonic() - t1
     if perf_monitor is not None:
-        perf_monitor.record_ms("train_step",
-                               (_time.monotonic() - t1) * 1000.0,
+        perf_monitor.record_ms("train_step", train_s * 1000.0,
                                epochs=ppo_epochs)
+    # Round telemetry (tokens/sec, step-time breakdown, analytic MFU):
+    # always-on — a handful of registry writes per round keeps the
+    # dashboard's obs tile and /metrics live without span tracing.
+    from ..models.transformer import count_params
+    telemetry = StepTelemetry(
+        get_registry(), param_count=count_params(state.params))
+    telemetry_out = telemetry.record_round(
+        collect_s=collect_s, batch_build_s=batch_build_s, train_s=train_s,
+        batch_tokens=int(tokens.size),
+        completion_tokens=sum(len(t.completion_ids)
+                              for t in trajectories),
+        episodes=len(episodes), trajectories=len(trajectories),
+        ppo_epochs=ppo_epochs)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
         # Engine serving counters (reuse efficiency) belong in the round
@@ -257,7 +294,8 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             "reward_mean": sum(ep_rewards) / len(ep_rewards),
             "reward_min": min(ep_rewards), "reward_max": max(ep_rewards),
             "collect_s": round(collect_s, 3),
-            "train_s": round(_time.monotonic() - t1, 3),
+            "train_s": round(train_s, 3),
+            **{k: round(float(v), 3) for k, v in telemetry_out.items()},
             **{k: round(v, 6) for k, v in out_metrics.items()},
         })
     return RoundResult(

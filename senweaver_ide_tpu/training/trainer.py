@@ -296,7 +296,14 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
             old_logp, ref_logp, grpo_config, n_groups, accum_steps)
-    if mesh is not None:
-        with mesh:
-            return _grpo_step(*args, mesh=mesh, lora_base=lora_base)
-    return _grpo_step(*args, lora_base=lora_base)
+    # Span measures DISPATCH of the jitted step (results are async);
+    # callers wanting completion time force with float()/block_until_ready
+    # inside their own enclosing span (rl_loop does).
+    from ..obs import get_tracer
+    with get_tracer().span("trainer.grpo_step",
+                           batch=int(tokens.shape[0]),
+                           accum_steps=accum_steps):
+        if mesh is not None:
+            with mesh:
+                return _grpo_step(*args, mesh=mesh, lora_base=lora_base)
+        return _grpo_step(*args, lora_base=lora_base)

@@ -100,6 +100,46 @@ def test_http_serves_page_and_state(sources):
         dash.stop()
 
 
+def test_metrics_endpoint_and_obs_state(sources):
+    """GET /metrics serves Prometheus text; state() carries the obs
+    summary block (span counts + throughput gauges)."""
+    from senweaver_ide_tpu import obs
+    obs._reset_for_tests()
+    try:
+        obs.get_registry().counter(
+            "senweaver_rounds_total", "rounds").inc(2)
+        obs.get_registry().gauge(
+            "senweaver_tokens_per_sec", "tput",
+            labelnames=("phase",)).set(42.0, phase="train")
+        obs.enable()
+        with obs.get_tracer().span("train_step"):
+            pass
+        collector, metrics_path = sources
+        dash = DashboardService(collector=collector,
+                                metrics_path=metrics_path)
+        s = dash.state()
+        assert s["obs"]["enabled"] is True
+        assert s["obs"]["total_spans"] == 1
+        assert s["obs"]["slowest"][0]["name"] == "train_step"
+        assert s["obs"]["tokens_per_sec"] == 42.0
+        assert s["obs"]["rounds_total"] == 2
+        json.dumps(s)
+
+        port = dash.start(port=0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert "# TYPE senweaver_rounds_total counter" in text
+            assert "senweaver_rounds_total 2" in text
+            assert 'senweaver_tokens_per_sec{phase="train"} 42' in text
+        finally:
+            dash.stop()
+    finally:
+        obs._reset_for_tests()
+
+
 def test_sources_are_optional_and_errors_contained(tmp_path):
     class Broken:
         def get_stats(self):

@@ -33,6 +33,30 @@ def test_token_threshold():
     assert pm.warnings and pm.warnings[0]["unit"] == "tokens"
 
 
+def test_record_tokens_lands_in_snapshot():
+    """Token stages must show up in timings/snapshot like ms stages do
+    (they were previously dropped on the floor)."""
+    pm = PerformanceMonitor(token_thresholds={"system_message_tokens": 10})
+    pm.record_tokens("system_message_tokens", 50)
+    pm.record_tokens("prompt_tokens", 7)       # no threshold configured
+    assert pm.timings["system_message_tokens"] == 50.0
+    assert pm.snapshot()["prompt_tokens"] == 7.0
+
+
+def test_registry_bridge_observes_stages():
+    from senweaver_ide_tpu.obs import MetricsRegistry
+    reg = MetricsRegistry()
+    pm = PerformanceMonitor(thresholds_ms={"slow": 1.0}, registry=reg)
+    pm.record_ms("slow", 4.0)
+    pm.record_ms("ok", 0.5)
+    hist = reg.get("senweaver_stage_ms")
+    assert hist.snapshot(stage="slow")["count"] == 1
+    assert hist.snapshot(stage="ok")["count"] == 1
+    warns = reg.get("senweaver_perf_warnings_total")
+    assert warns.value(stage="slow") == 1
+    assert warns.value(stage="ok") == 0
+
+
 def test_stage_context_manager():
     pm = PerformanceMonitor()
     with pm.stage("batch_build"):
