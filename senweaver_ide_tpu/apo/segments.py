@@ -183,6 +183,19 @@ class SegmentStore:
                     is_active=True, is_optimized=True))
         self._save()
 
+    def install_rules(self, rules: List[str]) -> None:
+        """Install ``rules`` as the exact ACTIVE optimized rule-set.
+
+        The checkpoint-resume path: OnlineImprovementLoop persists
+        ``get_optimized_rules()`` alongside the train state and replays
+        it through here, so a resumed loop's sessions render the same
+        system prompt the preempted process was serving. Delegates to
+        the beam applier — identical complete-set semantics (rules not
+        in the list retire, duplicates are not re-added)."""
+        self.apply_beam_best_prompt(PromptVersion(
+            version="resume",
+            content="\n".join(f"- {r}" for r in rules)))
+
     # --- persistence ---
 
     def _save(self) -> None:

@@ -1,0 +1,34 @@
+"""resilience — fault boundaries, update guards, and chaos injection.
+
+The robustness layer for the online GRPO loop (docs/resilience.md):
+
+- :mod:`.faults` — :class:`FailedEpisode` quarantine records,
+  :class:`ResilienceConfig` (episode timeout/retry/survivor thresholds +
+  update-guard knobs), and the shared retry-backoff shape;
+- :mod:`.guard` — :class:`UpdateGuard`, the NaN/Inf + loss-spike veto
+  over optimizer steps;
+- :mod:`.chaos` — :class:`FaultPlan`, the seeded deterministic
+  fault-injection harness (episode raise/hang/NaN-reward, engine
+  faults) the resilience tests drive every degraded path with.
+
+The episode fault boundary itself lives where the episodes run
+(``training/rl_loop.collect_group_trajectories``); preemption-safe
+resume lives on ``training/online.OnlineImprovementLoop`` — this package
+holds the policy objects they share.
+"""
+
+from .chaos import (ChaosEngine, ChaosError, ChaosSession, EngineFault,
+                    EPISODE_FAULT_KINDS, FaultPlan, FaultSpec)
+from .faults import (FailedEpisode, REASON_ERROR, REASON_TIMEOUT,
+                     ResilienceConfig, episode_retry_delay_s)
+from .guard import (REASON_LOSS_SPIKE, REASON_NONFINITE_GRAD,
+                    REASON_NONFINITE_LOSS, UpdateGuard)
+
+__all__ = [
+    "ChaosEngine", "ChaosError", "ChaosSession", "EngineFault",
+    "EPISODE_FAULT_KINDS", "FaultPlan", "FaultSpec",
+    "FailedEpisode", "REASON_ERROR", "REASON_TIMEOUT",
+    "ResilienceConfig", "episode_retry_delay_s",
+    "REASON_LOSS_SPIKE", "REASON_NONFINITE_GRAD", "REASON_NONFINITE_LOSS",
+    "UpdateGuard",
+]

@@ -1,0 +1,250 @@
+"""Deterministic fault injection for the training stack.
+
+A :class:`FaultPlan` is a seeded, replayable schedule of failures at
+exact ``(round_idx, task_idx, g)`` episode coordinates — the harness the
+resilience tests use to PROVE every degraded path end-to-end instead of
+hoping a mock raised in the right place. Three episode fault kinds:
+
+- ``raise``      — the episode dies with :class:`ChaosError` before any
+                   LLM call (a crashed worker);
+- ``hang``       — the episode sleeps ``hang_s`` before proceeding (a
+                   wedged engine slot; the boundary's timeout fires);
+- ``nan_reward`` — the episode completes but its reward is NaN (the
+                   poison propagates through advantages into a NaN loss
+                   the update guard must veto).
+
+Coordinates reach the injected session through the episode boundary's
+bind protocol: ``collect_group_trajectories`` calls
+``session.bind_episode(round_idx, task_idx, g)`` on any session that
+exposes it, and :class:`ChaosSession` uses that to consult the plan.
+``FaultSpec.times`` counts ATTEMPTS (retries re-bind a fresh session),
+so ``times=1`` with retries enabled exercises retry-then-succeed and
+``times=2`` with one retry exercises quarantine.
+
+Engine faults ride :class:`ChaosEngine` — ``submit``-call-indexed, for
+failures below the session layer (the serving plane dying mid-round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+EPISODE_FAULT_KINDS = ("raise", "hang", "nan_reward")
+ENGINE_FAULT_KINDS = ("raise", "hang")
+
+
+class ChaosError(RuntimeError):
+    """A deterministically injected failure (never a real bug)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled episode fault at exact coordinates."""
+
+    round_idx: int
+    task_idx: int
+    g: int
+    kind: str                   # one of EPISODE_FAULT_KINDS
+    times: int = 1              # attempts this fault fires for
+    hang_s: float = 30.0        # only for kind="hang"
+
+    def __post_init__(self):
+        if self.kind not in EPISODE_FAULT_KINDS:
+            raise ValueError(f"unknown episode fault kind {self.kind!r} "
+                             f"(want one of {EPISODE_FAULT_KINDS})")
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineFault:
+    """One scheduled engine fault, fired on the Nth submit() call."""
+
+    call_idx: int               # 0-based index into submit() calls
+    kind: str = "raise"         # one of ENGINE_FAULT_KINDS
+    hang_s: float = 30.0
+
+    def __post_init__(self):
+        if self.kind not in ENGINE_FAULT_KINDS:
+            raise ValueError(f"unknown engine fault kind {self.kind!r} "
+                             f"(want one of {ENGINE_FAULT_KINDS})")
+
+
+class FaultPlan:
+    """Seeded, thread-safe schedule of faults; wraps factories/engines.
+
+    The plan is the single source of truth — every injection is consumed
+    under a lock and logged to :attr:`injected`, so a test can assert
+    exactly which faults fired (and the
+    ``senweaver_chaos_faults_injected_total{kind=}`` counter mirrors it
+    for live runs)."""
+
+    def __init__(self, faults: Sequence[FaultSpec] = (),
+                 engine_faults: Sequence[EngineFault] = (), *,
+                 registry=None):
+        self.faults = list(faults)
+        self.engine_faults = list(engine_faults)
+        self._lock = threading.Lock()
+        # remaining attempt budget per episode fault (parallel index)
+        self._remaining: List[int] = [f.times for f in self.faults]
+        self._engine_remaining: Dict[int, EngineFault] = {
+            f.call_idx: f for f in self.engine_faults}
+        self._submit_calls = 0
+        self.injected: List[Tuple[str, Tuple[int, ...]]] = []
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._injected_total = registry.counter(
+            "senweaver_chaos_faults_injected_total",
+            "Faults injected by the chaos harness", labelnames=("kind",))
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def sample(cls, seed: int, *, rounds: int, num_tasks: int,
+               group_size: int, rate: float = 0.1,
+               kinds: Sequence[str] = EPISODE_FAULT_KINDS,
+               hang_s: float = 30.0, times: int = 1) -> "FaultPlan":
+        """Random-but-replayable plan: each (round, task, g) coordinate
+        independently faults with probability ``rate``; the same seed
+        always yields the same plan (a local Random — never the global
+        one, so test ordering can't perturb it)."""
+        rng = random.Random(seed)
+        faults = []
+        for r in range(rounds):
+            for t in range(num_tasks):
+                for g in range(group_size):
+                    if rng.random() < rate:
+                        faults.append(FaultSpec(
+                            r, t, g, rng.choice(list(kinds)),
+                            times=times, hang_s=hang_s))
+        return cls(faults)
+
+    # -- consumption -------------------------------------------------------
+    def take(self, round_idx: int, task_idx: int,
+             g: int) -> Optional[FaultSpec]:
+        """Consume one attempt of the fault at these coordinates (None if
+        nothing is scheduled or its budget is spent)."""
+        with self._lock:
+            for i, f in enumerate(self.faults):
+                if ((f.round_idx, f.task_idx, f.g)
+                        == (round_idx, task_idx, g)
+                        and self._remaining[i] > 0):
+                    self._remaining[i] -= 1
+                    self.injected.append(
+                        (f.kind, (round_idx, task_idx, g)))
+                    self._injected_total.inc(kind=f.kind)
+                    return f
+        return None
+
+    def take_engine(self) -> Optional[EngineFault]:
+        with self._lock:
+            idx = self._submit_calls
+            self._submit_calls += 1
+            f = self._engine_remaining.pop(idx, None)
+            if f is not None:
+                self.injected.append((f"engine_{f.kind}", (idx,)))
+                self._injected_total.inc(kind=f"engine_{f.kind}")
+            return f
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for kind, _ in self.injected:
+                out[kind] = out.get(kind, 0) + 1
+            return out
+
+    # -- wrappers ----------------------------------------------------------
+    def wrap_factory(self, make_session: Callable) -> Callable:
+        """Session factory that returns plan-aware :class:`ChaosSession`
+        proxies. Keyword-transparent (``**kwargs`` forwards ``rules=`` /
+        ``thread_id=``), so OnlineImprovementLoop's factory-signature
+        inspection still sees a thread_id-capable factory."""
+
+        def factory(*args, **kwargs):
+            return ChaosSession(make_session(*args, **kwargs), self)
+
+        return factory
+
+    def wrap_reward(self, reward_fn: Callable) -> Callable:
+        """Reward override that yields NaN when the episode's session
+        carries an active ``nan_reward`` fault — the injection path for
+        callers that score via ``reward_override`` (which bypasses the
+        trace reward ChaosSession poisons)."""
+
+        def reward(task_idx: int, g: int, session):
+            fault = getattr(session, "chaos_fault", None)
+            if fault is not None and fault.kind == "nan_reward":
+                return float("nan")
+            return reward_fn(task_idx, g, session)
+
+        return reward
+
+    def wrap_engine(self, engine) -> "ChaosEngine":
+        return ChaosEngine(engine, self)
+
+
+class ChaosSession:
+    """Transparent session proxy that fires the plan's episode faults.
+
+    Delegates everything to the wrapped session; only ``bind_episode``
+    (coordinate intake), ``run_turn`` (injection point), and ``close``
+    are intercepted. A ``nan_reward`` fault lets the turn complete and
+    then poisons ``trace.summary.final_reward`` — the default reward
+    path in ``collect_group_trajectories``; callers scoring through a
+    ``reward_override`` should wrap it with ``FaultPlan.wrap_reward``.
+    """
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self._plan = plan
+        self.chaos_fault: Optional[FaultSpec] = None
+
+    def bind_episode(self, round_idx: int, task_idx: int, g: int) -> None:
+        self.chaos_fault = self._plan.take(round_idx, task_idx, g)
+        inner_bind = getattr(self._inner, "bind_episode", None)
+        if inner_bind is not None:
+            inner_bind(round_idx, task_idx, g)
+
+    def run_turn(self, task: str):
+        fault = self.chaos_fault
+        if fault is not None and fault.kind == "raise":
+            raise ChaosError(
+                f"injected raise at (r{fault.round_idx}, "
+                f"t{fault.task_idx}, g{fault.g})")
+        if fault is not None and fault.kind == "hang":
+            time.sleep(fault.hang_s)
+        out = self._inner.run_turn(task)
+        if (fault is not None and fault.kind == "nan_reward"
+                and out.trace is not None):
+            out.trace.summary.final_reward = float("nan")
+        return out
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ChaosEngine:
+    """Engine proxy injecting submit()-indexed faults below the session
+    layer (EnginePolicyClient calls submit/step on this transparently)."""
+
+    def __init__(self, inner, plan: FaultPlan):
+        self._inner = inner
+        self._plan = plan
+
+    def submit(self, *args, **kwargs):
+        fault = self._plan.take_engine()
+        if fault is not None:
+            if fault.kind == "hang":
+                time.sleep(fault.hang_s)
+            else:
+                raise ChaosError(
+                    f"injected engine raise at submit #{fault.call_idx}")
+        return self._inner.submit(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)

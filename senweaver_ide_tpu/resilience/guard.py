@@ -1,0 +1,99 @@
+"""Update guard: veto optimizer steps that would corrupt the policy.
+
+One NaN gradient is enough to zero a run — Adam moments absorb the
+non-finite update and every subsequent step inherits it, silently.
+The guard sits between ``train_step``'s metrics and the decision to
+ADOPT the new state (training/rl_loop.py, trainer.train_step_guarded):
+it never touches device buffers, it just reads the already-synced host
+floats and answers "keep or revert".
+
+Three tripwires, checked in order:
+
+1. non-finite loss (NaN/Inf),
+2. non-finite global grad norm,
+3. loss spike — rolling z-score of the loss against the last
+   ``spike_window`` ACCEPTED losses (rejected losses never enter the
+   history, so one spike can't poison the baseline that judges the
+   next).
+
+Every trip increments ``senweaver_grpo_updates_skipped_total{reason=}``
+and is appended to :attr:`UpdateGuard.skipped` for the round capture.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .faults import ResilienceConfig
+
+REASON_NONFINITE_LOSS = "nonfinite_loss"
+REASON_NONFINITE_GRAD = "nonfinite_grad_norm"
+REASON_LOSS_SPIKE = "loss_spike"
+
+
+class UpdateGuard:
+    """Stateful keep-or-revert decision over per-update metrics.
+
+    One guard instance spans a RUN (the rolling loss history is the
+    whole point) — construct it once per loop, not per round."""
+
+    def __init__(self, *, spike_zscore: float = 6.0,
+                 spike_window: int = 16, spike_min_history: int = 5,
+                 spike_min_std: float = 1e-3, registry=None):
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self.spike_zscore = float(spike_zscore)
+        self.spike_min_history = int(spike_min_history)
+        self.spike_min_std = float(spike_min_std)
+        self._history: collections.deque = collections.deque(
+            maxlen=int(spike_window))
+        self._lock = threading.Lock()
+        self._skipped_total = registry.counter(
+            "senweaver_grpo_updates_skipped_total",
+            "GRPO optimizer steps vetoed by the update guard",
+            labelnames=("reason",))
+        self.skipped: List[Tuple[str, Optional[float]]] = []
+
+    @classmethod
+    def from_config(cls, config: ResilienceConfig,
+                    registry=None) -> Optional["UpdateGuard"]:
+        if not config.guard_updates:
+            return None
+        return cls(spike_zscore=config.spike_zscore,
+                   spike_window=config.spike_window,
+                   spike_min_history=config.spike_min_history,
+                   spike_min_std=config.spike_min_std, registry=registry)
+
+    def check(self, metrics: Dict[str, float]) -> Optional[str]:
+        """Returns a skip reason, or None to accept (and the accepted
+        loss joins the spike baseline)."""
+        loss = metrics.get("loss")
+        grad_norm = metrics.get("grad_norm")
+        reason = None
+        with self._lock:
+            if loss is None or not math.isfinite(loss):
+                reason = REASON_NONFINITE_LOSS
+            elif grad_norm is not None and not math.isfinite(grad_norm):
+                reason = REASON_NONFINITE_GRAD
+            elif len(self._history) >= self.spike_min_history:
+                mean = sum(self._history) / len(self._history)
+                var = sum((x - mean) ** 2 for x in self._history) \
+                    / len(self._history)
+                std = max(math.sqrt(var), self.spike_min_std)
+                if abs(loss - mean) / std > self.spike_zscore:
+                    reason = REASON_LOSS_SPIKE
+            if reason is None:
+                self._history.append(float(loss))
+                return None
+            self.skipped.append((reason, loss))
+        self._skipped_total.inc(reason=reason)
+        return reason
+
+    @property
+    def history(self) -> List[float]:
+        with self._lock:
+            return list(self._history)

@@ -143,7 +143,39 @@ class DashboardService:
                 out["onboarding"] = {"error": str(e)}
         out["training"] = _training_curves(self.metrics_path)
         out["obs"] = self._obs_summary()
+        out["resilience"] = self._resilience_summary()
         return out
+
+    def _resilience_summary(self) -> Dict[str, Any]:
+        """Fault-boundary counter totals (resilience/ + rl_loop): the
+        operator's at-a-glance degradation picture — all zeros on a
+        healthy run. Labeled counters (skip/fail reasons, chaos kinds)
+        sum across their label cells."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        try:
+            return {
+                "episodes_failed":
+                    total("senweaver_grpo_episodes_failed_total"),
+                "episode_retries":
+                    total("senweaver_grpo_episode_retries_total"),
+                "groups_dropped":
+                    total("senweaver_grpo_task_groups_dropped_total"),
+                "rounds_skipped":
+                    total("senweaver_grpo_rounds_skipped_total"),
+                "updates_skipped":
+                    total("senweaver_grpo_updates_skipped_total"),
+                "uploader_retries":
+                    total("senweaver_uploader_retries_total"),
+                "chaos_injected":
+                    total("senweaver_chaos_faults_injected_total"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
 
     def _obs_summary(self) -> Dict[str, Any]:
         """Span counts, top-5 slowest spans, and the live throughput
@@ -337,6 +369,8 @@ input[type=text], input[type=password], textarea {
 <section><h2>Observability</h2>
 <div id="obs" class="tiles"></div>
 <div id="obs-spans"></div></section>
+<section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
+</section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2>
 <div class="actionbar">
@@ -542,6 +576,15 @@ async function refresh() {
   document.getElementById("obs-spans").innerHTML = table(
     (ob_.slowest || []).map(x => [x.name, x.duration_ms]),
     ["slowest span", "ms"]);
+  const res = s.resilience || {};
+  tiles(document.getElementById("resilience"), [
+    ["failed episodes", res.episodes_failed],
+    ["episode retries", res.episode_retries],
+    ["groups dropped", res.groups_dropped],
+    ["rounds skipped", res.rounds_skipped],
+    ["updates skipped", res.updates_skipped],
+    ["uploader retries", res.uploader_retries],
+    ["chaos injected", res.chaos_injected]]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

@@ -90,26 +90,65 @@ class TraceUploader:
 
 
 def http_trace_transport(url: str, *, timeout: float = 10.0,
-                         headers: Optional[Dict[str, str]] = None
-                         ) -> Callable[[List[Dict]], bool]:
+                         headers: Optional[Dict[str, str]] = None,
+                         max_retries: int = 3,
+                         retry_base_s: float = 0.5,
+                         retry_max_s: float = 10.0,
+                         sleep: Callable[[float], None] = None,
+                         rng=None) -> Callable[[List[Dict]], bool]:
     """Real HTTP transport for the uploader: POST the batch as JSON to
     ``url`` (the reference's ``POST /api/traces`` shape,
-    traceCollectorService.ts:797-899). 2xx → True; any error or non-2xx
-    → False (the uploader's retry-next-cycle contract). Stdlib urllib —
-    no SDK dependency for the fleet ingest path."""
+    traceCollectorService.ts:797-899). 2xx → True. Stdlib urllib — no
+    SDK dependency for the fleet ingest path.
+
+    TRANSIENT failures (connection errors, timeouts, 5xx) are retried
+    in-call up to ``max_retries`` times with the agent loop's 1.5x
+    exponential backoff (agents/loop.py ``retry_delay_s`` shape, via
+    resilience.faults) plus 0.5–1.5x jitter — each retry increments
+    ``senweaver_uploader_retries_total``. PERMANENT failures (4xx: the
+    batch itself is rejected; malformed url) fail fast: retrying a
+    client error only hammers the ingest endpoint. Exhausted retries
+    return False — the uploader's own retry-next-cycle contract takes
+    over, with nothing marked uploaded. ``sleep``/``rng`` are
+    injectable for tests."""
+    import random
+    import time as _time
     import urllib.error
     import urllib.request
 
+    from ..obs import get_registry
+    from ..resilience.faults import episode_retry_delay_s
+
+    sleep = sleep or _time.sleep
+    rng = rng or random.Random()
+    retries_total = get_registry().counter(
+        "senweaver_uploader_retries_total",
+        "Transient-error retries inside the HTTP trace transport")
+
     def transport(batch: List[Dict]) -> bool:
         body = json.dumps({"traces": batch}).encode("utf-8")
-        req = urllib.request.Request(
-            url, data=body, method="POST",
-            headers={"Content-Type": "application/json",
-                     **(headers or {})})
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return 200 <= resp.status < 300
-        except (urllib.error.URLError, OSError, ValueError):
-            return False
+        attempt = 0
+        while True:
+            attempt += 1
+            req = urllib.request.Request(
+                url, data=body, method="POST",
+                headers={"Content-Type": "application/json",
+                         **(headers or {})})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return 200 <= resp.status < 300
+            except urllib.error.HTTPError as e:
+                if e.code < 500:
+                    return False        # 4xx: permanent, fail fast
+            except ValueError:
+                return False            # malformed url: permanent
+            except (urllib.error.URLError, OSError):
+                pass                    # transient: refused/timeout/DNS
+            if attempt > max_retries:
+                return False
+            retries_total.inc()
+            delay = episode_retry_delay_s(
+                attempt, base_s=retry_base_s, max_s=retry_max_s)
+            sleep(delay * (0.5 + rng.random()))
 
     return transport

@@ -1,7 +1,7 @@
 from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
                    token_logprobs)
 from .trainer import (TrainState, make_lora_train_state, make_optimizer,
-                      make_train_state, train_step)
+                      make_train_state, train_step, train_step_guarded)
 from .lora import (export_peft_adapter, init_lora, load_peft_adapter,
                    lora_param_count, materialize_lora, merge_lora,
                    split_lora)
@@ -9,6 +9,6 @@ from .checkpoint import CheckpointManager
 from .data import (Trajectory, TrajectoryDataset, make_batch,
                    make_batch_logps)
 from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
-from .rl_loop import (EpisodeRecord, RoundResult,
+from .rl_loop import (CollectResult, EpisodeRecord, RoundResult,
                       collect_group_trajectories, grpo_round)
 from .online import OnlineImprovementLoop, OnlineRoundResult

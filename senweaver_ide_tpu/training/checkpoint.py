@@ -142,9 +142,28 @@ class CheckpointManager:
     # -- gc ----------------------------------------------------------------
     def _gc(self) -> None:
         import shutil
-        steps = sorted(
-            int(m.group(1)) for m in
-            (_STEP_DIR_RE.match(n) for n in os.listdir(self.root)) if m)
-        for s in steps[:-self.keep_last] if self.keep_last else []:
+        if not self.keep_last:
+            return
+        complete: list = []
+        torn: list = []
+        for name in os.listdir(self.root):
+            m = _STEP_DIR_RE.match(name)
+            if not m:
+                continue
+            s = int(m.group(1))
+            (complete if os.path.exists(_meta_path(self.root, s))
+             else torn).append(s)
+        # Torn checkpoints (state written, meta.json never landed — a
+        # crash/preemption mid-save) are garbage, not history: reclaim
+        # them FIRST and never count them toward keep_last, so a torn
+        # dir can't evict a complete checkpoint from the retention
+        # budget. A torn dir NEWER than every complete step could be a
+        # save in progress (async/concurrent saver), so it is spared.
+        newest_complete = max(complete) if complete else None
+        for s in torn:
+            if newest_complete is not None and s <= newest_complete:
+                shutil.rmtree(os.path.join(self.root, f"step_{s}"),
+                              ignore_errors=True)
+        for s in sorted(complete)[:-self.keep_last]:
             shutil.rmtree(os.path.join(self.root, f"step_{s}"),
                           ignore_errors=True)

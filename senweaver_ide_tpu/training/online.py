@@ -31,11 +31,15 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-from typing import Callable, Dict, List, Optional, Sequence
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..apo.eval import outcome_feedback
 from ..apo.service import APOService
 from ..obs import get_tracer
+from ..resilience.faults import ResilienceConfig
+from ..resilience.guard import UpdateGuard
 from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
 from .rl_loop import grpo_round
@@ -50,6 +54,31 @@ _PROC_TAG = uuid.uuid4().hex[:6]
 _LOOP_IDS = itertools.count(1)
 
 
+class _SessionCounter:
+    """Atomic, snapshotable session-id source.
+
+    itertools.count gives the atomicity concurrent session creation
+    needs but can't report its position — which checkpoint/resume does:
+    a resumed loop's thread ids must keep advancing from the persisted
+    cursor, not restart at 1 and collide with the killed process's WAL
+    feedback keys."""
+
+    def __init__(self, start: int = 1):
+        self._lock = threading.Lock()
+        self._next = int(start)
+
+    def __next__(self) -> int:
+        with self._lock:
+            v = self._next
+            self._next += 1
+            return v
+
+    def peek(self) -> int:
+        """The id the NEXT __next__ will hand out (the resume cursor)."""
+        with self._lock:
+            return self._next
+
+
 @dataclasses.dataclass
 class OnlineRoundResult:
     round_idx: int
@@ -59,6 +88,10 @@ class OnlineRoundResult:
     analyzed: bool              # APO analysis ran this round
     beam_ran: bool              # prompt search ran this round
     train_metrics: Dict[str, float]
+    # Resilience surface (defaults when the loop runs unguarded):
+    failed_episodes: int = 0    # episodes quarantined this round
+    update_skipped: Optional[str] = None  # guard veto reason, if any
+    checkpointed: bool = False  # a checkpoint landed after this round
 
 
 class OnlineImprovementLoop:
@@ -80,7 +113,10 @@ class OnlineImprovementLoop:
                  feedback_fn=outcome_feedback,
                  metrics_service=None,
                  anchor_every: int = 0,
-                 analyze_every: Optional[int] = None):
+                 analyze_every: Optional[int] = None,
+                 resilience: Optional[ResilienceConfig] = None,
+                 checkpoint_manager=None,
+                 checkpoint_every: int = 1):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -113,16 +149,28 @@ class OnlineImprovementLoop:
         self._anchor = (state.params
                         if anchor_every > 0 and grpo_config.kl_coef > 0
                         else None)
+        # Resilience: the fault boundary config rides into every
+        # grpo_round; ONE UpdateGuard spans the loop so the loss-spike
+        # baseline accumulates across rounds instead of resetting.
+        self.resilience = resilience
+        self._update_guard = (UpdateGuard.from_config(resilience)
+                              if resilience is not None else None)
+        # Preemption safety: with a CheckpointManager, the loop persists
+        # its full resume surface (train state + round index + session
+        # cursor + optimized rules + KL anchor) every
+        # ``checkpoint_every`` rounds; OnlineImprovementLoop.resume()
+        # restores the exact round.
+        self.checkpoint_manager = checkpoint_manager
+        self.checkpoint_every = checkpoint_every
         self._round = 0
         # Atomic id source: sessions are created from the collection
-        # pool's worker threads (itertools.count.__next__ is atomic in
-        # CPython; a racy += would hand two episodes the same thread_id
-        # and cross-attribute their traces). The loop instance id keeps
-        # thread ids unique ACROSS loops sharing one collector — two
-        # successive 'online' jobs must not collide on
+        # pool's worker threads (a racy += would hand two episodes the
+        # same thread_id and cross-attribute their traces). The loop
+        # instance id keeps thread ids unique ACROSS loops sharing one
+        # collector — two successive 'online' jobs must not collide on
         # f"{thread_id}:{message_idx}" feedback keys.
         self._loop_id = next(_LOOP_IDS)
-        self._session_ids = itertools.count(1)
+        self._session_ids = _SessionCounter(1)
         # Factories that can't take thread_id force serial collection:
         # concurrent sessions sharing the collector's default thread id
         # would read each other's traces.
@@ -202,7 +250,8 @@ class OnlineImprovementLoop:
             ppo_epochs=self.ppo_epochs, max_parallel=self.max_parallel,
             reward_override=reward,
             metrics_service=self.metrics_service, engine=self.engine,
-            ref_params=self._anchor)
+            ref_params=self._anchor, resilience=self.resilience,
+            update_guard=self._update_guard, round_idx=self._round)
         self.state = out.state
         if (self._anchor is not None and self.anchor_every > 0
                 and (self._round + 1) % self.anchor_every == 0):
@@ -234,9 +283,95 @@ class OnlineImprovementLoop:
             rules=rules,
             analyzed=report is not None,
             beam_ran=beam_ran,
-            train_metrics=dict(out.metrics))
+            train_metrics=dict(out.metrics),
+            failed_episodes=len(out.failures),
+            update_skipped=out.update_skipped)
         self._round += 1
+        if (self.checkpoint_manager is not None and self.checkpoint_every
+                and self._round % self.checkpoint_every == 0):
+            with get_tracer().span("online.checkpoint",
+                                   round=self._round):
+                self.checkpoint()
+            result.checkpointed = True
         return result
 
     def run(self, rounds: int) -> List[OnlineRoundResult]:
         return [self.run_round() for _ in range(rounds)]
+
+    # -- preemption-safe persistence ---------------------------------------
+    def checkpoint(self) -> str:
+        """Persist the loop's full resume surface and return the step dir.
+
+        Beyond the train state, deterministic continuation needs the
+        loop-level cursors: the round index (rewards/faults keyed on
+        round coordinates), the session-id cursor (WAL feedback keys
+        must not collide), the ACTIVE optimized rules (a resumed round
+        must render the same system prompt), and the KL anchor params
+        (saved as ``anchor.npz`` beside the state; if a preemption lands
+        between meta.json and anchor.npz, resume() re-anchors at the
+        restored params — a refresh, not a corruption)."""
+        if self.checkpoint_manager is None:
+            raise ValueError("loop was built without a checkpoint_manager")
+        step_dir = self.checkpoint_manager.save(self.state, extra_meta={
+            "online_round": self._round,
+            "online_session_cursor": self._session_ids.peek(),
+            "online_rules": self.current_rules(),
+            "online_anchor": self._anchor is not None,
+        })
+        if self._anchor is not None:
+            import jax
+            import numpy as np
+            leaves = jax.tree_util.tree_leaves(self._anchor)
+            arrays = {f"leaf_{i}": np.asarray(jax.device_get(x))
+                      for i, x in enumerate(leaves)}
+            tmp = os.path.join(step_dir, "anchor.npz.tmp")
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, os.path.join(step_dir, "anchor.npz"))
+        return step_dir
+
+    @classmethod
+    def resume(cls, checkpoint_manager, state_template, model_config,
+               mesh, make_session: Callable[..., "RolloutSession"],
+               tasks: Sequence[str], *, step: Optional[int] = None,
+               **kwargs: Any) -> "OnlineImprovementLoop":
+        """Reconstruct a loop at the exact round a checkpoint captured.
+
+        ``state_template`` is a TrainState with matching structure
+        (shapes/dtypes/optimizer) for CheckpointManager.restore;
+        ``kwargs`` are the remaining constructor arguments (apo,
+        collector, engine, resilience, ...) — pass the same values the
+        killed process used, with the apo service backed by the SAME
+        segment-store path or any path (the persisted rule-set is
+        reinstalled either way). Restores: train state, round index,
+        session-id cursor, optimized rules, and the KL anchor; then
+        republishes the restored params to the engine so serving
+        matches training from the first resumed episode."""
+        state, meta = checkpoint_manager.restore(state_template, step)
+        loop = cls(state, model_config, mesh, make_session, tasks,
+                   checkpoint_manager=checkpoint_manager, **kwargs)
+        loop._round = int(meta.get("online_round", 0))
+        loop._session_ids = _SessionCounter(
+            int(meta.get("online_session_cursor", 1)))
+        rules = meta.get("online_rules")
+        if rules is not None:
+            loop.apo.segments.install_rules(list(rules))
+        if loop._anchor is not None:
+            anchor_path = os.path.join(checkpoint_manager.root,
+                                       f"step_{meta['step']}",
+                                       "anchor.npz")
+            if meta.get("online_anchor") and os.path.exists(anchor_path):
+                import jax
+                import numpy as np
+                leaves, treedef = jax.tree_util.tree_flatten(state.params)
+                with np.load(anchor_path) as data:
+                    restored = [data[f"leaf_{i}"]
+                                for i in range(len(leaves))]
+                loop._anchor = jax.tree_util.tree_unflatten(
+                    treedef, restored)
+            else:
+                loop._anchor = state.params
+        if loop.engine is not None and hasattr(loop.engine,
+                                               "update_params"):
+            loop.engine.update_params(state.params)
+        return loop

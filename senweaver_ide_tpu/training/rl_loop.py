@@ -18,11 +18,16 @@ SAME task.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
 from ..obs import StepTelemetry, get_registry, get_tracer
+from ..resilience.faults import (FailedEpisode, REASON_ERROR,
+                                 REASON_TIMEOUT, ResilienceConfig,
+                                 episode_retry_delay_s)
 from ..rollout.session import RolloutSession
 from .data import (Trajectory, make_batch, make_batch_logps,
                    place_batch_for_mesh)
@@ -44,12 +49,72 @@ class RoundResult:
     metrics: Dict[str, float]
     episodes: List[EpisodeRecord]
     trajectories: List[Trajectory]
+    # Resilience surface (empty/None without a ResilienceConfig):
+    failures: List[FailedEpisode] = dataclasses.field(default_factory=list)
+    dropped_groups: List[int] = dataclasses.field(default_factory=list)
+    update_skipped: Optional[str] = None
+
+
+@dataclasses.dataclass
+class CollectResult:
+    """Collection outcome + fault-boundary bookkeeping.
+
+    Iterates as the historical ``(trajectories, episodes)`` pair so
+    existing ``trajs, eps = collect_group_trajectories(...)`` call sites
+    keep working; resilience-aware callers read the named fields."""
+
+    trajectories: List[Trajectory]
+    episodes: List[EpisodeRecord]
+    failures: List[FailedEpisode] = dataclasses.field(default_factory=list)
+    dropped_groups: List[int] = dataclasses.field(default_factory=list)
+    retries: int = 0
+
+    def __iter__(self):
+        return iter((self.trajectories, self.episodes))
+
+
+class EpisodeTimeout(RuntimeError):
+    """An episode attempt exceeded ResilienceConfig.episode_timeout_s."""
+
+
+def _call_with_timeout(fn, timeout_s: Optional[float]):
+    """Run ``fn()`` bounded by ``timeout_s`` wall seconds. Python can't
+    kill a thread, so a timed-out attempt is ABANDONED on a daemon
+    thread: its session still closes via _run_episode's finally when
+    (if) the attempt eventually returns, but the boundary stops
+    waiting."""
+    if not timeout_s:
+        return fn()
+    box: Dict[str, object] = {}
+
+    def target():
+        try:
+            box["ok"] = fn()
+        except BaseException as e:          # re-raised on the caller
+            box["err"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name="episode-attempt")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise EpisodeTimeout(f"episode exceeded {timeout_s}s")
+    if "err" in box:
+        raise box["err"]                    # type: ignore[misc]
+    return box["ok"]
 
 
 def _run_episode(make_session, task_idx: int, task: str, g: int,
-                 reward_override) -> tuple[List[Trajectory], EpisodeRecord]:
+                 reward_override, round_idx: int = 0
+                 ) -> tuple[List[Trajectory], EpisodeRecord]:
     session = make_session()
     try:
+        # Episode-aware sessions (the chaos harness's ChaosSession, or
+        # any session wanting per-episode attribution) learn their exact
+        # coordinates before the turn runs.
+        bind = getattr(session, "bind_episode", None)
+        if bind is not None:
+            bind(round_idx, task_idx, g)
         client = session.client
         log_start = len(getattr(client, "call_log", []))
         out = session.run_turn(task)
@@ -78,7 +143,10 @@ def collect_group_trajectories(
         reward_override: Optional[Callable[[int, int, RolloutSession],
                                            float]] = None,
         max_parallel: int = 8,
-) -> tuple[List[Trajectory], List[EpisodeRecord]]:
+        resilience: Optional[ResilienceConfig] = None,
+        round_idx: int = 0,
+        retry_sleep: Callable[[float], None] = time.sleep,
+) -> CollectResult:
     """Run group_size episodes per task; one Trajectory per LLM call.
 
     Episodes run CONCURRENTLY (up to ``max_parallel`` host threads — the
@@ -94,7 +162,17 @@ def collect_group_trajectories(
     ``call_log`` slicing requires a client per episode).
     reward_override(task_idx, g, session) can replace the trace reward
     (evaluator-in-the-loop). Results are returned in deterministic
-    (task_idx, g) order regardless of completion order."""
+    (task_idx, g) order regardless of completion order.
+
+    With a ``resilience`` config, each episode runs inside a FAULT
+    BOUNDARY: per-attempt timeout (``episode_timeout_s``), bounded retry
+    with backoff (``episode_retries``), and quarantine — a persistently
+    failing episode becomes a :class:`FailedEpisode` record instead of
+    an exception. Task groups keeping fewer than ``min_group_survivors``
+    episodes are dropped whole (their advantages are degenerate), and a
+    round losing every group returns empty — the caller's empty-batch
+    path skips the update. Without a config the historical raise-on-
+    first-error semantics hold (but in-flight work is drained first)."""
     import concurrent.futures as _fut
 
     # Span context must cross the pool explicitly (contextvars don't):
@@ -102,25 +180,103 @@ def collect_group_trajectories(
     # group nests under the round's "collect" span in the flamegraph.
     tracer = get_tracer()
     parent_ctx = tracer.capture()
+    registry = get_registry()
+    failures: List[FailedEpisode] = []
+    retries_total = [0]
 
     def _episode_job(ti: int, task: str, g: int):
         with tracer.attach(parent_ctx):
             with tracer.span("episode", task_idx=ti, g=g):
                 return _run_episode(make_session, ti, task, g,
-                                    reward_override)
+                                    reward_override, round_idx)
 
+    def _guarded_job(ti: int, task: str, g: int):
+        """The fault boundary: returns (result, None) or (None,
+        FailedEpisode) — never raises."""
+        assert resilience is not None
+        t0 = time.monotonic()
+        last_err: Optional[BaseException] = None
+        attempts = 0
+        while attempts <= resilience.episode_retries:
+            attempts += 1
+            try:
+                out = _call_with_timeout(
+                    lambda: _episode_job(ti, task, g),
+                    resilience.episode_timeout_s)
+                return out, None
+            except Exception as e:
+                last_err = e
+            if attempts <= resilience.episode_retries:
+                retries_total[0] += 1
+                registry.counter(
+                    "senweaver_grpo_episode_retries_total",
+                    "Episode attempts retried by the fault boundary"
+                ).inc()
+                retry_sleep(episode_retry_delay_s(
+                    attempts, base_s=resilience.retry_base_delay_s,
+                    max_s=resilience.retry_max_delay_s))
+        reason = (REASON_TIMEOUT if isinstance(last_err, EpisodeTimeout)
+                  else REASON_ERROR)
+        registry.counter(
+            "senweaver_grpo_episodes_failed_total",
+            "Episodes quarantined after exhausting retries",
+            labelnames=("reason",)).inc(reason=reason)
+        return None, FailedEpisode(
+            task_idx=ti, g=g, round_idx=round_idx, reason=reason,
+            error=repr(last_err), attempts=attempts,
+            elapsed_s=time.monotonic() - t0)
+
+    run_job = _episode_job if resilience is None else _guarded_job
     jobs = [(ti, task, g) for ti, task in enumerate(tasks)
             for g in range(group_size)]
     results: Dict[tuple, tuple] = {}
     if max_parallel <= 1 or len(jobs) <= 1:
         for ti, task, g in jobs:
-            results[(ti, g)] = _episode_job(ti, task, g)
+            results[(ti, g)] = run_job(ti, task, g)
     else:
         with _fut.ThreadPoolExecutor(max_workers=max_parallel) as pool:
-            futs = {pool.submit(_episode_job, ti, task, g): (ti, g)
+            futs = {pool.submit(run_job, ti, task, g): (ti, g)
                     for ti, task, g in jobs}
-            for f in _fut.as_completed(futs):
-                results[futs[f]] = f.result()
+            try:
+                for f in _fut.as_completed(futs):
+                    results[futs[f]] = f.result()
+            except BaseException:
+                # Historical (no-resilience) crash path, fixed: cancel
+                # episodes that haven't started and DRAIN the in-flight
+                # ones before re-raising — their threads must not keep
+                # stepping a shared engine the caller is about to tear
+                # down, and _run_episode's finally closes each session
+                # only when its thread finishes.
+                for other in futs:
+                    other.cancel()
+                _fut.wait(list(futs))
+                raise
+
+    if resilience is not None:
+        for (ti, g), (out, failure) in sorted(results.items()):
+            if failure is not None:
+                failures.append(failure)
+        # Group-survivor threshold: group-relative advantages over 0-1
+        # survivors are degenerate (vacuous or mean-centered to zero),
+        # so a gutted group's trajectories only add noise to the batch.
+        eff_min = min(resilience.min_group_survivors, group_size)
+        dropped_groups: List[int] = []
+        for ti in range(len(tasks)):
+            survivors = [k for k, (out, fl) in results.items()
+                         if k[0] == ti and fl is None]
+            if len(survivors) < eff_min:
+                dropped_groups.append(ti)
+                for k in survivors:
+                    del results[k]
+        if dropped_groups:
+            registry.counter(
+                "senweaver_grpo_task_groups_dropped_total",
+                "Task groups dropped below min_group_survivors"
+            ).inc(len(dropped_groups))
+        results = {k: v[0] for k, v in results.items()
+                   if v[1] is None and k[0] not in dropped_groups}
+    else:
+        dropped_groups = []
 
     trajectories: List[Trajectory] = []
     episodes: List[EpisodeRecord] = []
@@ -128,7 +284,10 @@ def collect_group_trajectories(
         trajs, episode = results[key]
         trajectories.extend(trajs)
         episodes.append(episode)
-    return trajectories, episodes
+    return CollectResult(trajectories=trajectories, episodes=episodes,
+                         failures=failures,
+                         dropped_groups=dropped_groups,
+                         retries=retries_total[0])
 
 
 def grpo_round(state: TrainState, model_config, mesh,
@@ -145,6 +304,9 @@ def grpo_round(state: TrainState, model_config, mesh,
                engine=None,
                lora_base=None,
                ref_params=None,
+               resilience: Optional[ResilienceConfig] = None,
+               update_guard=None,
+               round_idx: int = 0,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -155,11 +317,23 @@ def grpo_round(state: TrainState, model_config, mesh,
     (chatThreadService.ts:1742). ``perf_monitor``
     (services.PerformanceMonitor) threshold-checks each phase;
     ``profile_dir`` wraps the whole round in a ``jax.profiler.trace``
-    capture (TensorBoard-loadable device timelines)."""
+    capture (TensorBoard-loadable device timelines).
+
+    ``resilience`` arms the episode fault boundary in collection (see
+    collect_group_trajectories) and — unless an explicit
+    ``update_guard`` is passed — a fresh UpdateGuard vetoing NaN/Inf
+    updates for this round. Loops spanning many rounds should build ONE
+    resilience.UpdateGuard (UpdateGuard.from_config) and pass it in, so
+    the loss-spike baseline accumulates across rounds. ``round_idx``
+    tags FailedEpisode records and the chaos harness's injection
+    coordinates."""
     import time as _time
 
     if ppo_epochs < 1:
         raise ValueError(f"ppo_epochs must be >= 1, got {ppo_epochs}")
+    if update_guard is None and resilience is not None:
+        from ..resilience.guard import UpdateGuard
+        update_guard = UpdateGuard.from_config(resilience)
 
     from ..services.perf_monitor import profile_capture
     with profile_capture(profile_dir), \
@@ -172,7 +346,8 @@ def grpo_round(state: TrainState, model_config, mesh,
             grpo_config=grpo_config, reward_override=reward_override,
             max_parallel=max_parallel, metrics_service=metrics_service,
             perf_monitor=perf_monitor, engine=engine, lora_base=lora_base,
-            ref_params=ref_params)
+            ref_params=ref_params, resilience=resilience,
+            update_guard=update_guard, round_idx=round_idx)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
@@ -180,25 +355,39 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      reward_override, max_parallel, accum_steps=1,
                      ppo_epochs=1, metrics_service=None,
                      perf_monitor=None, engine=None,
-                     lora_base=None, ref_params=None) -> RoundResult:
+                     lora_base=None, ref_params=None, resilience=None,
+                     update_guard=None, round_idx=0) -> RoundResult:
     import time as _time
     tracer = get_tracer()
     t0 = _time.monotonic()
     with tracer.span("collect", tasks=len(tasks), group_size=group_size):
-        trajectories, episodes = collect_group_trajectories(
+        collected = collect_group_trajectories(
             make_session, tasks, group_size=group_size,
-            reward_override=reward_override, max_parallel=max_parallel)
+            reward_override=reward_override, max_parallel=max_parallel,
+            resilience=resilience, round_idx=round_idx)
+    trajectories, episodes = collected.trajectories, collected.episodes
+    failures = collected.failures
+    dropped_groups = collected.dropped_groups
     collect_s = _time.monotonic() - t0
     if perf_monitor is not None:
         perf_monitor.record_ms("rollout_collect", collect_s * 1000.0,
                                episodes=len(episodes))
     if not trajectories:
+        # Bottom rung of the degradation ladder: nothing survived
+        # collection — keep the state, skip the update, leave a trail.
+        if resilience is not None and (failures or dropped_groups):
+            get_registry().counter(
+                "senweaver_grpo_rounds_skipped_total",
+                "Rounds skipped after losing every task group").inc()
         if metrics_service is not None:
             metrics_service.capture("GRPO Round Empty",
                                     {"tasks": len(tasks),
+                                     "failed_episodes": len(failures),
+                                     "groups_dropped": len(dropped_groups),
                                      "collect_s": round(collect_s, 3)})
         return RoundResult(state=state, metrics={}, episodes=episodes,
-                           trajectories=[])
+                           trajectories=[], failures=failures,
+                           dropped_groups=dropped_groups)
     t_b = _time.monotonic()
     with tracer.span("batch_build", trajectories=len(trajectories)):
         tokens, mask, rewards, group_ids = make_batch(
@@ -250,17 +439,31 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             perf_monitor.record_ms("ref_logp",
                                    (_time.monotonic() - t_r) * 1000.0)
     t1 = _time.monotonic()
+    update_skipped: Optional[str] = None
     with tracer.span("train_step", epochs=ppo_epochs,
                      batch_tokens=int(tokens.size)):
         for _ in range(ppo_epochs):
+            prev_state = state
             state, metrics = train_step(
                 state, model_config, mesh, tokens, mask, rewards,
                 group_ids, old_logp=old, ref_logp=ref,
                 grpo_config=grpo_config, accum_steps=accum_steps,
                 lora_base=lora_base)
-        # float() forces device completion, so the span/timer close on
-        # the finished update, not on async dispatch.
-        out_metrics = {k: float(v) for k, v in metrics.items()}
+            if update_guard is not None:
+                # Guarded adoption: sync the metrics to host floats and
+                # let the guard veto the step BEFORE the new state is
+                # kept — a NaN gradient never reaches the optimizer
+                # moments, and further epochs on a vetoed batch are
+                # pointless.
+                out_metrics = {k: float(v) for k, v in metrics.items()}
+                update_skipped = update_guard.check(out_metrics)
+                if update_skipped is not None:
+                    state = prev_state
+                    break
+        if update_guard is None:
+            # float() forces device completion, so the span/timer close
+            # on the finished update, not on async dispatch.
+            out_metrics = {k: float(v) for k, v in metrics.items()}
     train_s = _time.monotonic() - t1
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step", train_s * 1000.0,
@@ -290,6 +493,10 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             **engine_stats,
             "episodes": len(episodes),
             "trajectories": len(trajectories),
+            "failed_episodes": len(failures),
+            "episode_retries": collected.retries,
+            "groups_dropped": len(dropped_groups),
+            "update_skipped": update_skipped or "",
             "batch_tokens": int(tokens.size),
             "reward_mean": sum(ep_rewards) / len(ep_rewards),
             "reward_min": min(ep_rewards), "reward_max": max(ep_rewards),
@@ -300,4 +507,6 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         })
     return RoundResult(
         state=state, metrics=out_metrics,
-        episodes=episodes, trajectories=trajectories)
+        episodes=episodes, trajectories=trajectories,
+        failures=failures, dropped_groups=dropped_groups,
+        update_skipped=update_skipped)

@@ -307,3 +307,31 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
             with mesh:
                 return _grpo_step(*args, mesh=mesh, lora_base=lora_base)
         return _grpo_step(*args, lora_base=lora_base)
+
+
+def train_step_guarded(state: TrainState, config: ModelConfig,
+                       mesh: Optional[Mesh],
+                       tokens: jax.Array, completion_mask: jax.Array,
+                       rewards: jax.Array, group_ids: jax.Array, *,
+                       guard, **kwargs
+                       ) -> Tuple[TrainState, Dict[str, float],
+                                  Optional[str]]:
+    """``train_step`` behind a resilience.UpdateGuard.
+
+    Runs the update, syncs the metrics to host floats (forcing device
+    completion), and asks ``guard`` whether to ADOPT the new state.
+    Returns ``(state, float_metrics, skip_reason)`` — on a veto the
+    returned state is the INPUT state (params and optimizer moments
+    untouched by the non-finite/spiking update) and ``skip_reason`` is
+    the guard's verdict; otherwise ``skip_reason`` is None. A ``guard``
+    of None degrades to plain train_step with float metrics."""
+    new_state, metrics = train_step(state, config, mesh, tokens,
+                                    completion_mask, rewards, group_ids,
+                                    **kwargs)
+    float_metrics = {k: float(v) for k, v in metrics.items()}
+    if guard is None:
+        return new_state, float_metrics, None
+    reason = guard.check(float_metrics)
+    if reason is not None:
+        return state, float_metrics, reason
+    return new_state, float_metrics, None

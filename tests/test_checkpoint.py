@@ -78,6 +78,44 @@ def test_gc_keeps_last(tmp_path, tiny_state):
     assert mgr.latest_step() == steps[-1]
 
 
+def test_gc_reclaims_torn_dirs_without_evicting_complete(tmp_path,
+                                                        tiny_state):
+    """A torn step dir (state written, meta.json never landed — a crash
+    or preemption mid-save) is reclaimed and never counts against
+    keep_last; a torn dir NEWER than every complete step could be a save
+    in progress, so it is spared."""
+    config, state0 = tiny_state
+    mgr = CheckpointManager(str(tmp_path / "ck4"), keep_last=2,
+                            use_orbax=False)
+    s1 = _advance(config, state0, 1)
+    mgr.save(s1)
+    s2 = _advance(config, s1, 1)
+    mgr.save(s2)
+    root = tmp_path / "ck4"
+    (root / "step_0").mkdir()                      # torn, old
+    (root / "step_0" / "state.npz").write_bytes(b"torn")
+    (root / "step_9").mkdir()                      # torn, newest
+    (root / "step_9" / "state.npz").write_bytes(b"torn")
+    assert mgr.latest_step() == 2                  # torn dirs invisible
+    s3 = _advance(config, s2, 1)
+    mgr.save(s3)                                   # triggers gc
+    names = {p.name for p in root.iterdir()}
+    # both keep_last complete checkpoints retained (the torn dirs did
+    # NOT evict them); old torn dir reclaimed; newest torn dir spared
+    assert names == {"step_2", "step_3", "step_9"}
+    restored, meta = mgr.restore(state0)
+    assert meta["step"] == 3 and int(restored.step) == 3
+
+
+def test_latest_step_ignores_torn_dirs(tmp_path, tiny_state):
+    _, state0 = tiny_state
+    mgr = CheckpointManager(str(tmp_path / "ck5"), use_orbax=False)
+    (tmp_path / "ck5" / "step_7").mkdir()          # no meta.json
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(state0)
+
+
 def test_restore_missing_raises(tmp_path, tiny_state):
     _, state = tiny_state
     mgr = CheckpointManager(str(tmp_path / "empty"), use_orbax=False)

@@ -1,0 +1,536 @@
+"""Fault-tolerant GRPO (resilience/ + the boundaries it arms): episode
+fault boundary in collection, NaN/spike update guards, preemption-safe
+checkpoint/resume on the online loop, and the deterministic chaos
+harness that drives every degraded path end to end."""
+
+import math
+import re
+import types
+
+import jax
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.apo.eval import RuleSensitivePolicy
+from senweaver_ide_tpu.apo.local import make_local_apo
+from senweaver_ide_tpu.apo.types import APOConfig
+from senweaver_ide_tpu.models import get_config
+from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+from senweaver_ide_tpu.resilience import (REASON_ERROR,
+                                          REASON_LOSS_SPIKE,
+                                          REASON_NONFINITE_GRAD,
+                                          REASON_NONFINITE_LOSS,
+                                          REASON_TIMEOUT, ChaosError,
+                                          ChaosSession, EngineFault,
+                                          FaultPlan, FaultSpec,
+                                          ResilienceConfig, UpdateGuard,
+                                          episode_retry_delay_s)
+from senweaver_ide_tpu.rollout.session import RolloutSession
+from senweaver_ide_tpu.traces.collector import TraceCollector
+from senweaver_ide_tpu.training import (CheckpointManager,
+                                        OnlineImprovementLoop, grpo_round,
+                                        make_train_state,
+                                        train_step_guarded)
+from senweaver_ide_tpu.training.rl_loop import collect_group_trajectories
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def tiny_rl():
+    cfg = get_config("tiny-test")
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    return cfg, state
+
+
+# ---- minimal session satisfying _run_episode's contract ----
+
+class _TurnOut:
+    def __init__(self):
+        self.trace = None
+        self.loop = types.SimpleNamespace(steps=1)
+
+
+class _TinySession:
+    def __init__(self, log):
+        self.client = types.SimpleNamespace(call_log=[])
+        self.closed = False
+        self.thread_id = "tiny"
+        log.append(self)
+
+    def run_turn(self, task):
+        self.client.call_log.append(([1, 2, 3], [4, 5]))
+        return _TurnOut()
+
+    def close(self):
+        self.closed = True
+
+
+# ---- fault plan / chaos harness units ----
+
+def test_fault_spec_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="episode fault kind"):
+        FaultSpec(0, 0, 0, "explode")
+    with pytest.raises(ValueError, match="engine fault kind"):
+        EngineFault(0, kind="nan_reward")   # episode-only kind
+
+
+def test_fault_plan_sample_is_deterministic():
+    kw = dict(rounds=3, num_tasks=4, group_size=4, rate=0.5)
+    a = FaultPlan.sample(7, **kw)
+    b = FaultPlan.sample(7, **kw)
+    assert a.faults and a.faults == b.faults
+    assert a.faults != FaultPlan.sample(8, **kw).faults
+
+
+def test_retry_delay_backoff_shape():
+    assert episode_retry_delay_s(1, base_s=0.05, max_s=2.0) == 0.05
+    assert episode_retry_delay_s(2, base_s=0.05,
+                                 max_s=2.0) == pytest.approx(0.075)
+    assert episode_retry_delay_s(50, base_s=0.05, max_s=2.0) == 2.0
+
+
+def test_chaos_session_injects_and_budgets():
+    log = []
+    plan = FaultPlan([FaultSpec(0, 0, 0, "raise", times=1)])
+    s = ChaosSession(_TinySession(log), plan)
+    s.bind_episode(0, 0, 0)
+    with pytest.raises(ChaosError):
+        s.run_turn("t")
+    assert plan.injected_counts() == {"raise": 1}
+    # budget spent: a rebound session (the retry) passes clean
+    s2 = ChaosSession(_TinySession(log), plan)
+    s2.bind_episode(0, 0, 0)
+    assert s2.chaos_fault is None
+    s2.run_turn("t")
+    # other coordinates were never scheduled
+    s3 = ChaosSession(_TinySession(log), plan)
+    s3.bind_episode(1, 0, 0)
+    assert s3.chaos_fault is None
+
+
+def test_chaos_engine_faults_on_submit():
+    class Eng:
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, *a, **k):
+            self.calls += 1
+            return self.calls
+
+    plan = FaultPlan(engine_faults=[EngineFault(1, kind="raise")])
+    eng = plan.wrap_engine(Eng())
+    assert eng.submit([1]) == 1            # call #0 passes
+    with pytest.raises(ChaosError):
+        eng.submit([1])                    # call #1 injected
+    assert eng.submit([1]) == 2            # budget spent
+    assert plan.injected_counts() == {"engine_raise": 1}
+
+
+# ---- update guard units ----
+
+def test_update_guard_vetoes_nonfinite():
+    g = UpdateGuard()
+    assert g.check({"loss": float("nan"),
+                    "grad_norm": 1.0}) == REASON_NONFINITE_LOSS
+    assert g.check({"loss": 1.0,
+                    "grad_norm": float("inf")}) == REASON_NONFINITE_GRAD
+    assert g.check({"loss": 1.0, "grad_norm": 1.0}) is None
+    # rejected losses never entered the baseline
+    assert g.history == [1.0]
+    assert [r for r, _ in g.skipped] == [REASON_NONFINITE_LOSS,
+                                         REASON_NONFINITE_GRAD]
+
+
+def test_update_guard_spike_detection_and_std_floor():
+    g = UpdateGuard(spike_zscore=3.0, spike_min_history=5,
+                    spike_min_std=0.5)
+    for _ in range(5):
+        assert g.check({"loss": 1.0}) is None
+    # constant history: the std floor keeps a small move from tripping
+    assert g.check({"loss": 1.5}) is None
+    # a genuine spike against the floored std is vetoed...
+    assert g.check({"loss": 50.0}) == REASON_LOSS_SPIKE
+    # ...and does NOT poison the baseline that judges the next loss
+    assert len(g.history) == 6
+    assert g.check({"loss": 1.2}) is None
+
+
+def test_update_guard_needs_min_history():
+    g = UpdateGuard(spike_zscore=1.0, spike_min_history=5,
+                    spike_min_std=1e-3)
+    for loss in (1.0, 1.0, 1.0, 100.0):    # 4 accepted: below min history
+        assert g.check({"loss": loss}) is None
+
+
+def test_update_guard_from_config():
+    assert UpdateGuard.from_config(
+        ResilienceConfig(guard_updates=False)) is None
+    g = UpdateGuard.from_config(ResilienceConfig(spike_zscore=4.5))
+    assert isinstance(g, UpdateGuard) and g.spike_zscore == 4.5
+
+
+def test_train_step_guarded_reverts_on_nonfinite(tiny_rl):
+    import jax.numpy as jnp
+    cfg, state = tiny_rl
+    tokens = jnp.ones((2, 16), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.bool_)
+    gids = jnp.zeros((2,), jnp.int32)
+    guard = UpdateGuard()
+    new_state, metrics, reason = train_step_guarded(
+        state, cfg, None, tokens, mask,
+        jnp.asarray([float("nan"), 1.0]), gids, guard=guard)
+    assert reason == REASON_NONFINITE_LOSS
+    assert new_state is state              # step NOT adopted
+    assert math.isnan(metrics["loss"])
+    # without a guard it degrades to a plain train_step
+    new_state, metrics, reason = train_step_guarded(
+        state, cfg, None, tokens, mask, jnp.asarray([1.0, -1.0]), gids,
+        guard=None)
+    assert reason is None
+    assert int(new_state.step) == int(state.step) + 1
+
+
+# ---- episode fault boundary in collect_group_trajectories ----
+
+def test_collect_quarantines_and_drops_group():
+    log = []
+    plan = FaultPlan([FaultSpec(0, 0, 0, "raise", times=2)])
+    res = ResilienceConfig(episode_retries=1, retry_base_delay_s=0.0,
+                           min_group_survivors=2)
+    out = collect_group_trajectories(
+        plan.wrap_factory(lambda: _TinySession(log)), ["a", "b"],
+        group_size=2, resilience=res, max_parallel=1,
+        retry_sleep=lambda s: None)
+    assert len(out.failures) == 1
+    f = out.failures[0]
+    assert (f.task_idx, f.g, f.round_idx) == (0, 0, 0)
+    assert f.reason == REASON_ERROR and f.attempts == 2
+    assert "ChaosError" in f.error
+    assert out.retries == 1
+    # task 0 kept only one survivor < min_group_survivors → group dropped
+    assert out.dropped_groups == [0]
+    assert [e.task_idx for e in out.episodes] == [1, 1]
+    assert all(t.group_id == 1 for t in out.trajectories)
+    # every session opened (including the quarantined attempts) closed
+    assert log and all(s.closed for s in log)
+
+
+def test_collect_retry_then_succeed():
+    log = []
+    plan = FaultPlan([FaultSpec(0, 0, 1, "raise", times=1)])
+    res = ResilienceConfig(episode_retries=1, retry_base_delay_s=0.0)
+    slept = []
+    out = collect_group_trajectories(
+        plan.wrap_factory(lambda: _TinySession(log)), ["a"],
+        group_size=2, resilience=res, max_parallel=1,
+        retry_sleep=slept.append)
+    assert out.failures == [] and out.dropped_groups == []
+    assert out.retries == 1 and len(slept) == 1
+    assert len(out.episodes) == 2
+    reg = obs.get_registry()
+    assert reg.counter(
+        "senweaver_grpo_episode_retries_total").value() == 1
+
+
+def test_collect_hang_times_out_to_quarantine():
+    log = []
+    plan = FaultPlan([FaultSpec(0, 0, 0, "hang", times=2, hang_s=1.0)])
+    res = ResilienceConfig(episode_timeout_s=0.15, episode_retries=1,
+                           retry_base_delay_s=0.0, min_group_survivors=2)
+    out = collect_group_trajectories(
+        plan.wrap_factory(lambda: _TinySession(log)), ["a"],
+        group_size=2, resilience=res, max_parallel=1,
+        retry_sleep=lambda s: None)
+    assert len(out.failures) == 1
+    assert out.failures[0].reason == REASON_TIMEOUT
+    assert out.failures[0].attempts == 2
+    assert out.retries == 1
+    assert out.dropped_groups == [0]
+    reg = obs.get_registry()
+    assert reg.counter("senweaver_grpo_episodes_failed_total",
+                       labelnames=("reason",)).value(
+                           reason=REASON_TIMEOUT) == 1
+
+
+def test_collect_min_survivors_capped_at_group_size():
+    """group_size=1 smoke runs survive a min_group_survivors=2 default —
+    the threshold is capped at the group size."""
+    log = []
+    res = ResilienceConfig(min_group_survivors=2)
+    out = collect_group_trajectories(
+        lambda: _TinySession(log), ["a", "b"], group_size=1,
+        resilience=res, max_parallel=1, retry_sleep=lambda s: None)
+    assert out.dropped_groups == [] and len(out.episodes) == 2
+
+
+# ---- degraded rounds through grpo_round ----
+
+def test_grpo_round_skips_round_when_all_groups_lost(tiny_rl):
+    cfg, state = tiny_rl
+    log, captured = [], []
+    plan = FaultPlan([FaultSpec(0, 0, 0, "raise"),
+                      FaultSpec(0, 0, 1, "raise")])
+    res = ResilienceConfig(episode_retries=0, min_group_survivors=2)
+    svc = types.SimpleNamespace(
+        capture=lambda ev, props: captured.append((ev, props)))
+    out = grpo_round(state, cfg, None,
+                     plan.wrap_factory(lambda: _TinySession(log)),
+                     ["only"], group_size=2, max_parallel=1,
+                     resilience=res, metrics_service=svc)
+    assert out.state is state              # bottom rung: state untouched
+    assert out.metrics == {} and out.trajectories == []
+    assert len(out.failures) == 2 and out.dropped_groups == [0]
+    assert captured and captured[0][0] == "GRPO Round Empty"
+    assert captured[0][1]["failed_episodes"] == 2
+    assert captured[0][1]["groups_dropped"] == 1
+    reg = obs.get_registry()
+    assert reg.counter(
+        "senweaver_grpo_rounds_skipped_total").value() == 1
+
+
+def test_nan_reward_vetoes_update_via_grpo_round(tiny_rl):
+    cfg, state = tiny_rl
+    log = []
+    plan = FaultPlan([FaultSpec(0, 0, 0, "nan_reward")])
+    res = ResilienceConfig(episode_retries=0)
+
+    def reward(ti, g, session):
+        return 1.0 if g % 2 == 0 else -1.0
+
+    out = grpo_round(state, cfg, None,
+                     plan.wrap_factory(lambda: _TinySession(log)), ["t"],
+                     group_size=2, max_len=256, max_parallel=1,
+                     resilience=res,
+                     reward_override=plan.wrap_reward(reward))
+    assert out.update_skipped == REASON_NONFINITE_LOSS
+    assert out.state is state              # poisoned step never adopted
+    assert math.isnan(out.metrics["loss"])
+    assert out.failures == []              # the episode itself succeeded
+    assert plan.injected_counts() == {"nan_reward": 1}
+    reg = obs.get_registry()
+    assert reg.counter("senweaver_grpo_updates_skipped_total",
+                       labelnames=("reason",)).value(
+                           reason=REASON_NONFINITE_LOSS) == 1
+
+
+# ---- online loop: chaos acceptance + preemption-safe resume ----
+
+def _build_stack(tmp_path, tag):
+    """A fresh 'process': own collector, scripted client, APO service
+    (gates pinned shut so determinism reduces to rewards + GRPO math),
+    and recording session factory."""
+    collector = TraceCollector()
+    client = RuleSensitivePolicy()
+    tok = ByteTokenizer()
+    n = [0]
+
+    class Recording:
+        def __init__(self, inner):
+            self.inner = inner
+            self.call_log = []
+
+        def chat(self, messages, **kw):
+            r = self.inner.chat(messages, **kw)
+            self.call_log.append(
+                (tok.encode("\n".join(m.content for m in messages))[-96:],
+                 tok.encode(r.text)[:48]))
+            return r
+
+    def make_session(rules=None, thread_id=None):
+        n[0] += 1
+        s = RolloutSession(client, str(tmp_path / f"{tag}-ws{n[0]}"),
+                           apo_rules=list(rules or []),
+                           thread_id=thread_id or f"{tag}-t{n[0]}",
+                           collector=collector,
+                           include_tool_definitions=False,
+                           loop_sleep=lambda _s: None)
+        s.workspace.write_file("app.py", "x = 1\n")
+        s.client = Recording(client)
+        s.loop.client = s.client
+        return s
+
+    apo = make_local_apo(
+        collector, client,
+        config=APOConfig(min_traces_for_analysis=10**9,
+                         min_feedbacks_for_analysis=10**9))
+    return collector, apo, make_session
+
+
+def _round_reward(ti, g, session):
+    """Deterministic in (round, task, g): the round index comes from the
+    loop's thread-id scheme, so a resumed loop reproduces a round's
+    rewards iff it restored the round cursor correctly."""
+    m = re.search(r"-r(\d+)-", session.thread_id)
+    rnd = int(m.group(1)) if m else 0
+    return 0.1 * rnd + 0.5 * ti - 0.25 * g + 0.125
+
+
+def test_chaos_rounds_complete_and_resume_reproduces(tmp_path):
+    """The acceptance scenario: one raising episode, one hanging episode,
+    and one NaN loss across a 3-round run — all 3 rounds complete, only
+    the poisoned update is skipped, and a post-kill resume() reproduces
+    the remaining round's reward mean bit-for-bit."""
+    cfg = get_config("tiny-test")
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep_last=3,
+                            use_orbax=False)
+    res = ResilienceConfig(episode_timeout_s=0.5, episode_retries=1,
+                           retry_base_delay_s=0.01,
+                           retry_max_delay_s=0.02,
+                           min_group_survivors=2)
+    faults = [FaultSpec(0, 0, 0, "raise", times=2),   # quarantined
+              FaultSpec(0, 1, 0, "hang", times=1, hang_s=3.0),  # retried
+              FaultSpec(1, 0, 1, "nan_reward", times=1)]        # vetoed
+    tasks = ["alpha", "beta"]
+
+    collector1, apo1, make_session1 = _build_stack(tmp_path, "p1")
+    plan1 = FaultPlan(faults)
+    loop1 = OnlineImprovementLoop(
+        state, cfg, None, plan1.wrap_factory(make_session1), tasks,
+        apo=apo1, collector=collector1, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=plan1.wrap_reward(_round_reward),
+        resilience=res, checkpoint_manager=mgr, checkpoint_every=1)
+
+    r01 = loop1.run(2)                     # no exception escapes
+    assert [r.round_idx for r in r01] == [0, 1]
+    # round 0: (0,0,0) raised twice → quarantined, its group dropped;
+    # (0,1,0) hung past the timeout once, then the retry succeeded
+    assert r01[0].failed_episodes == 1
+    assert r01[0].episodes == 2            # only task 1's group survived
+    assert r01[0].update_skipped is None
+    assert r01[0].reward_mean == pytest.approx(0.5)
+    assert int(loop1.state.step) == 1
+    # round 1: the NaN reward propagated into a NaN loss; exactly that
+    # update was vetoed — params and step untouched
+    assert r01[1].update_skipped == REASON_NONFINITE_LOSS
+    assert r01[1].episodes == 4
+    assert math.isnan(r01[1].reward_mean)
+    assert int(loop1.state.step) == 1
+    assert plan1.injected_counts() == {"raise": 2, "hang": 1,
+                                       "nan_reward": 1}
+    assert r01[0].checkpointed and r01[1].checkpointed
+
+    ckpt_step = mgr.latest_step()          # post-round-1 checkpoint
+    assert ckpt_step == 1
+    state_at_ckpt = loop1.state
+
+    r2 = loop1.run(1)[0]                   # round 2: clean
+    assert r2.round_idx == 2
+    assert r2.update_skipped is None and r2.failed_episodes == 0
+    assert r2.episodes == 4
+    assert int(loop1.state.step) == 2
+    assert r2.reward_mean == pytest.approx(0.45)
+
+    # -- preemption: fresh-process posture (new collector/apo/sessions/
+    # plan), resume from the post-round-1 checkpoint, re-run round 2 --
+    collector2, apo2, make_session2 = _build_stack(tmp_path, "p2")
+    plan2 = FaultPlan(faults)              # same schedule, fresh budgets
+    template = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                                learning_rate=1e-3)
+    loop2 = OnlineImprovementLoop.resume(
+        mgr, template, cfg, None, plan2.wrap_factory(make_session2),
+        tasks, step=ckpt_step,
+        apo=apo2, collector=collector2, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=plan2.wrap_reward(_round_reward),
+        resilience=res, checkpoint_every=1)
+    assert loop2._round == 2               # resumes AT the killed round
+    assert int(loop2.state.step) == 1
+    for a, b in zip(jax.tree_util.tree_leaves(loop2.state.params),
+                    jax.tree_util.tree_leaves(state_at_ckpt.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    r2b = loop2.run(1)[0]
+    assert r2b.round_idx == 2
+    assert r2b.reward_mean == r2.reward_mean    # bit-for-bit
+    assert r2b.episodes == r2.episodes
+    assert int(loop2.state.step) == 2
+    # round 2 sits past every scheduled fault: the fresh plan stays idle
+    assert plan2.injected_counts() == {}
+
+
+def test_resume_restores_rules_and_session_cursor(tmp_path):
+    cfg = get_config("tiny-test")
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    mgr = CheckpointManager(str(tmp_path / "ckr"), use_orbax=False)
+    rules = ["verify the diff with tests first"]
+    collector1, apo1, make_session1 = _build_stack(tmp_path, "q1")
+    apo1.segments.install_rules(list(rules))
+    loop1 = OnlineImprovementLoop(
+        state, cfg, None, make_session1, ["t"],
+        apo=apo1, collector=collector1, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0,
+        checkpoint_manager=mgr, checkpoint_every=1)
+    r0 = loop1.run(1)[0]
+    assert r0.checkpointed and r0.rules == rules
+    cursor1 = loop1._session_ids.peek()
+    assert cursor1 == 3                    # two sessions handed out
+
+    collector2, apo2, make_session2 = _build_stack(tmp_path, "q2")
+    assert apo2.get_optimized_rules() == []    # fresh store knows nothing
+    template = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                                learning_rate=1e-3)
+    loop2 = OnlineImprovementLoop.resume(
+        mgr, template, cfg, None, make_session2, ["t"],
+        apo=apo2, collector=collector2, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0)
+    assert loop2._round == 1
+    assert loop2.current_rules() == rules      # reinstalled from meta
+    # the WAL feedback-key cursor continues, never restarts at 1
+    assert loop2._session_ids.peek() == cursor1
+    assert int(loop2.state.step) == 1
+
+
+def test_resume_restores_kl_anchor(tmp_path):
+    from senweaver_ide_tpu.training.grpo import GRPOConfig
+    cfg = get_config("tiny-test")
+    state = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                             learning_rate=1e-3)
+    init_leaves = [np.asarray(jax.device_get(x))
+                   for x in jax.tree_util.tree_leaves(state.params)]
+    mgr = CheckpointManager(str(tmp_path / "cka"), use_orbax=False)
+    collector1, apo1, make_session1 = _build_stack(tmp_path, "a1")
+    anchored = dict(grpo_config=GRPOConfig(kl_coef=0.05),
+                    anchor_every=10**6)    # anchor == init, never refreshed
+    loop1 = OnlineImprovementLoop(
+        state, cfg, None, make_session1, ["t"],
+        apo=apo1, collector=collector1, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0,
+        checkpoint_manager=mgr, checkpoint_every=1, **anchored)
+    loop1.run(1)
+    import os
+    assert os.path.exists(os.path.join(mgr.root, "step_1", "anchor.npz"))
+
+    collector2, apo2, make_session2 = _build_stack(tmp_path, "a2")
+    template = make_train_state(cfg, jax.random.PRNGKey(0), None,
+                                learning_rate=1e-3)
+    loop2 = OnlineImprovementLoop.resume(
+        mgr, template, cfg, None, make_session2, ["t"],
+        apo=apo2, collector=collector2, group_size=2, max_len=1024,
+        max_parallel=1,
+        reward_override=lambda ti, g, s: 1.0 if g % 2 == 0 else -1.0,
+        **anchored)
+    # the anchor came back from anchor.npz — the INIT params, not the
+    # (stepped) restored state the constructor would default it to
+    anchor_leaves = [np.asarray(x)
+                     for x in jax.tree_util.tree_leaves(loop2._anchor)]
+    for a, b in zip(anchor_leaves, init_leaves):
+        np.testing.assert_array_equal(a, b)
+    stepped = [np.asarray(jax.device_get(x))
+               for x in jax.tree_util.tree_leaves(loop2.state.params)]
+    assert any(not np.array_equal(a, s)
+               for a, s in zip(anchor_leaves, stepped))

@@ -235,6 +235,52 @@ def test_grpo_round_captures_engine_stats(tmp_path, tiny_stack):
     assert done[0]["engine_prefill_tokens"] > 0
 
 
+def test_collect_crash_drains_inflight_sessions():
+    """Without a resilience config the historical semantics hold — the
+    first episode error raises out of collection — but only AFTER every
+    in-flight episode finished and closed its session: leaked worker
+    threads must not keep stepping an engine the caller tears down."""
+    import threading
+    import time
+    import types
+
+    from senweaver_ide_tpu.training.rl_loop import \
+        collect_group_trajectories
+
+    made = []
+    lock = threading.Lock()
+    fail_next = [True]
+
+    class _Session:
+        def __init__(self, fail):
+            self.client = types.SimpleNamespace(call_log=[])
+            self.closed = False
+            self.fail = fail
+            made.append(self)
+
+        def run_turn(self, task):
+            if self.fail:
+                raise RuntimeError("boom")
+            time.sleep(0.2)
+            self.client.call_log.append(([1, 2], [3]))
+            return types.SimpleNamespace(
+                trace=None, loop=types.SimpleNamespace(steps=1))
+
+        def close(self):
+            self.closed = True
+
+    def make_session():
+        with lock:
+            fail = fail_next[0]
+            fail_next[0] = False
+        return _Session(fail)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        collect_group_trajectories(make_session, ["a", "b"],
+                                   group_size=2, max_parallel=4)
+    assert made and all(s.closed for s in made)
+
+
 def test_train_step_uses_state_optimizer():
     """Regression (r3): train_step must apply updates with the SAME
     transformation whose .init built state.opt_state. The r2 code fell

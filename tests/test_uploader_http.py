@@ -21,8 +21,11 @@ from senweaver_ide_tpu.traces.uploader import (TraceUploader,
 class _TracesHandler(http.server.BaseHTTPRequestHandler):
     received = []          # class-level: one server per fixture
     fail_next = 0
+    fail_code = 500
+    requests = 0
 
     def do_POST(self):
+        _TracesHandler.requests += 1
         body = self.rfile.read(int(self.headers["Content-Length"]))
         if self.path != "/api/traces":
             self.send_response(404)
@@ -30,7 +33,7 @@ class _TracesHandler(http.server.BaseHTTPRequestHandler):
             return
         if _TracesHandler.fail_next > 0:
             _TracesHandler.fail_next -= 1
-            self.send_response(500)
+            self.send_response(_TracesHandler.fail_code)
             self.end_headers()
             return
         payload = json.loads(body)
@@ -48,6 +51,8 @@ class _TracesHandler(http.server.BaseHTTPRequestHandler):
 def traces_server():
     _TracesHandler.received = []
     _TracesHandler.fail_next = 0
+    _TracesHandler.fail_code = 500
+    _TracesHandler.requests = 0
     srv = http.server.HTTPServer(("127.0.0.1", 0), _TracesHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
@@ -92,21 +97,64 @@ def test_upload_survives_restart_without_resend(traces_server, tmp_path):
     assert len(_TracesHandler.received) == 1
 
 
-def test_server_error_marks_nothing_then_retries(traces_server, tmp_path):
+def test_transient_5xx_retried_in_call(traces_server, tmp_path):
+    """A 5xx is transient: the transport retries in-call with backoff
+    and the batch lands without waiting for the next upload cycle."""
     traces = _ended_traces(2)
-    up = TraceUploader(http_trace_transport(traces_server),
-                       uploaded_ids_path=str(tmp_path / "ids.json"))
+    sleeps = []
+    up = TraceUploader(
+        http_trace_transport(traces_server, sleep=sleeps.append),
+        uploaded_ids_path=str(tmp_path / "ids.json"))
     _TracesHandler.fail_next = 1
-    assert up.upload(traces) == 0          # 500 → failed batch, no marks
-    assert up.upload(traces) == 2          # next cycle succeeds
+    assert up.upload(traces) == 2          # 500 → in-call retry → 200
+    assert _TracesHandler.requests == 2
+    assert len(_TracesHandler.received) == 1
+    # one backoff slept: base 0.5s scaled by the 0.5–1.5x jitter
+    assert len(sleeps) == 1
+    assert 0.25 <= sleeps[0] <= 0.75
+
+
+def test_exhausted_retries_defer_to_next_cycle(traces_server, tmp_path):
+    traces = _ended_traces(2)
+    up = TraceUploader(
+        http_trace_transport(traces_server, max_retries=1,
+                             sleep=lambda s: None),
+        uploaded_ids_path=str(tmp_path / "ids.json"))
+    _TracesHandler.fail_next = 3
+    assert up.upload(traces) == 0          # 2 attempts, both 500 → give up
+    assert _TracesHandler.requests == 2
+    # nothing was marked: the next cycle re-sends (one more 500, then 200)
+    assert up.upload(traces) == 2
+    assert _TracesHandler.requests == 4
+    assert len(_TracesHandler.received) == 1
+
+
+def test_4xx_fails_fast_without_retry(traces_server, tmp_path):
+    """Client errors are permanent — the batch itself is rejected, so
+    retrying would only hammer the ingest endpoint."""
+    traces = _ended_traces(1)
+    sleeps = []
+    up = TraceUploader(
+        http_trace_transport(traces_server, sleep=sleeps.append),
+        uploaded_ids_path=str(tmp_path / "ids.json"))
+    _TracesHandler.fail_next = 1
+    _TracesHandler.fail_code = 422
+    assert up.upload(traces) == 0
+    assert _TracesHandler.requests == 1    # exactly one attempt
+    assert sleeps == []
+    # the uploader contract still holds: nothing marked, next cycle works
+    assert up.upload(traces) == 1
     assert len(_TracesHandler.received) == 1
 
 
 def test_unreachable_peer_is_a_clean_false(tmp_path):
     traces = _ended_traces(1)
+    sleeps = []
     up = TraceUploader(
-        http_trace_transport("http://127.0.0.1:9/api/traces"),  # closed
+        http_trace_transport("http://127.0.0.1:9/api/traces",  # closed
+                             max_retries=1, sleep=sleeps.append),
         uploaded_ids_path=str(tmp_path / "ids.json"))
     t0 = time.monotonic()
     assert up.upload(traces) == 0
+    assert len(sleeps) == 1                # transient → retried once
     assert time.monotonic() - t0 < 10      # fails fast, no hang
