@@ -1,6 +1,6 @@
 from .checkpoints import (CheckpointEntry, ConversationCheckpoints,
                           FileSnapshotter)
-from .engine import RolloutEngine
+from .engine import QueueFull, RolloutEngine
 from .policy_client import EnginePolicyClient, render_chat_template
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill_chunked,

@@ -40,6 +40,14 @@ from ..ops.sampling import sample_token, sampled_logprob
 from .sampler import SampleParams
 
 
+class QueueFull(RuntimeError):
+    """submit() refused: the engine's bounded queue is at ``max_queue``.
+
+    Raised instead of silently growing the backlog so an admission layer
+    (serve/admission.py) can shed load explicitly; the unbounded default
+    (``max_queue=None``) keeps the legacy enqueue-anything behavior."""
+
+
 def _bucket(n: int, minimum: int = 16) -> int:
     b = minimum
     while b < n:
@@ -238,7 +246,8 @@ class RolloutEngine:
                  num_slots: int = 8, max_len: int = 2048,
                  sample: SampleParams = SampleParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
-                 mesh=None, max_prefixes: int = 8):
+                 mesh=None, max_prefixes: int = 8,
+                 max_queue: Optional[int] = None):
         self.config = config
         self.num_slots = num_slots
         # Sliding-window configs serve from a ring cache: the pool holds
@@ -307,9 +316,14 @@ class RolloutEngine:
                        "batched_prefills": 0, "batched_prefill_slots": 0,
                        "prefix_installs": 0, "prefix_tokens_reused": 0,
                        "prefix_evictions": 0,
+                       "prefix_cache_hits": 0, "prefix_cache_misses": 0,
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
                        "hold_evictions": 0}
+        # Bounded admission (None = legacy unbounded): submit() raises
+        # QueueFull past this many QUEUED requests — in-flight slots and
+        # continuations (which bypass the queue) don't count.
+        self.max_queue = max_queue
         self._queue: Deque[_Request] = deque()
         self._requests: Dict[int, _Request] = {}
         self._next_rid = 0
@@ -399,6 +413,11 @@ class RolloutEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} ≥ engine max_len bound "
                 f"{self.context_bound}")
+        if (self.max_queue is not None
+                and len(self._queue) >= self.max_queue):
+            raise QueueFull(
+                f"engine queue at max_queue={self.max_queue} "
+                f"({len(self._queue)} queued)")
         if prefix_id is not None:
             if prefix_id not in self._prefixes:
                 raise KeyError(f"unknown prefix_id {prefix_id}")
@@ -494,7 +513,16 @@ class RolloutEngine:
         with self._lock:
             out = dict(self._stats)
             out["weight_quant"] = int(is_quantized(self.params))
+            out["queue_depth"] = len(self._queue)
+            out["slots_active"] = sum(r is not None
+                                      for r in self._slot_req)
             return out
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet scheduled into a slot."""
+        with self._lock:
+            return len(self._queue)
 
     def result(self, rid: int) -> List[int]:
         with self._lock:
@@ -736,6 +764,7 @@ class RolloutEngine:
                 # budget evicts). Fall back to a full prefill — raising
                 # here would corrupt an unrelated caller's step().
                 req.prefix_id = None
+                self._stats["prefix_cache_misses"] += 1
             if req.prefix_id is not None or (
                     len(req.prompt) >= self.max_len and self._ring):
                 self._queue.popleft()
@@ -780,6 +809,7 @@ class RolloutEngine:
             slot_arr = jnp.asarray(slot, jnp.int32)
             self.cache = _install_prefix(self.cache, p_cache, slot_arr)
             self._stats["prefix_installs"] += 1
+            self._stats["prefix_cache_hits"] += 1
             self._stats["prefix_tokens_reused"] += len(p_tokens)
             suffix = req.prompt[len(p_tokens):]
             # prefill_tokens = tokens actually COMPUTED (the prefix

@@ -1,0 +1,28 @@
+"""serve/ — multi-replica rollout serving fleet.
+
+Composes N :class:`~senweaver_ide_tpu.rollout.engine.RolloutEngine`
+instances behind one facade with admission control (priority classes,
+rate limits, deadlines, typed :class:`Rejected` sheds), SLO-aware
+routing (prefix affinity + least outstanding work + retry-on-death), and
+versioned rolling weight publication. See ``docs/serving.md``.
+"""
+
+from .admission import (AdmissionConfig, AdmissionQueue, ClassPolicy,
+                        FleetRequest, INTERACTIVE, PRIORITY_CLASSES,
+                        REJECT_DEADLINE, REJECT_NO_REPLICAS,
+                        REJECT_QUEUE_FULL, REJECT_RATE_LIMITED,
+                        REJECT_REPLICA_FAILURE, Rejected,
+                        RequestRejected, TRAIN_ROLLOUT, TokenBucket)
+from .frontend import Completed, ServingFleet
+from .replica import (DEAD, DRAINING, EngineReplica, LIVE, ReplicaDead)
+from .router import Router
+from .weights import WeightPublisher
+
+__all__ = [
+    "AdmissionConfig", "AdmissionQueue", "ClassPolicy", "Completed",
+    "DEAD", "DRAINING", "EngineReplica", "FleetRequest", "INTERACTIVE",
+    "LIVE", "PRIORITY_CLASSES", "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
+    "REJECT_QUEUE_FULL", "REJECT_RATE_LIMITED", "REJECT_REPLICA_FAILURE",
+    "Rejected", "ReplicaDead", "RequestRejected", "Router",
+    "ServingFleet", "TRAIN_ROLLOUT", "TokenBucket", "WeightPublisher",
+]

@@ -1,0 +1,617 @@
+"""ServingFleet — N rollout engines behind one submit/result/stream API.
+
+The facade composes the other serve/ pieces into a drop-in SUPERSET of
+the single-engine surface (``submit / step / is_done / result /
+result_logps / register_prefix / release_slot / update_params / stats /
+context_bound``), which is exactly what ``EnginePolicyClient`` and
+``OnlineImprovementLoop`` program against — point them at a fleet and
+they scale from one engine to N without code changes. On top of that it
+adds what only a fleet can have: priority classes and deadlines at
+submit, typed :class:`Rejected` outcomes, replica failover, and rolling
+weight publication.
+
+Request lifecycle::
+
+    submit() ── admission (bound/rate/deadline) ──┐
+        │                                         ├─ Rejected (typed)
+        ▼                                         │
+    class queue ── pump(): router.pick ───────────┘
+        │              │
+        │              ▼
+        │         replica.submit → decode steps → Completed
+        │              │ (replica dies)
+        └──── requeue with backoff (resilience shape) ── retries spent ──▶
+                                                          Rejected
+
+Drive it either way:
+
+- **manually**: ``step()`` (one pump: publish-roll advance, deadline
+  sweep, dispatch, one decode step per replica) — deterministic, what
+  the tests and single-threaded callers use; ``run()`` pumps until idle.
+- **threaded**: ``start()`` gives every replica its stepper thread and
+  the fleet a dispatcher thread — N engines decode concurrently.
+
+Time is an injectable ``clock`` (monotonic seconds) so admission and
+retry backoff run on a fake clock in tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+from ..resilience.faults import REASON_ERROR, episode_retry_delay_s
+from .admission import (AdmissionConfig, AdmissionQueue, FleetRequest,
+                        REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
+                        Rejected, RequestRejected, TRAIN_ROLLOUT)
+from .replica import DEAD, EngineReplica
+from .router import Router
+from .weights import WeightPublisher
+
+
+@dataclasses.dataclass(frozen=True)
+class Completed:
+    """Terminal success outcome for one fleet request."""
+
+    ticket: int
+    priority: str
+    tokens: List[int]
+    logps: List[float]
+    replica_id: str
+    weight_version: int             # replica version when dispatched
+    weight_version_at_finish: int   # and when it finished (must match —
+                                    # the no-mixed-versions invariant)
+    attempts: int
+    ttft_ms: Optional[float]
+    e2e_ms: float
+
+
+class ServingFleet:
+    """N EngineReplicas + admission + router + publisher, one facade."""
+
+    def __init__(self, engines: Sequence[Any], *,
+                 admission: AdmissionConfig = AdmissionConfig(),
+                 clock=time.monotonic,
+                 registry=None,
+                 max_retries: int = 2,
+                 retry_base_delay_s: float = 0.05,
+                 retry_max_delay_s: float = 2.0,
+                 max_consecutive_faults: int = 3,
+                 metrics_service=None):
+        if not engines:
+            raise ValueError("a fleet needs at least one engine")
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self.registry = registry
+        self.clock = clock
+        self.metrics_service = metrics_service
+        self.replicas: List[EngineReplica] = [
+            e if isinstance(e, EngineReplica) else EngineReplica(
+                f"replica-{i}", e,
+                max_consecutive_faults=max_consecutive_faults,
+                registry=registry)
+            for i, e in enumerate(engines)]
+        self.admission = AdmissionQueue(admission, registry=registry,
+                                        now=clock())
+        self.router = Router(self.replicas, max_retries=max_retries,
+                             retry_base_delay_s=retry_base_delay_s,
+                             retry_max_delay_s=retry_max_delay_s,
+                             registry=registry)
+        self.publisher = WeightPublisher(self.replicas, registry=registry)
+        self._lock = threading.RLock()
+        self._next_ticket = 0
+        self._requests: Dict[int, FleetRequest] = {}
+        self._outcomes: Dict[int, Union[Completed, Rejected]] = {}
+        # fleet-level prefix ids: pid -> (tokens, publisher version at
+        # registration). A publish invalidates every pid implicitly
+        # (version mismatch -> KeyError), mirroring engine semantics so
+        # auto_prefix clients re-register against the new policy.
+        self._fleet_prefixes: Dict[int, tuple] = {}
+        self._next_prefix_id = 0
+        self._dispatcher: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._requests_total = registry.counter(
+            "senweaver_serve_requests_total",
+            "Requests submitted to the fleet.",
+            labelnames=("priority",))
+        self._completed_total = registry.counter(
+            "senweaver_serve_completed_total",
+            "Requests completed by the fleet.",
+            labelnames=("priority",))
+        self._shed_total = registry.counter(
+            "senweaver_serve_shed_total",
+            "Requests shed by admission control (typed Rejected).",
+            labelnames=("priority", "reason"))
+        self._ttft_ms = registry.histogram(
+            "senweaver_serve_ttft_ms",
+            "Submit-to-first-token latency (ms).",
+            labelnames=("priority",))
+        self._e2e_ms = registry.histogram(
+            "senweaver_serve_e2e_ms",
+            "Submit-to-completion latency (ms).",
+            labelnames=("priority",))
+        self._replicas_live = registry.gauge(
+            "senweaver_serve_replicas_live",
+            "Replicas not DEAD.")
+        self._replicas_live.set(len(self.replicas))
+
+    # -- single-engine API superset ------------------------------------------
+    @property
+    def context_bound(self) -> int:
+        """Longest servable context — the most conservative replica's
+        bound (a request must be servable wherever routing lands it)."""
+        return min(int(getattr(r.engine, "context_bound", 1 << 30))
+                   for r in self.replicas)
+
+    @property
+    def num_slots(self) -> int:
+        return sum(r.capacity for r in self.replicas)
+
+    def submit(self, prompt: List[int], *, max_new_tokens: int = 128,
+               priority: str = TRAIN_ROLLOUT,
+               deadline_s: Optional[float] = None,
+               prefix_id: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               hold_slot: bool = False,
+               continue_from: Optional[int] = None) -> int:
+        """Admit a generation request; returns a fleet ticket.
+
+        Sheds (queue full / rate limit) are NOT exceptions: the ticket's
+        outcome is a typed :class:`Rejected` and ``is_done`` is
+        immediately True — the caller always gets an answer. KeyError
+        (stale ``prefix_id`` after a weight publish) and ValueError (bad
+        continuation) match engine semantics so ``EnginePolicyClient``'s
+        recovery paths work unchanged."""
+        with self._lock:
+            now = self.clock()
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._requests_total.inc(priority=priority)
+            if continue_from is not None:
+                return self._submit_continuation(
+                    ticket, prompt, max_new_tokens=max_new_tokens,
+                    eos_id=eos_id, hold_slot=hold_slot,
+                    continue_from=continue_from, priority=priority)
+            prefix_tokens = None
+            if prefix_id is not None:
+                entry = self._fleet_prefixes.get(prefix_id)
+                if entry is None or entry[1] != self.publisher.version:
+                    raise KeyError(
+                        f"unknown or stale fleet prefix_id {prefix_id}")
+                prefix_tokens = entry[0]
+                if prompt[:len(prefix_tokens)] != prefix_tokens:
+                    raise ValueError(
+                        "prompt does not start with the registered "
+                        f"prefix (prefix_id {prefix_id})")
+            req = FleetRequest(
+                ticket=ticket, prompt=list(prompt),
+                max_new_tokens=max_new_tokens, priority=priority,
+                eos_id=eos_id, prefix_tokens=prefix_tokens,
+                hold_slot=hold_slot,
+                deadline=None if deadline_s is None else now + deadline_s,
+                submitted_at=now)
+            self._requests[ticket] = req
+            rejected = self.admission.offer(req, now)
+            if rejected is not None:
+                self._outcomes[ticket] = rejected
+            return ticket
+
+    def _submit_continuation(self, ticket: int, prompt: List[int], *,
+                             max_new_tokens: int, eos_id: Optional[int],
+                             hold_slot: bool, continue_from: int,
+                             priority: str) -> int:
+        """Turn continuation: pinned to the replica holding the slot's
+        KV, dispatched immediately (it extends a conversation that
+        already passed admission). Raises ValueError when the slot is
+        gone — same contract as the engine, so clients fall back to a
+        full prefill."""
+        prev = self._requests.get(continue_from)
+        if prev is None or prev.replica_id is None:
+            raise ValueError(
+                f"continue_from={continue_from}: unknown ticket")
+        replica = next((r for r in self.replicas
+                        if r.replica_id == prev.replica_id), None)
+        if replica is None or replica.state == DEAD:
+            raise ValueError(
+                f"continue_from={continue_from}: replica "
+                f"{prev.replica_id} is gone; slot released")
+        now = self.clock()
+        req = FleetRequest(
+            ticket=ticket, prompt=list(prompt),
+            max_new_tokens=max_new_tokens, priority=priority,
+            eos_id=eos_id, hold_slot=hold_slot, submitted_at=now)
+        self._requests[ticket] = req
+        rid = replica.engine.submit(
+            prompt, max_new_tokens=max_new_tokens,
+            continue_from=prev.engine_rid, hold_slot=hold_slot,
+            eos_id=eos_id)
+        replica.adopt(rid, req)
+        req.dispatched_at = now
+        return ticket
+
+    def register_prefix(self, tokens: List[int]) -> int:
+        """Fleet-level prefix id. Replicas materialize the KV lazily on
+        first dispatch (the router's prefix affinity then keeps reusing
+        the warm replica). Invalidated by the next weight publish —
+        submit() raises KeyError then, and auto_prefix clients
+        re-register."""
+        if not tokens:
+            raise ValueError("empty prefix")
+        with self._lock:
+            key = (list(tokens), self.publisher.version)
+            for pid, entry in self._fleet_prefixes.items():
+                if entry == tuple(key):
+                    return pid
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._fleet_prefixes[pid] = (list(tokens),
+                                         self.publisher.version)
+            return pid
+
+    def is_done(self, ticket: int) -> bool:
+        with self._lock:
+            self._require(ticket)
+            return ticket in self._outcomes
+
+    def outcome(self, ticket: int
+                ) -> Optional[Union[Completed, Rejected]]:
+        with self._lock:
+            self._require(ticket)
+            return self._outcomes.get(ticket)
+
+    def result(self, ticket: int) -> List[int]:
+        """Tokens so far (live view while decoding, final list once
+        completed). Raises :class:`RequestRejected` for shed requests —
+        a typed error, never a silently empty generation."""
+        with self._lock:
+            out = self._outcomes.get(ticket)
+            if isinstance(out, Completed):
+                return list(out.tokens)
+            if isinstance(out, Rejected):
+                raise RequestRejected(out)
+            req = self._require(ticket)
+            if req.engine_rid is not None and req.replica_id is not None:
+                replica = self._replica_by_id(req.replica_id)
+                if replica is not None and replica.state != DEAD:
+                    return replica.engine.result(req.engine_rid)
+            return []
+
+    def result_logps(self, ticket: int) -> List[float]:
+        with self._lock:
+            out = self._outcomes.get(ticket)
+            if isinstance(out, Completed):
+                return list(out.logps)
+            if isinstance(out, Rejected):
+                raise RequestRejected(out)
+            req = self._require(ticket)
+            if req.engine_rid is not None and req.replica_id is not None:
+                replica = self._replica_by_id(req.replica_id)
+                if replica is not None and replica.state != DEAD:
+                    return replica.engine.result_logps(req.engine_rid)
+            return []
+
+    def release_slot(self, ticket: int) -> None:
+        """Free a held decode slot (turn continuation ended)."""
+        with self._lock:
+            req = self._requests.get(ticket)
+            if req is None or req.replica_id is None \
+                    or req.engine_rid is None:
+                return
+            replica = self._replica_by_id(req.replica_id)
+            if replica is not None and replica.state != DEAD:
+                replica.engine.release_slot(req.engine_rid)
+
+    # -- pump ----------------------------------------------------------------
+    def step(self) -> Dict[int, List[int]]:
+        """One scheduling + decode round; returns {ticket: [tokens]}
+        emitted this step (the engine.step contract, ticket-keyed)."""
+        with self._lock:
+            now = self.clock()
+            self.publisher.advance()
+            for rej in self.admission.shed_expired(now):
+                self._record_rejection(rej)
+            self._dispatch(now)
+            emitted_by_ticket: Dict[int, List[int]] = {}
+            for replica in list(self.replicas):
+                if replica.state == DEAD or not replica.has_work():
+                    continue
+                try:
+                    emitted, done = replica.step()
+                except Exception:
+                    self._record_fault(replica, now)
+                    continue
+                self._ingest(replica, emitted, done, emitted_by_ticket)
+            return emitted_by_ticket
+
+    def run(self) -> Dict[int, List[int]]:
+        """Pump until every submitted request has an outcome. Returns
+        {ticket: tokens} for the COMPLETED ones (rejected tickets carry
+        their outcome, reachable via ``outcome()``)."""
+        while self.pending():
+            self.step()
+        with self._lock:
+            return {t: list(o.tokens)
+                    for t, o in self._outcomes.items()
+                    if isinstance(o, Completed)}
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._requests) - len(self._outcomes)
+
+    def stream(self, ticket: int) -> Iterator[int]:
+        """Yield ``ticket``'s tokens as they decode, pumping the fleet
+        (manual mode) until the request finishes."""
+        sent = 0
+        while True:
+            done = self.is_done(ticket)
+            toks = self.result(ticket)      # raises if rejected
+            while sent < len(toks):
+                yield toks[sent]
+                sent += 1
+            if done:
+                return
+            self.step()
+
+    # -- weights -------------------------------------------------------------
+    def update_params(self, params) -> int:
+        """Versioned rolling publish (the ``engine.update_params``
+        drop-in the online loop calls). Blocks until every live replica
+        serves the new version, pumping the fleet meanwhile — serving
+        never stops, generations never mix versions."""
+        with self._lock:
+            version = self.publisher.begin(params)
+        if self._dispatcher is not None:
+            # Threaded mode: the dispatcher pumps the roll forward.
+            while self.publisher.in_progress:
+                time.sleep(0.001)
+        else:
+            while self.publisher.in_progress:
+                self.step()
+        return version
+
+    # -- chaos / operations --------------------------------------------------
+    def kill_replica(self, replica_id: str) -> None:
+        """Declare a replica dead (chaos hook / operator action); its
+        in-flight requests are retried elsewhere or shed explicitly."""
+        with self._lock:
+            replica = self._replica_by_id(replica_id)
+            if replica is None:
+                raise KeyError(f"no replica {replica_id!r}")
+            self._handle_death(replica, self.clock())
+
+    # -- threaded mode -------------------------------------------------------
+    def start(self, *, dispatch_interval_s: float = 0.001) -> None:
+        """Threaded serving: per-replica stepper threads + a dispatcher
+        thread running admission/routing/publish; ``submit``/``result``
+        stay safe from any thread."""
+        if self._dispatcher is not None:
+            return
+        self._stop.clear()
+        for replica in self.replicas:
+            replica.start(self._on_replica_step)
+
+        def dispatch_loop():
+            while not self._stop.is_set():
+                with self._lock:
+                    now = self.clock()
+                    self.publisher.advance()
+                    for rej in self.admission.shed_expired(now):
+                        self._record_rejection(rej)
+                    self._dispatch(now)
+                    self._reap_faulted(now)
+                time.sleep(dispatch_interval_s)
+
+        self._dispatcher = threading.Thread(
+            target=dispatch_loop, name="serve-dispatch", daemon=True)
+        self._dispatcher.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=5)
+            self._dispatcher = None
+        for replica in self.replicas:
+            replica.stop()
+
+    def _on_replica_step(self, replica: EngineReplica,
+                         emitted: Dict[int, List[int]],
+                         done: List[FleetRequest]) -> None:
+        """Stepper-thread completion intake (threaded mode)."""
+        with self._lock:
+            self._ingest(replica, emitted, done, {})
+
+    # -- stats ---------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            completed = sum(isinstance(o, Completed)
+                            for o in self._outcomes.values())
+            rejected = sum(isinstance(o, Rejected)
+                           for o in self._outcomes.values())
+            out: Dict[str, Any] = {
+                "replicas": {r.replica_id: r.stats()
+                             for r in self.replicas},
+                "replicas_live": sum(r.state != DEAD
+                                     for r in self.replicas),
+                "queue_depth": self.admission.depth(),
+                **self.admission.stats(),
+                "pending": len(self._requests) - len(self._outcomes),
+                "completed": completed,
+                "rejected": rejected,
+                "weight_version": self.publisher.version,
+                "weight_version_skew": self.publisher.skew(),
+                "publish_in_progress": self.publisher.in_progress,
+            }
+            return out
+
+    def snapshot_event(self) -> Dict[str, Any]:
+        """Flat serving snapshot for the metrics JSONL (the shape
+        ``scripts/serve_report.py`` renders). Captured via the wired
+        ``metrics_service`` when :meth:`record_snapshot` is called."""
+        with self._lock:
+            def hsnap(name):
+                h = self.registry.get(name)
+                if h is None:
+                    return 0.0, 0
+                total_sum = total_count = 0.0
+                for cell in h.samples().values():
+                    total_sum += cell[-2]
+                    total_count += cell[-1]
+                return total_sum, int(total_count)
+
+            def ctotal(name):
+                m = self.registry.get(name)
+                if m is None:
+                    return 0
+                return sum(float(v) for v in m.samples().values())
+
+            ttft_sum, ttft_n = hsnap("senweaver_serve_ttft_ms")
+            e2e_sum, e2e_n = hsnap("senweaver_serve_e2e_ms")
+            return {
+                "replicas_live": sum(r.state != DEAD
+                                     for r in self.replicas),
+                "queue_depth": self.admission.depth(),
+                "completed": ctotal("senweaver_serve_completed_total"),
+                "shed": ctotal("senweaver_serve_shed_total"),
+                "retries": ctotal("senweaver_serve_retries_total"),
+                "publishes": ctotal("senweaver_serve_publishes_total"),
+                "weight_version_skew": self.publisher.skew(),
+                "ttft_ms_sum": ttft_sum, "ttft_count": ttft_n,
+                "e2e_ms_sum": e2e_sum, "e2e_count": e2e_n,
+            }
+
+    def record_snapshot(self) -> None:
+        """Capture a "Serving Snapshot" event on the wired metrics
+        service (no-op without one)."""
+        if self.metrics_service is not None:
+            self.metrics_service.capture("Serving Snapshot",
+                                         self.snapshot_event())
+
+    # -- internals -----------------------------------------------------------
+    def _require(self, ticket: int) -> FleetRequest:
+        req = self._requests.get(ticket)
+        if req is None:
+            raise KeyError(f"unknown ticket {ticket}")
+        return req
+
+    def _replica_by_id(self, replica_id: str
+                       ) -> Optional[EngineReplica]:
+        return next((r for r in self.replicas
+                     if r.replica_id == replica_id), None)
+
+    def _dispatch(self, now: float) -> None:
+        """Move admitted requests onto accepting replicas, priority
+        first, until nothing is ready or nothing accepts."""
+        while True:
+            req, sheds = self.admission.pop_ready(now)
+            for rej in sheds:
+                self._record_rejection(rej)
+            if req is None:
+                return
+            replica = self.router.pick(req)
+            if replica is None:
+                self.admission.requeue(req)     # nothing accepting now
+                return
+            try:
+                replica.submit(req)
+                req.dispatched_at = now
+            except Exception:
+                # Submit blew up (chaos engine, OOM, wedged pool):
+                # fault the replica; the request goes back through the
+                # router's retry/shed triage like an orphan.
+                if replica.record_fault(REASON_ERROR):
+                    self.admission.requeue(req)
+                    self._handle_death(replica, now)
+                else:
+                    req.attempts += 1
+                    if req.attempts > self.router.max_retries:
+                        self._record_rejection(Rejected(
+                            ticket=req.ticket, priority=req.priority,
+                            reason=REJECT_REPLICA_FAILURE,
+                            detail=f"submit failed "
+                                   f"{req.attempts} times"))
+                    else:
+                        req.not_before = now + episode_retry_delay_s(
+                            req.attempts,
+                            base_s=self.router.retry_base_delay_s,
+                            max_s=self.router.retry_max_delay_s)
+                        self.admission.requeue(req)
+
+    def _ingest(self, replica: EngineReplica,
+                emitted: Dict[int, List[int]],
+                done: List[FleetRequest],
+                emitted_by_ticket: Dict[int, List[int]]) -> None:
+        """Book token emissions (TTFT) and completions (outcomes)."""
+        now = self.clock()
+        done_by_rid = {r.engine_rid: r for r in done}
+        for rid, toks in emitted.items():
+            req = replica.inflight.get(rid) or done_by_rid.get(rid)
+            if req is None:
+                continue                # e.g. pre-kill stragglers
+            emitted_by_ticket.setdefault(req.ticket, []).extend(toks)
+            if req.first_token_at is None and toks:
+                req.first_token_at = now
+                self._ttft_ms.observe(
+                    (now - req.submitted_at) * 1000.0,
+                    priority=req.priority)
+        for req in done:
+            self._complete(replica, req, now)
+
+    def _complete(self, replica: EngineReplica, req: FleetRequest,
+                  now: float) -> None:
+        tokens = replica.engine.result(req.engine_rid)
+        logps = replica.engine.result_logps(req.engine_rid)
+        e2e_ms = (now - req.submitted_at) * 1000.0
+        self._outcomes[req.ticket] = Completed(
+            ticket=req.ticket, priority=req.priority,
+            tokens=list(tokens), logps=list(logps),
+            replica_id=replica.replica_id,
+            weight_version=(req.version_at_dispatch
+                            if req.version_at_dispatch is not None
+                            else replica.weight_version),
+            weight_version_at_finish=replica.weight_version,
+            attempts=req.attempts,
+            ttft_ms=(None if req.first_token_at is None
+                     else (req.first_token_at - req.submitted_at)
+                     * 1000.0),
+            e2e_ms=e2e_ms)
+        self._completed_total.inc(priority=req.priority)
+        self._e2e_ms.observe(e2e_ms, priority=req.priority)
+
+    def _record_rejection(self, rej: Rejected) -> None:
+        # Admission already counted its own sheds; router/fleet-origin
+        # rejections (replica_failure / no_replicas) are counted here —
+        # same counter, so the shed rate is one number.
+        if rej.reason in (REJECT_REPLICA_FAILURE, REJECT_NO_REPLICAS):
+            self._shed_total.inc(priority=rej.priority,
+                                 reason=rej.reason)
+        self._outcomes[rej.ticket] = rej
+
+    def _record_fault(self, replica: EngineReplica, now: float) -> None:
+        if replica.record_fault(REASON_ERROR):
+            self._handle_death(replica, now)
+
+    def _handle_death(self, replica: EngineReplica, now: float) -> None:
+        requeue, shed = self.router.on_replica_death(replica, now)
+        self._replicas_live.set(
+            sum(r.state != DEAD for r in self.replicas))
+        for rej in shed:
+            self._record_rejection(rej)
+        for req in requeue:
+            self.admission.requeue(req)
+        if not self.router.live_replicas():
+            for rej in self.admission.shed_all(
+                    REJECT_NO_REPLICAS, "no live replicas"):
+                self._record_rejection(rej)
+
+    def _reap_faulted(self, now: float) -> None:
+        """Threaded mode: stepper threads can only RECORD faults; the
+        dispatcher turns a replica whose fault budget is spent into a
+        proper death (orphan triage included)."""
+        for replica in self.replicas:
+            if (replica.state != DEAD
+                    and replica._consecutive_faults
+                    >= replica.max_consecutive_faults):
+                self._handle_death(replica, now)

@@ -1,0 +1,279 @@
+"""EngineReplica — one RolloutEngine as a member of a serving fleet.
+
+The wrapper owns what the bare engine doesn't know it has: an identity
+(``replica_id``), a health state machine (LIVE → DRAINING → LIVE for
+weight rolls, anything → DEAD on faults), a weight version, the
+in-flight request map the router balances on, and — in threaded mode —
+the stepper thread that drives ``engine.step()`` so N replicas decode
+concurrently while the fleet's dispatcher admits and routes.
+
+Fault vocabulary is reused from ``resilience.faults`` (REASON_ERROR /
+REASON_TIMEOUT): a replica that throws out of submit/step records a
+fault, and ``max_consecutive_faults`` of them without a healthy step in
+between kill it — the same escalate-after-bounded-retries shape the
+episode boundary uses, applied to the serving plane.
+
+State transitions never lose requests: ``kill()`` returns the orphaned
+in-flight FleetRequests so the router can resubmit them elsewhere (or
+shed them with a typed Rejected when retries are spent).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..resilience.faults import REASON_ERROR
+from .admission import FleetRequest
+
+LIVE = "live"
+DRAINING = "draining"
+DEAD = "dead"
+_STATE_CODE = {LIVE: 0, DRAINING: 1, DEAD: 2}
+
+
+class ReplicaDead(RuntimeError):
+    """Operation attempted on a DEAD replica."""
+
+
+class EngineReplica:
+    """One engine + its fleet-facing bookkeeping. All mutation is
+    serialized under ``self._lock`` (the engine has its own lock; this
+    one covers the replica's maps so the dispatcher thread and the
+    stepper thread compose)."""
+
+    def __init__(self, replica_id: str, engine, *,
+                 max_consecutive_faults: int = 3,
+                 registry=None):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.state = LIVE
+        self.weight_version = 0
+        self.max_consecutive_faults = max(1, int(max_consecutive_faults))
+        self._consecutive_faults = 0
+        # engine rid -> FleetRequest, the router's outstanding-work signal
+        self.inflight: Dict[int, FleetRequest] = {}
+        # prefix tokens (tuple) -> engine prefix_id; cleared on weight
+        # install (engine.update_params drops old-policy prefix KV)
+        self._prefixes: Dict[tuple, int] = {}
+        self._lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._state_gauge = registry.gauge(
+            "senweaver_serve_replica_state",
+            "Replica health (0=live, 1=draining, 2=dead).",
+            labelnames=("replica",))
+        self._inflight_gauge = registry.gauge(
+            "senweaver_serve_replica_inflight",
+            "Requests decoding on this replica.",
+            labelnames=("replica",))
+        self._version_gauge = registry.gauge(
+            "senweaver_serve_weight_version",
+            "Weight version this replica is serving.",
+            labelnames=("replica",))
+        self._faults_total = registry.counter(
+            "senweaver_serve_replica_faults_total",
+            "Faults recorded against fleet replicas.",
+            labelnames=("replica", "reason"))
+        self._state_gauge.set(0, replica=replica_id)
+        self._inflight_gauge.set(0, replica=replica_id)
+        self._version_gauge.set(0, replica=replica_id)
+
+    # -- capacity / routing signals -----------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(getattr(self.engine, "num_slots", 8))
+
+    @property
+    def outstanding(self) -> int:
+        with self._lock:
+            return len(self.inflight)
+
+    @property
+    def accepting(self) -> bool:
+        """Routable: live with a free decode slot."""
+        with self._lock:
+            return self.state == LIVE and len(self.inflight) < self.capacity
+
+    def holds_prefix(self, tokens: Tuple[int, ...]) -> bool:
+        with self._lock:
+            return tokens in self._prefixes
+
+    # -- lifecycle -----------------------------------------------------------
+    def drain(self) -> None:
+        """Stop accepting new work; in-flight decodes run to completion
+        (the first half of a rolling weight swap)."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            self.state = DRAINING
+            self._state_gauge.set(_STATE_CODE[DRAINING],
+                                  replica=self.replica_id)
+
+    def resume(self) -> None:
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            self.state = LIVE
+            self._state_gauge.set(_STATE_CODE[LIVE],
+                                  replica=self.replica_id)
+
+    def kill(self) -> List[FleetRequest]:
+        """Mark DEAD and hand back the orphaned in-flight requests for
+        the router to resubmit. Idempotent — a second kill returns []."""
+        with self._lock:
+            if self.state == DEAD:
+                return []
+            self.state = DEAD
+            self._state_gauge.set(_STATE_CODE[DEAD],
+                                  replica=self.replica_id)
+            orphans = list(self.inflight.values())
+            self.inflight.clear()
+            self._inflight_gauge.set(0, replica=self.replica_id)
+            return orphans
+
+    def record_fault(self, reason: str = REASON_ERROR) -> bool:
+        """Count a fault; returns True when this one crossed
+        ``max_consecutive_faults`` (the replica is NOT killed here — the
+        fleet does that so it can collect the orphans in one place)."""
+        with self._lock:
+            self._faults_total.inc(replica=self.replica_id, reason=reason)
+            self._consecutive_faults += 1
+            return self._consecutive_faults >= self.max_consecutive_faults
+
+    # -- serving -------------------------------------------------------------
+    def submit(self, req: FleetRequest) -> int:
+        """Dispatch one admitted request onto this replica's engine.
+        Registers the request's prefix on demand (prefix-affinity means
+        the router usually picked a replica that already holds it).
+        Raises whatever the engine raises — the fleet translates that
+        into a fault + retry."""
+        with self._lock:
+            if self.state != LIVE:
+                raise ReplicaDead(
+                    f"{self.replica_id} is {self.state}, not accepting")
+            prefix_id = None
+            if req.prefix_tokens:
+                key = tuple(req.prefix_tokens)
+                prefix_id = self._prefixes.get(key)
+                if prefix_id is None:
+                    prefix_id = self.engine.register_prefix(
+                        list(req.prefix_tokens))
+                    self._prefixes[key] = prefix_id
+            rid = self.engine.submit(
+                req.prompt, max_new_tokens=req.max_new_tokens,
+                prefix_id=prefix_id, eos_id=req.eos_id,
+                hold_slot=req.hold_slot)
+            self.inflight[rid] = req
+            req.replica_id = self.replica_id
+            req.engine_rid = rid
+            req.version_at_dispatch = self.weight_version
+            self._consecutive_faults = 0
+            self._inflight_gauge.set(len(self.inflight),
+                                     replica=self.replica_id)
+            return rid
+
+    def adopt(self, rid: int, req: FleetRequest) -> None:
+        """Track an engine rid submitted outside :meth:`submit` (turn
+        continuations pin themselves to the held slot's replica and call
+        the engine directly)."""
+        with self._lock:
+            self.inflight[rid] = req
+            req.replica_id = self.replica_id
+            req.engine_rid = rid
+            req.version_at_dispatch = self.weight_version
+            self._inflight_gauge.set(len(self.inflight),
+                                     replica=self.replica_id)
+
+    def step(self) -> Tuple[Dict[int, List[int]], List[FleetRequest]]:
+        """One engine step. Returns (emitted {engine_rid: [tokens]},
+        completed FleetRequests). Engine exceptions propagate — the
+        fleet records the fault and decides whether this kills us."""
+        with self._lock:
+            if self.state == DEAD:
+                return {}, []
+            emitted = self.engine.step()
+            self._consecutive_faults = 0
+            done: List[FleetRequest] = []
+            for rid in list(self.inflight):
+                if self.engine.is_done(rid):
+                    done.append(self.inflight.pop(rid))
+            if done:
+                self._inflight_gauge.set(len(self.inflight),
+                                         replica=self.replica_id)
+            return emitted, done
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return self.state != DEAD and bool(
+                getattr(self.engine, "has_work", False))
+
+    # -- weights -------------------------------------------------------------
+    def install_weights(self, params, version: int) -> None:
+        """Swap in a published weight version. The publisher only calls
+        this at zero in-flight (drain-first), which is the whole
+        no-mixed-versions guarantee; asserting it here turns a publisher
+        bug into a loud error instead of silent off-policy tokens."""
+        with self._lock:
+            if self.inflight:
+                raise RuntimeError(
+                    f"{self.replica_id}: install_weights with "
+                    f"{len(self.inflight)} in flight — drain first")
+            self.engine.update_params(params)
+            self.weight_version = int(version)
+            self._prefixes.clear()      # engine dropped old-policy KV
+            self._version_gauge.set(version, replica=self.replica_id)
+
+    # -- stepper thread (threaded mode) --------------------------------------
+    def start(self, on_step, *, idle_sleep_s: float = 0.001) -> None:
+        """Drive ``step()`` in a daemon thread while there is work;
+        ``on_step(replica, emitted, done)`` is the fleet's completion
+        intake (called outside the replica lock)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                if self.state == DEAD:
+                    return
+                if not self.has_work():
+                    time.sleep(idle_sleep_s)
+                    continue
+                try:
+                    emitted, done = self.step()
+                except Exception:
+                    # The fleet's dispatcher notices via record_fault on
+                    # its next touch; the stepper must not die silently
+                    # holding requests.
+                    self.record_fault()
+                    time.sleep(idle_sleep_s)
+                    continue
+                if emitted or done:
+                    on_step(self, emitted, done)
+
+        self._thread = threading.Thread(
+            target=run, name=f"serve-step-{self.replica_id}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            out = {"state": self.state,
+                   "weight_version": self.weight_version,
+                   "inflight": len(self.inflight),
+                   "capacity": self.capacity}
+        try:
+            out["engine"] = self.engine.stats()
+        except Exception as e:        # a dead engine still reports
+            out["engine"] = {"error": str(e)}
+        return out

@@ -1,0 +1,112 @@
+"""SLO-aware request routing across the replica fleet.
+
+Two signals, in order:
+
+1. **Prefix affinity** — a request carrying ``prefix_tokens`` (the
+   shared agent system prompt) prefers a replica that already holds that
+   prefix's KV: installing it there is one HBM copy
+   (``engine._install_prefix``) instead of a full prefill on a cold
+   replica. Ties break by least outstanding work.
+2. **Least outstanding work** — otherwise the live replica with the
+   fewest in-flight requests wins (the classic least-loaded policy; with
+   uniform decode cost per step, in-flight count IS outstanding work).
+
+Replica death is the router's second job: orphaned in-flight requests
+come back through :meth:`on_replica_death`, which either schedules a
+retry on the surviving fleet — backoff via the SAME exponential shape
+the episode fault boundary uses (``resilience.episode_retry_delay_s``)
+— or sheds the request with a typed ``Rejected`` once its retry budget
+is spent. A retried request restarts from its prompt: partial tokens
+from the dead replica are discarded (they may belong to a different
+weight version than the surviving replicas serve).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..resilience.faults import episode_retry_delay_s
+from .admission import (REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
+                        FleetRequest, Rejected)
+from .replica import EngineReplica
+
+
+class Router:
+    def __init__(self, replicas: Sequence[EngineReplica], *,
+                 max_retries: int = 2,
+                 retry_base_delay_s: float = 0.05,
+                 retry_max_delay_s: float = 2.0,
+                 registry=None):
+        self.replicas = list(replicas)
+        self.max_retries = int(max_retries)
+        self.retry_base_delay_s = retry_base_delay_s
+        self.retry_max_delay_s = retry_max_delay_s
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._affinity_hits = registry.counter(
+            "senweaver_serve_prefix_affinity_hits_total",
+            "Requests routed to a replica already holding their prefix.")
+        self._retries_total = registry.counter(
+            "senweaver_serve_retries_total",
+            "Requests resubmitted after a replica death/fault.")
+        self._deaths_total = registry.counter(
+            "senweaver_serve_replica_deaths_total",
+            "Replicas declared dead.")
+
+    # -- selection -----------------------------------------------------------
+    def live_replicas(self) -> List[EngineReplica]:
+        from .replica import DEAD
+        return [r for r in self.replicas if r.state != DEAD]
+
+    def pick(self, req: FleetRequest) -> Optional[EngineReplica]:
+        """Choose a replica for ``req`` (None = nothing accepting; the
+        request stays queued)."""
+        accepting = [r for r in self.replicas if r.accepting]
+        if not accepting:
+            return None
+        if req.prefix_tokens:
+            key = tuple(req.prefix_tokens)
+            warm = [r for r in accepting if r.holds_prefix(key)]
+            if warm:
+                self._affinity_hits.inc()
+                return min(warm, key=lambda r: r.outstanding)
+        return min(accepting, key=lambda r: r.outstanding)
+
+    # -- failure handling ----------------------------------------------------
+    def on_replica_death(self, replica: EngineReplica, now: float
+                         ) -> Tuple[List[FleetRequest], List[Rejected]]:
+        """Kill ``replica`` and triage its orphans: (requeue, shed).
+
+        Requeued requests carry a ``not_before`` backoff floor — the
+        dispatcher won't touch them until it passes — and cleared
+        dispatch state (their partial tokens died with the replica)."""
+        orphans = replica.kill()
+        self._deaths_total.inc()
+        requeue: List[FleetRequest] = []
+        shed: List[Rejected] = []
+        have_survivors = bool(self.live_replicas())
+        for req in orphans:
+            req.attempts += 1
+            req.replica_id = None
+            req.engine_rid = None
+            req.version_at_dispatch = None
+            req.first_token_at = None
+            if not have_survivors:
+                shed.append(Rejected(
+                    ticket=req.ticket, priority=req.priority,
+                    reason=REJECT_NO_REPLICAS,
+                    detail="last replica died"))
+            elif req.attempts > self.max_retries:
+                shed.append(Rejected(
+                    ticket=req.ticket, priority=req.priority,
+                    reason=REJECT_REPLICA_FAILURE,
+                    detail=f"retry budget spent "
+                           f"({req.attempts - 1} retries)"))
+            else:
+                req.not_before = now + episode_retry_delay_s(
+                    req.attempts, base_s=self.retry_base_delay_s,
+                    max_s=self.retry_max_delay_s)
+                self._retries_total.inc()
+                requeue.append(req)
+        return requeue, shed

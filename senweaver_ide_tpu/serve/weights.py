@@ -1,0 +1,131 @@
+"""Versioned weight publication with rolling replica swaps.
+
+``RolloutEngine.update_params`` is instantaneous for one engine but a
+fleet can't blink: swapping every replica at once means zero serving
+capacity for the duration of N param transfers, and swapping NONE means
+rollouts drift off-policy. The publisher threads the needle the way RLAX
+/ Podracer actor fleets do — roll one replica at a time:
+
+    for each live replica:  drain → wait for zero in-flight → swap →
+                            resume
+
+The fleet keeps serving on the other replicas throughout; the weight-
+version SKEW this creates (some replicas on v, some on v+1 mid-roll) is
+first-class and exported as ``senweaver_serve_weight_version_skew`` —
+GRPO's importance ratio tolerates bounded skew, but only if you can see
+it.
+
+The roll is a resumable state machine advanced by :meth:`advance` (the
+fleet pumps it between decode steps), never a blocking loop — a publish
+must not stall the dispatcher that keeps the other replicas fed. Because
+a replica swaps only at zero in-flight, no generation ever mixes tokens
+from two weight versions; :meth:`EngineReplica.install_weights` asserts
+exactly that.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+from .replica import DEAD, LIVE, EngineReplica
+
+
+class WeightPublisher:
+    def __init__(self, replicas: Sequence[EngineReplica], *,
+                 registry=None):
+        self.replicas = list(replicas)
+        self.version = 0            # latest PUBLISHED (begun) version
+        self._pending_params = None
+        self._roll_queue: List[EngineReplica] = []
+        self._current: Optional[EngineReplica] = None
+        self._lock = threading.RLock()
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._skew_gauge = registry.gauge(
+            "senweaver_serve_weight_version_skew",
+            "Max minus min weight version across live replicas.")
+        self._publishes_total = registry.counter(
+            "senweaver_serve_publishes_total",
+            "Weight versions published to the fleet.")
+        self._rolled_total = registry.counter(
+            "senweaver_serve_replicas_rolled_total",
+            "Per-replica weight swaps completed.")
+        self._skew_gauge.set(0)
+
+    @property
+    def in_progress(self) -> bool:
+        with self._lock:
+            return self._pending_params is not None
+
+    def skew(self) -> int:
+        """Version spread across non-dead replicas (0 = converged)."""
+        with self._lock:
+            versions = [r.weight_version for r in self.replicas
+                        if r.state != DEAD]
+        if not versions:
+            return 0
+        return max(versions) - min(versions)
+
+    def begin(self, params) -> int:
+        """Stage a new version for rolling install; returns it. A begin
+        during an unfinished roll fast-forwards: the in-progress roll
+        retargets to the newest params (replicas already swapped to the
+        superseded version will be re-rolled — they're in the queue
+        again), which is the right semantics for a trainer publishing
+        faster than the fleet drains."""
+        with self._lock:
+            self.version += 1
+            self._pending_params = params
+            self._publishes_total.inc()
+            # (Re)build the roll queue: every non-dead replica needs the
+            # new version, including ones mid-drain from a previous roll.
+            self._roll_queue = [r for r in self.replicas
+                                if r.state != DEAD]
+            self._current = None
+            return self.version
+
+    def advance(self) -> bool:
+        """One state-machine step of the roll; returns True when the
+        publish has fully landed (or there was none). Called by the
+        fleet's pump between decode steps, so draining replicas keep
+        stepping their in-flight work toward zero."""
+        with self._lock:
+            if self._pending_params is None:
+                self._update_skew()
+                return True
+            if self._current is None:
+                # Next replica to roll; skip ones that died mid-roll.
+                while self._roll_queue:
+                    cand = self._roll_queue.pop(0)
+                    if cand.state != DEAD:
+                        self._current = cand
+                        break
+                if self._current is None:       # queue exhausted
+                    self._pending_params = None
+                    self._update_skew()
+                    return True
+                if self._current.state == LIVE:
+                    self._current.drain()
+            cur = self._current
+            if cur.state == DEAD:
+                # Died while draining: its orphans are the router's
+                # problem; the roll just moves on.
+                self._current = None
+                self._update_skew()
+                return False
+            if cur.outstanding == 0:
+                cur.install_weights(self._pending_params, self.version)
+                cur.resume()
+                self._rolled_total.inc()
+                self._current = None
+                if not self._roll_queue:
+                    self._pending_params = None
+                    self._update_skew()
+                    return True
+            self._update_skew()
+            return False
+
+    def _update_skew(self) -> None:
+        self._skew_gauge.set(self.skew())

@@ -144,6 +144,7 @@ class DashboardService:
         out["training"] = _training_curves(self.metrics_path)
         out["obs"] = self._obs_summary()
         out["resilience"] = self._resilience_summary()
+        out["serving"] = self._serving_summary()
         return out
 
     def _resilience_summary(self) -> Dict[str, Any]:
@@ -173,6 +174,54 @@ class DashboardService:
                     total("senweaver_uploader_retries_total"),
                 "chaos_injected":
                     total("senweaver_chaos_faults_injected_total"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _serving_summary(self) -> Dict[str, Any]:
+        """Serving-fleet tile row, read straight off the registry's
+        ``senweaver_serve_*`` series (zero wiring — any ServingFleet in
+        the process shows up; all None/zero without one). Labeled
+        counters sum across cells; the TTFT/e2e histograms collapse to
+        their running means."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        def hist_mean(name: str) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            s = c = 0.0
+            for cell in m.samples().values():
+                s += cell[-2]
+                c += cell[-1]
+            return (s / c) if c else None
+
+        try:
+            live = self.registry.get("senweaver_serve_replicas_live")
+            versions = self.registry.get("senweaver_serve_weight_version")
+            skew = self.registry.get(
+                "senweaver_serve_weight_version_skew")
+            return {
+                "replicas_live": (None if live is None
+                                  else live.value()),
+                "queue_depth": total("senweaver_serve_queue_depth"),
+                "completed": total("senweaver_serve_completed_total"),
+                "shed": total("senweaver_serve_shed_total"),
+                "retries": total("senweaver_serve_retries_total"),
+                "replica_deaths":
+                    total("senweaver_serve_replica_deaths_total"),
+                "publishes": total("senweaver_serve_publishes_total"),
+                "weight_version": (
+                    max((float(v) for v in versions.samples().values()),
+                        default=0) if versions is not None else 0),
+                "version_skew": (skew.value()
+                                 if skew is not None else 0),
+                "ttft_ms_mean": hist_mean("senweaver_serve_ttft_ms"),
+                "e2e_ms_mean": hist_mean("senweaver_serve_e2e_ms"),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -371,6 +420,7 @@ input[type=text], input[type=password], textarea {
 <div id="obs-spans"></div></section>
 <section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
 </section>
+<section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2>
 <div class="actionbar">
@@ -585,6 +635,17 @@ async function refresh() {
     ["updates skipped", res.updates_skipped],
     ["uploader retries", res.uploader_retries],
     ["chaos injected", res.chaos_injected]]);
+  const sv = s.serving || {};
+  tiles(document.getElementById("serving"), [
+    ["replicas live", sv.replicas_live],
+    ["queue depth", sv.queue_depth],
+    ["completed", sv.completed],
+    ["shed", sv.shed],
+    ["retries", sv.retries],
+    ["weight version", sv.weight_version],
+    ["version skew", sv.version_skew],
+    ["ttft ms (mean)", sv.ttft_ms_mean],
+    ["e2e ms (mean)", sv.e2e_ms_mean]]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

@@ -259,7 +259,20 @@ class OnlineImprovementLoop:
         if self.engine is not None and hasattr(self.engine,
                                                "update_params"):
             with get_tracer().span("online.publish_params"):
-                self.engine.update_params(self.state.params)
+                published = self.engine.update_params(self.state.params)
+            # A ServingFleet publish is VERSIONED (rolling drain→swap
+            # across replicas via serve.WeightPublisher); a bare engine
+            # returns None. Record the version + serving state so the
+            # metrics trail ties each training round to the weight
+            # version its next round samples from.
+            if isinstance(published, int) \
+                    and self.metrics_service is not None:
+                self.metrics_service.capture("Weights Published", {
+                    "round": self._round,
+                    "weight_version": published,
+                })
+            if hasattr(self.engine, "record_snapshot"):
+                self.engine.record_snapshot()
 
         # APO side of the cycle (the reference's timer tick, driven at
         # round boundaries here): analysis when gates open; prompt beam

@@ -753,3 +753,54 @@ def test_prefix_eviction_fallback_to_full_prefill(model):
     srid = solo.submit([1, 2, 3, 4], max_new_tokens=4)
     np.testing.assert_array_equal(np.asarray(out[rid]),
                                   np.asarray(solo.run()[srid]))
+
+
+def test_max_queue_bounds_submit_with_typed_queuefull(model):
+    """Bounded admission at the engine: past max_queue QUEUED requests
+    submit() raises QueueFull (typed, never a silent drop); scheduling
+    drains the queue and re-opens admission. In-flight slots don't
+    count against the bound."""
+    from senweaver_ide_tpu.rollout import QueueFull
+
+    params, config = model
+    eng = RolloutEngine(params, config, num_slots=1, max_len=64,
+                        sample=GREEDY, max_queue=2)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=2)
+    r2 = eng.submit([4, 5, 6], max_new_tokens=2)
+    assert eng.queue_depth == 2
+    assert eng.stats()["queue_depth"] == 2
+    with pytest.raises(QueueFull):
+        eng.submit([7, 8, 9], max_new_tokens=2)
+    eng.step()                   # r1 scheduled into the slot: depth 2→1
+    assert eng.queue_depth < 2   # admission re-opens
+    r3 = eng.submit([7, 8, 9], max_new_tokens=2)
+    out = eng.run()
+    assert all(len(out[r]) == 2 for r in (r1, r2, r3))
+
+
+def test_prefix_cache_hit_and_miss_counters(model):
+    """stats() exposes prefix-cache effectiveness: installs count as
+    hits; a prefix invalidated while its request sat queued counts as
+    a miss (full-prefill fallback)."""
+    params, config = model
+    eng = RolloutEngine(params, config, num_slots=2, max_len=64,
+                        sample=GREEDY)
+    pid = eng.register_prefix([1, 2, 3])
+    ra = eng.submit([1, 2, 3, 4], max_new_tokens=2, prefix_id=pid)
+    rb = eng.submit([1, 2, 3, 5], max_new_tokens=2, prefix_id=pid)
+    eng.run()
+    s = eng.stats()
+    assert s["prefix_cache_hits"] == 2
+    assert s["prefix_cache_misses"] == 0
+
+    # Weight sync drops the prefix while a request is queued: the
+    # scheduler falls back to full prefill and counts the miss.
+    eng2 = RolloutEngine(params, config, num_slots=1, max_len=64,
+                         sample=GREEDY)
+    pid2 = eng2.register_prefix([1, 2, 3])
+    hold = eng2.submit([9, 9, 9], max_new_tokens=2)       # occupies slot
+    rc = eng2.submit([1, 2, 3, 4], max_new_tokens=2, prefix_id=pid2)
+    eng2.update_params(params)        # invalidates pid2's KV
+    out = eng2.run()
+    assert len(out[rc]) == 2 and len(out[hold]) == 2
+    assert eng2.stats()["prefix_cache_misses"] == 1

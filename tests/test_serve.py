@@ -1,0 +1,395 @@
+"""Serving fleet: admission semantics, priority/deadline SLOs, replica
+failover, and rolling weight publication.
+
+The acceptance invariants under test (ISSUE "serve/"):
+
+- every admitted request COMPLETES or is explicitly REJECTED — none lost,
+  under overload, replica death, and mid-flight weight rolls;
+- no completed generation mixes tokens from two weight versions
+  (``Completed.weight_version == weight_version_at_finish``);
+- the ``senweaver_serve_*`` telemetry (queue depth, shed counts, TTFT
+  histogram, version-skew gauge) is emitted throughout.
+
+Time-dependent semantics (deadlines, rate limits, priority ordering) run
+on a deterministic fake clock — seeded and sleep-free, the same posture
+as resilience/chaos.py.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.agents.llm import ChatMessage
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.models.tokenizer import ByteTokenizer
+from senweaver_ide_tpu.rollout import EnginePolicyClient, RolloutEngine
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import (AdmissionConfig, ClassPolicy,
+                                     Completed, DEAD, INTERACTIVE,
+                                     Rejected, RequestRejected,
+                                     ServingFleet, TRAIN_ROLLOUT)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_engine(model, num_slots=2, max_len=64):
+    params, config = model
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY)
+
+
+class FakeClock:
+    """Injectable monotonic clock: time moves only when told to."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---- drop-in parity ------------------------------------------------------
+
+def test_fleet_matches_single_engine(model):
+    """A 1-replica fleet is token-for-token the single engine (greedy →
+    scheduling-invariant), and stream() yields exactly result()."""
+    params, config = model
+    prompt = [5, 9, 2, 7, 1, 3]
+    ref_eng = make_engine(model)
+    ref_rid = ref_eng.submit(prompt, max_new_tokens=10)
+    ref = ref_eng.run()[ref_rid]
+
+    fleet = ServingFleet([make_engine(model)])
+    t = fleet.submit(prompt, max_new_tokens=10)
+    streamed = list(fleet.stream(t))
+    np.testing.assert_array_equal(np.asarray(streamed), np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(fleet.result(t)),
+                                  np.asarray(ref))
+    out = fleet.outcome(t)
+    assert isinstance(out, Completed)
+    assert out.weight_version == out.weight_version_at_finish == 0
+    assert fleet.is_done(t)
+
+
+def test_fleet_spreads_load_across_replicas(model):
+    """More requests than one replica's slots: both replicas decode, all
+    complete, and per-replica inflight telemetry was exercised."""
+    fleet = ServingFleet([make_engine(model, num_slots=1),
+                          make_engine(model, num_slots=1)])
+    tickets = [fleet.submit([i + 1, i + 2, i + 3], max_new_tokens=6)
+               for i in range(4)]
+    fleet.step()
+    used = {fleet._requests[t].replica_id for t in tickets
+            if fleet._requests[t].replica_id is not None}
+    assert len(used) == 2          # least-outstanding-work spread them
+    fleet.run()
+    assert all(isinstance(fleet.outcome(t), Completed) for t in tickets)
+
+
+# ---- admission: priority, deadlines, rate limits (fake clock) ------------
+
+def test_priority_deadline_semantics_fake_clock(model):
+    """Saturated fleet: INTERACTIVE dispatches ahead of earlier-queued
+    TRAIN_ROLLOUT and meets its deadline; a train request whose deadline
+    passes while queued is shed; past the queue bound submits shed
+    immediately — all visible in the admission metrics."""
+    clock = FakeClock()
+    fleet = ServingFleet(
+        [make_engine(model, num_slots=1)],
+        admission=AdmissionConfig(
+            interactive=ClassPolicy(max_queue=4),
+            train_rollout=ClassPolicy(max_queue=3)),
+        clock=clock)
+
+    t_run = fleet.submit([1, 2, 3], max_new_tokens=3)       # occupies slot
+    fleet.step()
+    assert fleet._requests[t_run].replica_id is not None
+
+    t_expire = fleet.submit([2, 3, 4], max_new_tokens=3,
+                            deadline_s=0.5)                 # will expire
+    t_wait1 = fleet.submit([3, 4, 5], max_new_tokens=3)
+    t_wait2 = fleet.submit([4, 5, 6], max_new_tokens=3)
+    t_full = fleet.submit([5, 6, 7], max_new_tokens=3)      # queue at 3
+    t_inter = fleet.submit([6, 7, 8], max_new_tokens=3,
+                           priority=INTERACTIVE, deadline_s=30.0)
+
+    full_out = fleet.outcome(t_full)
+    assert isinstance(full_out, Rejected)
+    assert full_out.reason == "queue_full"
+    with pytest.raises(RequestRejected):
+        fleet.result(t_full)
+
+    clock.advance(1.0)              # t_expire's 0.5s deadline passes
+    while fleet.pending():
+        fleet.step()
+        clock.advance(0.01)         # distinct dispatch timestamps
+
+    exp_out = fleet.outcome(t_expire)
+    assert isinstance(exp_out, Rejected) and exp_out.reason == "deadline"
+    for t in (t_run, t_wait1, t_wait2, t_inter):
+        assert isinstance(fleet.outcome(t), Completed)
+    # Interactive jumped the train backlog that queued BEFORE it...
+    inter, w1, w2 = (fleet._requests[t]
+                     for t in (t_inter, t_wait1, t_wait2))
+    assert inter.dispatched_at < w1.dispatched_at
+    assert inter.dispatched_at < w2.dispatched_at
+    # ...and met its deadline (queue-wait bound, fake-clock exact).
+    assert inter.dispatched_at < inter.deadline
+
+    reg = obs.get_registry()
+    shed = reg.get("senweaver_serve_shed_total").samples()
+    assert shed[("train_rollout", "queue_full")] == 1
+    assert shed[("train_rollout", "deadline")] == 1
+    assert ("interactive",) in \
+        reg.get("senweaver_serve_admitted_total").samples()
+    depth = reg.get("senweaver_serve_queue_depth").samples()
+    assert depth[("interactive",)] == 0        # drained at the end
+    assert depth[("train_rollout",)] == 0
+
+
+def test_rate_limit_sheds_typed(model):
+    """Token-bucket admission: burst of 1 at 1 req/s — the second
+    immediate submit sheds, a refill later one is admitted."""
+    clock = FakeClock()
+    fleet = ServingFleet(
+        [make_engine(model)],
+        admission=AdmissionConfig(
+            interactive=ClassPolicy(rate=1.0, burst=1.0)),
+        clock=clock)
+    t1 = fleet.submit([1, 2, 3], max_new_tokens=2, priority=INTERACTIVE)
+    t2 = fleet.submit([1, 2, 4], max_new_tokens=2, priority=INTERACTIVE)
+    out2 = fleet.outcome(t2)
+    assert isinstance(out2, Rejected) and out2.reason == "rate_limited"
+    clock.advance(1.0)
+    t3 = fleet.submit([1, 2, 5], max_new_tokens=2, priority=INTERACTIVE)
+    fleet.run()
+    assert isinstance(fleet.outcome(t1), Completed)
+    assert isinstance(fleet.outcome(t3), Completed)
+
+
+# ---- failover ------------------------------------------------------------
+
+def test_failover_midstream(model):
+    """EnginePolicyClient (auto_prefix) over a 2-replica fleet: the
+    serving replica is killed after the FIRST streamed chunk; the client
+    keeps pumping, the fleet retries on the survivor, and the final text
+    matches a never-killed single-engine run byte for byte. A weight
+    publish afterwards invalidates the fleet prefix id and the client's
+    KeyError path re-registers transparently."""
+    params, config = model
+    tok = ByteTokenizer()
+    msgs = [ChatMessage("system", "You are a terse helper."),
+            ChatMessage("user", "say hi")]
+
+    ref_eng = RolloutEngine(params, config, num_slots=2, max_len=512,
+                            sample=GREEDY)
+    ref = EnginePolicyClient(ref_eng, tok, default_max_new_tokens=8,
+                             auto_prefix=True).chat(msgs)
+
+    fleet = ServingFleet(
+        [RolloutEngine(params, config, num_slots=2, max_len=512,
+                       sample=GREEDY) for _ in range(2)],
+        retry_base_delay_s=0.0)     # no wall-clock stall in the retry
+    client = EnginePolicyClient(fleet, tok, default_max_new_tokens=8,
+                                auto_prefix=True)
+    killed = []
+
+    def on_text(_chunk):
+        if killed:
+            return
+        pending = [t for t in fleet._requests
+                   if t not in fleet._outcomes]
+        rep = fleet._requests[pending[0]].replica_id
+        assert rep is not None
+        fleet.kill_replica(rep)
+        killed.append(rep)
+
+    resp = client.chat(msgs, on_text=on_text)
+    assert killed, "kill hook never fired"
+    assert resp.text == ref.text
+    reg = obs.get_registry()
+    assert sum(reg.get(
+        "senweaver_serve_replica_deaths_total").samples().values()) == 1
+    assert sum(reg.get(
+        "senweaver_serve_retries_total").samples().values()) >= 1
+    done = [o for o in fleet._outcomes.values()
+            if isinstance(o, Completed)]
+    assert done and all(o.attempts >= 1 for o in done)
+
+    # Publish new weights on the survivor; the held fleet prefix_id is
+    # now stale → client re-registers (KeyError path) and completes.
+    fleet.update_params(init_params(config, jax.random.PRNGKey(1)))
+    resp2 = client.chat(msgs)
+    assert isinstance(resp2.text, str)
+    last = max(t for t in fleet._requests)
+    out = fleet.outcome(last)
+    assert isinstance(out, Completed)
+    assert out.weight_version == out.weight_version_at_finish == 1
+
+
+def test_last_replica_death_sheds_everything_typed(model):
+    """No silent loss even when the WHOLE fleet dies: in-flight and
+    queued requests all resolve to typed Rejected outcomes."""
+    fleet = ServingFleet([make_engine(model, num_slots=1)])
+    t1 = fleet.submit([1, 2, 3], max_new_tokens=8)
+    t2 = fleet.submit([4, 5, 6], max_new_tokens=8)    # queued behind
+    fleet.step()
+    fleet.kill_replica("replica-0")
+    for t in (t1, t2):
+        out = fleet.outcome(t)
+        assert isinstance(out, Rejected)
+        assert out.reason == "no_replicas"
+        assert fleet.is_done(t)
+
+
+# ---- rolling weight publication ------------------------------------------
+
+def test_rolling_publish_skew_visible_and_no_mixing(model):
+    """Publish while both replicas decode: replicas roll one at a time
+    (version skew of exactly 1 is observable mid-roll), serving never
+    stops, every generation finishes on the version it started on, and
+    the skew gauge converges back to 0."""
+    params, config = model
+    fleet = ServingFleet([make_engine(model, num_slots=1),
+                          make_engine(model, num_slots=1)])
+    t1 = fleet.submit([1, 2, 3], max_new_tokens=10)
+    t2 = fleet.submit([4, 5, 6], max_new_tokens=10)
+    fleet.step()
+    assert fleet._requests[t1].replica_id != fleet._requests[t2].replica_id
+
+    version = fleet.publisher.begin(
+        init_params(config, jax.random.PRNGKey(1)))
+    assert version == 1
+    skews = set()
+    while fleet.publisher.in_progress or fleet.pending():
+        fleet.step()
+        skews.add(fleet.publisher.skew())
+    assert 1 in skews                      # mid-roll divergence was real
+    assert fleet.publisher.skew() == 0     # and converged
+    for t in (t1, t2):
+        out = fleet.outcome(t)
+        assert isinstance(out, Completed)
+        assert out.weight_version == out.weight_version_at_finish == 0
+    # Post-roll traffic serves v1 on every replica.
+    t3 = fleet.submit([7, 8, 9], max_new_tokens=4)
+    fleet.run()
+    assert fleet.outcome(t3).weight_version_at_finish == 1
+    reg = obs.get_registry()
+    assert sum(reg.get(
+        "senweaver_serve_publishes_total").samples().values()) == 1
+    assert sum(reg.get(
+        "senweaver_serve_replicas_rolled_total").samples().values()) == 2
+    assert reg.get("senweaver_serve_weight_version_skew") \
+        .samples()[()] == 0
+
+
+# ---- the chaos acceptance run --------------------------------------------
+
+def test_chaos_acceptance_overload_death_and_publish(model):
+    """The ISSUE's acceptance scenario: a 3-replica CPU fleet under
+    mixed-priority load beyond capacity, one replica killed mid-flight,
+    one rolling weight publish mid-run. Invariants: every submitted
+    request completes or is explicitly Rejected (none lost); no
+    completed generation mixes weight versions; queue-depth, shed,
+    TTFT, and version-skew telemetry all emitted."""
+    params, config = model
+    fleet = ServingFleet(
+        [make_engine(model, num_slots=2) for _ in range(3)],
+        admission=AdmissionConfig(
+            interactive=ClassPolicy(max_queue=8),
+            train_rollout=ClassPolicy(max_queue=4)),
+        retry_base_delay_s=0.0)
+
+    tickets = []
+    # Wave 1: overload — 10 train submits against 6 slots + 4 queue
+    # spots land at least one typed queue_full shed.
+    for i in range(10):
+        tickets.append(fleet.submit([i + 1, i + 2, i + 3, i + 4],
+                                    max_new_tokens=6))
+    for i in range(4):
+        tickets.append(fleet.submit([i + 2, i + 5, i + 7],
+                                    max_new_tokens=4,
+                                    priority=INTERACTIVE,
+                                    deadline_s=60.0))
+    fleet.step()
+    fleet.step()
+
+    # Kill a replica that is decoding right now.
+    victim = next(r for r in fleet.replicas if r.outstanding > 0)
+    fleet.kill_replica(victim.replica_id)
+
+    # Publish new weights while the survivors are still loaded; the
+    # pump advances the roll between decode steps.
+    fleet.publisher.begin(init_params(config, jax.random.PRNGKey(1)))
+
+    # Wave 2: more traffic DURING the roll.
+    for i in range(4):
+        tickets.append(fleet.submit([i + 3, i + 1, i + 9],
+                                    max_new_tokens=4))
+
+    steps = 0
+    while fleet.pending() or fleet.publisher.in_progress:
+        fleet.step()
+        steps += 1
+        assert steps < 2000, "fleet failed to drain"
+
+    # -- none lost: every ticket has a terminal outcome ------------------
+    assert len(tickets) == len(set(tickets))
+    outcomes = {t: fleet.outcome(t) for t in tickets}
+    assert all(o is not None for o in outcomes.values())
+    completed = [o for o in outcomes.values() if isinstance(o, Completed)]
+    rejected = [o for o in outcomes.values() if isinstance(o, Rejected)]
+    assert len(completed) + len(rejected) == len(tickets)
+    assert completed, "nothing completed under chaos"
+    assert any(o.reason == "queue_full" for o in rejected), \
+        "overload never shed"
+
+    # -- no version mixing ----------------------------------------------
+    for o in completed:
+        assert o.weight_version == o.weight_version_at_finish
+
+    # -- fleet state ------------------------------------------------------
+    assert sum(r.state == DEAD for r in fleet.replicas) == 1
+    live_versions = {r.weight_version for r in fleet.replicas
+                     if r.state != DEAD}
+    assert live_versions == {1}            # publish landed everywhere
+    assert fleet.publisher.skew() == 0
+
+    # -- telemetry emitted ------------------------------------------------
+    reg = obs.get_registry()
+    assert reg.get("senweaver_serve_queue_depth") is not None
+    shed = reg.get("senweaver_serve_shed_total").samples()
+    assert sum(shed.values()) == len(rejected)
+    ttft = reg.get("senweaver_serve_ttft_ms").samples()
+    # ≥: a request retried after its replica died re-observes TTFT on
+    # the second dispatch (its first token died with the replica).
+    assert sum(cell[-1] for cell in ttft.values()) >= len(completed)
+    assert reg.get("senweaver_serve_weight_version_skew") \
+        .samples()[()] == 0
+    assert sum(reg.get(
+        "senweaver_serve_replica_deaths_total").samples().values()) == 1
+    # stats() aggregates the same picture for the dashboard.
+    s = fleet.stats()
+    assert s["replicas_live"] == 2
+    assert s["completed"] == len(completed)
+    assert s["rejected"] == len(rejected)
+    assert s["weight_version"] == 1
