@@ -485,6 +485,76 @@ def _measure_train(model_name: str, batch: int, seq: int, *,
     return out
 
 
+def _measure_prefix_fleet(*, n_replicas: int = 4, prefix_len: int = 48,
+                          n_requests: int = 8) -> dict:
+    """Fleet-shared prefix economics: one-prefill broadcast vs lazy
+    per-replica prefill on an N-replica fleet (serve/prefix_store.py).
+
+    Protocol-level numbers, so the tiny model demonstrates them on any
+    backend: prefix prefills actually computed per mode, prefill FLOPs
+    avoided by installing the donor's KV instead of recomputing
+    (≈ 2·N_params per prefix token per avoided prefill), and the
+    prefix-bearing TTFT mean per mode — the acceptance signal is
+    broadcast TTFT < lazy TTFT."""
+    import jax
+    import numpy as np
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.serve import ServingFleet
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prefix = [(i % 200) + 2 for i in range(prefix_len)]
+
+    def run(shared: bool) -> dict:
+        obs._reset_for_tests()
+        engines = [RolloutEngine(params, config, num_slots=2,
+                                 max_len=128, sample=greedy)
+                   for _ in range(n_replicas)]
+        fleet = ServingFleet(engines,
+                             shared_prefix_broadcast=shared)
+        pid = fleet.register_prefix(prefix)
+        tickets = [fleet.submit(prefix + [7 + i], max_new_tokens=4,
+                                prefix_id=pid)
+                   for i in range(n_requests)]
+        fleet.run()
+        ttfts = [fleet.outcome(t).ttft_ms for t in tickets
+                 if fleet.outcome(t).ttft_ms is not None]
+        snap = fleet.snapshot_event()
+        return {
+            "prefix_prefills": sum(e.stats()["prefix_prefills"]
+                                   for e in engines),
+            "prefills_avoided": snap["prefix_prefills_avoided"],
+            "ttft_ms_mean": sum(ttfts) / max(1, len(ttfts)),
+        }
+
+    run(shared=True)        # warm the jit caches so neither mode pays
+    lazy = run(shared=False)
+    bcast = run(shared=True)
+    obs._reset_for_tests()
+    avoided = bcast["prefills_avoided"]
+    return {
+        "replicas": n_replicas,
+        "prefix_len": prefix_len,
+        "prefix_prefills_lazy": lazy["prefix_prefills"],
+        "prefix_prefills_broadcast": bcast["prefix_prefills"],
+        "prefills_avoided": avoided,
+        "prefill_flops_avoided": int(
+            2.0 * n_params * prefix_len * avoided),
+        "ttft_ms_lazy": round(lazy["ttft_ms_mean"], 2),
+        "ttft_ms_broadcast": round(bcast["ttft_ms_mean"], 2),
+        "ttft_speedup": round(
+            lazy["ttft_ms_mean"] / max(1e-9, bcast["ttft_ms_mean"]), 3),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -584,6 +654,15 @@ def main() -> None:
             extra[key] = _measure_train(name, b, s, accum_steps=acc)
         except Exception as e:
             extra[key] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Fleet-shared prefix economics (one-prefill broadcast vs lazy
+    # per-replica prefill). Protocol-level, so tiny-test covers it on
+    # every backend.
+    try:
+        _log("prefix fleet measure: prefix_fleet")
+        extra["prefix_fleet"] = _measure_prefix_fleet()
+    except Exception as e:
+        extra["prefix_fleet"] = f"error: {type(e).__name__}: {e}"[:200]
 
     baseline = _baseline()
     metric = (f"decode_tokens_per_sec_per_chip[{model_name}"

@@ -52,6 +52,16 @@ from ..ops.attention import MASKED_THRESHOLD as _MASKED
 from ..ops.attention import NEG_INF, repeat_kv
 
 
+def _axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` across JAX versions: absent in 0.4.x, where
+    ``psum(1, axis)`` is the canonical spelling (it constant-folds to
+    the bound axis size, so Python-level shape checks still work)."""
+    size = getattr(jax.lax, "axis_size", None)
+    if size is not None:
+        return size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
 def chunk_attention_lse(
     q: jax.Array,                  # (B, Sq, Hq, D)
     k: jax.Array,                  # (B, Skv, Hkv, D)
@@ -123,7 +133,7 @@ def ring_attention(
     """Ring attention over the ``axis_name`` mesh axis. Must run inside
     ``shard_map`` with the sequence axis sharded on that axis. Device i's
     queries live at absolute positions [i·S_local, (i+1)·S_local)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     s_local = q.shape[1]
     q_off = idx * s_local
@@ -163,7 +173,7 @@ def ulysses_attention(
     Hq/sp heads, reshard back. Head counts must divide by the axis size."""
     from ..ops.attention import attention
 
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     if q.shape[2] % n or k.shape[2] % n:
         raise ValueError(
             f"ulysses needs head counts divisible by |{axis_name}|={n}; "

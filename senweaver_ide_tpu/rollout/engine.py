@@ -48,6 +48,14 @@ class QueueFull(RuntimeError):
     (``max_queue=None``) keeps the legacy enqueue-anything behavior."""
 
 
+class PrefixImportError(ValueError):
+    """import_prefix() refused a foreign KV buffer: shape, dtype,
+    quantization flavor, or recorded length doesn't match this engine's
+    pool layout. Typed so a fleet-level broadcast (serve/prefix_store.py)
+    can catch it and degrade to a local lazy prefill instead of serving
+    from a corrupt cache."""
+
+
 def _bucket(n: int, minimum: int = 16) -> int:
     b = minimum
     while b < n:
@@ -315,7 +323,8 @@ class RolloutEngine:
         self._stats = {"prefills": 0, "prefill_tokens": 0,
                        "batched_prefills": 0, "batched_prefill_slots": 0,
                        "prefix_installs": 0, "prefix_tokens_reused": 0,
-                       "prefix_evictions": 0,
+                       "prefix_evictions": 0, "prefix_prefills": 0,
+                       "prefix_imports": 0, "prefix_exports": 0,
                        "prefix_cache_hits": 0, "prefix_cache_misses": 0,
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
@@ -654,6 +663,95 @@ class RolloutEngine:
                                    jax.device_get(last[0]))
             self._prefix_by_tokens[key] = pid
             self._touch_prefix(pid)
+            self._stats["prefix_prefills"] += 1
+            return pid
+
+    def export_prefix(self, prefix_id: int):
+        """Hand out a registered prefix for installation into ANOTHER
+        engine (serve/prefix_store.py one-prefill broadcast): returns
+        ``(tokens, kv, last_logits)`` — the token list, the one-slot
+        KVCache buffer, and the final-token logits as a host (V,) array.
+
+        The KV buffer is shared by reference, which is safe: JAX arrays
+        are immutable and the jitted paths donate only the POOL cache,
+        never a prefix buffer. Raises KeyError if the prefix was evicted
+        or invalidated (callers re-register, same as submit())."""
+        with self._lock:
+            if prefix_id not in self._prefixes:
+                raise KeyError(f"unknown prefix_id {prefix_id}")
+            tokens, sub, last = self._prefixes[prefix_id]
+            self._touch_prefix(prefix_id)
+            self._stats["prefix_exports"] += 1
+            return list(tokens), sub, last
+
+    def import_prefix(self, tokens: List[int], kv: KVCache,
+                      last_logits=None) -> int:
+        """Adopt a prefix KV computed by a peer engine — the receive side
+        of the fleet broadcast. Instead of re-prefilling ``tokens``, the
+        peer's one-slot buffer is device-placed (``jax.device_put`` is a
+        device-to-device copy when source and target differ, a no-op
+        aliasing when they share a device) and registered in this
+        engine's prefix cache under a fresh prefix_id, LRU-accounted
+        exactly like a locally-prefilled one.
+
+        The buffer must match this pool's slot layout bit-for-bit —
+        shape (L, 1, max_len, Hkv, Dh), dtype, quantization flavor, and
+        recorded length == len(tokens) — anything else raises
+        :class:`PrefixImportError` (serving attention over a mismatched
+        buffer would be silent garbage). ``last_logits`` is the donor's
+        final-token logits; without it, a zero-suffix submit recomputes
+        the last position (one-token prefill) on first use."""
+        with self._lock:
+            if not tokens:
+                raise ValueError("empty prefix")
+            if len(tokens) >= self.max_len:
+                raise ValueError(
+                    f"prefix length {len(tokens)} ≥ pool capacity "
+                    f"{self.max_len}")
+            key = tuple(tokens)
+            if key in self._prefix_by_tokens:   # already resident here
+                pid = self._prefix_by_tokens[key]
+                self._touch_prefix(pid)
+                return pid
+            L, _, cap, hkv, dh = self.cache.k.shape
+            want = (L, 1, cap, hkv, dh)
+            if tuple(kv.k.shape) != want or tuple(kv.v.shape) != want:
+                raise PrefixImportError(
+                    f"prefix KV shape {tuple(kv.k.shape)}/"
+                    f"{tuple(kv.v.shape)} != pool slot layout {want}")
+            if kv.k.dtype != self.cache.k.dtype:
+                raise PrefixImportError(
+                    f"prefix KV dtype {kv.k.dtype} != pool dtype "
+                    f"{self.cache.k.dtype}")
+            if bool(kv.quantized) != bool(self.cache.quantized):
+                raise PrefixImportError(
+                    f"prefix quantization {kv.quantized} != pool "
+                    f"quantization {self.cache.quantized}")
+            if int(jax.device_get(kv.length)) != len(tokens):
+                raise PrefixImportError(
+                    f"prefix KV records length "
+                    f"{int(jax.device_get(kv.length))} but "
+                    f"{len(tokens)} tokens were declared")
+            while len(self._prefixes) >= self.max_prefixes:
+                lru = min(self._prefix_last_use,
+                          key=self._prefix_last_use.get)
+                self.release_prefix(lru)
+                self._stats["prefix_evictions"] += 1
+            if self.mesh is not None:
+                # TP pool: place like any fresh array; jit resharding
+                # handles the KV-spec layout at first install.
+                placed = jax.device_put(kv)
+            else:
+                dev = next(iter(self.cache.k.devices()))
+                placed = jax.device_put(kv, dev)
+            last = (None if last_logits is None
+                    else np.asarray(jax.device_get(last_logits)))
+            pid = self._next_prefix_id
+            self._next_prefix_id += 1
+            self._prefixes[pid] = (list(tokens), placed, last)
+            self._prefix_by_tokens[key] = pid
+            self._touch_prefix(pid)
+            self._stats["prefix_imports"] += 1
             return pid
 
     def _touch_prefix(self, pid: int) -> None:
@@ -818,8 +916,18 @@ class RolloutEngine:
             if suffix:
                 last_logits = self._prefill_chunks(slot_arr, suffix,
                                                    fresh_first=False)
-            else:
+            elif p_last is not None:
                 last_logits = jnp.asarray(p_last)
+            else:
+                # Imported prefix without donor logits: re-feed the last
+                # prefix token at its own position (rewind the cursor by
+                # one) to recompute the final logits — a 1-token prefill,
+                # not a full pass; the rewritten k/v is bit-identical.
+                self.cache = self.cache._replace(
+                    length=self.cache.length.at[slot].set(true_len - 1))
+                last_logits = self._prefill_chunks(
+                    slot_arr, [req.prompt[-1]], fresh_first=False)
+                self._stats["prefill_tokens"] += 1
         elif true_len >= self.max_len and self._ring:
             # Long prompt on a ring pool: exact-size chunk chain
             # (see _prefill_slot_chunk). Reset the slot's stale

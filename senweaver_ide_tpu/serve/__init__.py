@@ -14,6 +14,7 @@ from .admission import (AdmissionConfig, AdmissionQueue, ClassPolicy,
                         REJECT_REPLICA_FAILURE, Rejected,
                         RequestRejected, TRAIN_ROLLOUT, TokenBucket)
 from .frontend import Completed, ServingFleet
+from .prefix_store import SharedPrefixStore
 from .replica import (DEAD, DRAINING, EngineReplica, LIVE, ReplicaDead)
 from .router import Router
 from .weights import WeightPublisher
@@ -24,5 +25,6 @@ __all__ = [
     "LIVE", "PRIORITY_CLASSES", "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
     "REJECT_QUEUE_FULL", "REJECT_RATE_LIMITED", "REJECT_REPLICA_FAILURE",
     "Rejected", "ReplicaDead", "RequestRejected", "Router",
-    "ServingFleet", "TRAIN_ROLLOUT", "TokenBucket", "WeightPublisher",
+    "ServingFleet", "SharedPrefixStore", "TRAIN_ROLLOUT", "TokenBucket",
+    "WeightPublisher",
 ]

@@ -97,6 +97,11 @@ class FleetRequest:
     # -- dispatch state (owned by the fleet) --------------------------------
     attempts: int = 0
     not_before: float = 0.0
+    # tokens decoded so far on the current replica — max_new_tokens
+    # minus this is the request's REMAINING decode work, the unit the
+    # router balances in (reset to 0 on re-dispatch after a death: the
+    # partial tokens died with the replica).
+    emitted: int = 0
     replica_id: Optional[str] = None
     engine_rid: Optional[int] = None
     version_at_dispatch: Optional[int] = None
@@ -154,7 +159,8 @@ class TokenBucket:
 
 
 class AdmissionQueue:
-    """Per-class bounded FIFO queues with rate limits and deadline shed.
+    """Per-class bounded queues (EDF within a class) with rate limits
+    and deadline shed.
 
     Not a thread in sight: the fleet serializes access under its own
     lock and supplies ``now`` — this object is pure policy, which is
@@ -223,29 +229,43 @@ class AdmissionQueue:
     # -- dispatch ------------------------------------------------------------
     def pop_ready(self, now: float) -> Tuple[Optional[FleetRequest],
                                              List[Rejected]]:
-        """Next dispatchable request (priority order, FIFO within class,
-        honoring ``not_before`` backoff) plus any requests shed because
-        their deadline passed while queued."""
+        """Next dispatchable request plus any shed because their
+        deadline passed while queued.
+
+        Order: strict priority class first; WITHIN a class, earliest
+        deadline first (EDF — the queue-wait deadline is the SLO, so
+        the request closest to blowing it runs next), deadline-less
+        requests after all deadline-bearing ones in FIFO order.
+        ``not_before`` backoff is honored: a request inside its retry
+        floor is skipped without losing its queue position."""
         sheds: List[Rejected] = []
         picked: Optional[FleetRequest] = None
         for p in PRIORITY_CLASSES:
             q = self._queues[p]
-            skipped: List[FleetRequest] = []
-            while q:
-                req = q.popleft()
+            keep: List[FleetRequest] = []
+            best_key = None
+            best_i = -1
+            for req in q:
                 if req.deadline is not None and now >= req.deadline:
                     sheds.append(self._shed(
                         req, REJECT_DEADLINE,
                         f"queued past deadline "
                         f"(+{now - req.deadline:.3f}s)"))
                     continue
+                keep.append(req)
                 if req.not_before > now:
-                    skipped.append(req)
                     continue
-                picked = req
-                break
-            for r in reversed(skipped):     # preserve FIFO order
-                q.appendleft(r)
+                key = (req.deadline is None,
+                       req.deadline if req.deadline is not None else 0.0,
+                       len(keep) - 1)
+                if best_key is None or key < best_key:
+                    best_key = key
+                    best_i = len(keep) - 1
+            if best_i >= 0:
+                picked = keep.pop(best_i)
+            if len(keep) != len(q):
+                q.clear()
+                q.extend(keep)
             self._depth_gauge.set(len(q), priority=p)
             if picked is not None:
                 break

@@ -46,6 +46,7 @@ from ..resilience.faults import REASON_ERROR, episode_retry_delay_s
 from .admission import (AdmissionConfig, AdmissionQueue, FleetRequest,
                         REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
                         Rejected, RequestRejected, TRAIN_ROLLOUT)
+from .prefix_store import SharedPrefixStore
 from .replica import DEAD, EngineReplica
 from .router import Router
 from .weights import WeightPublisher
@@ -79,7 +80,8 @@ class ServingFleet:
                  retry_base_delay_s: float = 0.05,
                  retry_max_delay_s: float = 2.0,
                  max_consecutive_faults: int = 3,
-                 metrics_service=None):
+                 metrics_service=None,
+                 shared_prefix_broadcast: bool = True):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if registry is None:
@@ -101,16 +103,18 @@ class ServingFleet:
                              retry_max_delay_s=retry_max_delay_s,
                              registry=registry)
         self.publisher = WeightPublisher(self.replicas, registry=registry)
+        # Fleet prefix ids + the one-prefill broadcast protocol. The
+        # store sees ``self.replicas`` by reference, so add_replica'd
+        # members participate; a publisher begin() invalidates every
+        # shared entry (stale pids raise KeyError at submit, mirroring
+        # engine semantics so auto_prefix clients re-register).
+        self.prefix_store = SharedPrefixStore(
+            self.replicas, self.publisher, registry=registry,
+            enabled=shared_prefix_broadcast)
         self._lock = threading.RLock()
         self._next_ticket = 0
         self._requests: Dict[int, FleetRequest] = {}
         self._outcomes: Dict[int, Union[Completed, Rejected]] = {}
-        # fleet-level prefix ids: pid -> (tokens, publisher version at
-        # registration). A publish invalidates every pid implicitly
-        # (version mismatch -> KeyError), mirroring engine semantics so
-        # auto_prefix clients re-register against the new policy.
-        self._fleet_prefixes: Dict[int, tuple] = {}
-        self._next_prefix_id = 0
         self._dispatcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._requests_total = registry.counter(
@@ -177,11 +181,11 @@ class ServingFleet:
                     continue_from=continue_from, priority=priority)
             prefix_tokens = None
             if prefix_id is not None:
-                entry = self._fleet_prefixes.get(prefix_id)
-                if entry is None or entry[1] != self.publisher.version:
+                entry = self.prefix_store.lookup(prefix_id)
+                if entry is None:
                     raise KeyError(
                         f"unknown or stale fleet prefix_id {prefix_id}")
-                prefix_tokens = entry[0]
+                prefix_tokens = list(entry.tokens)
                 if prompt[:len(prefix_tokens)] != prefix_tokens:
                     raise ValueError(
                         "prompt does not start with the registered "
@@ -233,23 +237,15 @@ class ServingFleet:
         return ticket
 
     def register_prefix(self, tokens: List[int]) -> int:
-        """Fleet-level prefix id. Replicas materialize the KV lazily on
-        first dispatch (the router's prefix affinity then keeps reusing
-        the warm replica). Invalidated by the next weight publish —
-        submit() raises KeyError then, and auto_prefix clients
-        re-register."""
-        if not tokens:
-            raise ValueError("empty prefix")
+        """Fleet-level prefix id. The KV materializes at first dispatch
+        via the one-prefill broadcast: the picked replica prefills ONCE
+        and the store installs its buffer into every other live replica
+        (device-to-device copy), so the whole fleet is warm — the
+        router's prefix affinity becomes a tiebreak, not a necessity.
+        Invalidated by the next weight publish — submit() raises
+        KeyError then, and auto_prefix clients re-register."""
         with self._lock:
-            key = (list(tokens), self.publisher.version)
-            for pid, entry in self._fleet_prefixes.items():
-                if entry == tuple(key):
-                    return pid
-            pid = self._next_prefix_id
-            self._next_prefix_id += 1
-            self._fleet_prefixes[pid] = (list(tokens),
-                                         self.publisher.version)
-            return pid
+            return self.prefix_store.register(tokens)
 
     def is_done(self, ticket: int) -> bool:
         with self._lock:
@@ -373,6 +369,36 @@ class ServingFleet:
         return version
 
     # -- chaos / operations --------------------------------------------------
+    def add_replica(self, engine, *,
+                    replica_id: Optional[str] = None) -> EngineReplica:
+        """Grow the fleet with a new (or resurrected) replica. The
+        engine must already hold the CURRENT published params — the
+        fleet stamps it with the publisher's version rather than
+        replaying the publish. Shared prefixes are NOT pushed eagerly;
+        the store backfills on the replica's first prefix-bearing
+        dispatch (the lazy half of the broadcast protocol)."""
+        with self._lock:
+            if replica_id is None:
+                replica_id = f"replica-{len(self.replicas)}"
+            if self._replica_by_id(replica_id) is not None:
+                raise ValueError(f"replica id {replica_id!r} taken")
+            replica = (engine if isinstance(engine, EngineReplica)
+                       else EngineReplica(replica_id, engine,
+                                          registry=self.registry))
+            replica.weight_version = self.publisher.version
+            replica._version_gauge.set(self.publisher.version,
+                                       replica=replica.replica_id)
+            # router and publisher hold their own list copies; the
+            # prefix store shares self.replicas by reference.
+            self.replicas.append(replica)
+            self.router.replicas.append(replica)
+            self.publisher.replicas.append(replica)
+            self._replicas_live.set(
+                sum(r.state != DEAD for r in self.replicas))
+        if self._dispatcher is not None:        # threaded mode
+            replica.start(self._on_replica_step)
+        return replica
+
     def kill_replica(self, replica_id: str) -> None:
         """Declare a replica dead (chaos hook / operator action); its
         in-flight requests are retried elsewhere or shed explicitly."""
@@ -443,6 +469,7 @@ class ServingFleet:
                 "weight_version": self.publisher.version,
                 "weight_version_skew": self.publisher.skew(),
                 "publish_in_progress": self.publisher.in_progress,
+                **self.prefix_store.stats(),
             }
             return out
 
@@ -469,6 +496,26 @@ class ServingFleet:
 
             ttft_sum, ttft_n = hsnap("senweaver_serve_ttft_ms")
             e2e_sum, e2e_n = hsnap("senweaver_serve_e2e_ms")
+            inst_sum, inst_n = hsnap("senweaver_serve_prefix_install_ms")
+
+            def ttft_buckets():
+                # Per-priority cumulative TTFT buckets — what
+                # scripts/prefix_report.py derives p50/p95 from.
+                h = self.registry.get("senweaver_serve_ttft_ms")
+                if h is None or not hasattr(h, "snapshot"):
+                    return {}
+                out = {}
+                from .admission import PRIORITY_CLASSES
+                for p in PRIORITY_CLASSES:
+                    snap = h.snapshot(priority=p)
+                    if snap["count"]:
+                        out[p] = {
+                            "buckets": {str(k): v for k, v
+                                        in snap["buckets"].items()},
+                            "sum": snap["sum"],
+                            "count": snap["count"]}
+                return out
+
             return {
                 "replicas_live": sum(r.state != DEAD
                                      for r in self.replicas),
@@ -480,6 +527,17 @@ class ServingFleet:
                 "weight_version_skew": self.publisher.skew(),
                 "ttft_ms_sum": ttft_sum, "ttft_count": ttft_n,
                 "e2e_ms_sum": e2e_sum, "e2e_count": e2e_n,
+                "prefix_broadcasts": ctotal(
+                    "senweaver_serve_prefix_broadcasts_total"),
+                "prefix_prefills_avoided": ctotal(
+                    "senweaver_serve_prefix_prefills_avoided_total"),
+                "prefix_broadcast_failures": ctotal(
+                    "senweaver_serve_prefix_broadcast_failures_total"),
+                "prefix_invalidations": ctotal(
+                    "senweaver_serve_prefix_invalidations_total"),
+                "prefix_install_ms_sum": inst_sum,
+                "prefix_install_count": inst_n,
+                "ttft_by_priority": ttft_buckets(),
             }
 
     def record_snapshot(self) -> None:
@@ -514,6 +572,12 @@ class ServingFleet:
             if replica is None:
                 self.admission.requeue(req)     # nothing accepting now
                 return
+            if req.prefix_tokens:
+                # Warm the picked replica BEFORE dispatch: donor prefill
+                # + fleet broadcast on first touch, backfill install for
+                # late joiners — never raises; on failure the replica's
+                # own lazy register_prefix path inside submit() covers.
+                self.prefix_store.ensure(replica, req.prefix_tokens)
             try:
                 replica.submit(req)
                 req.dispatched_at = now

@@ -1,0 +1,227 @@
+"""SharedPrefixStore — fleet prefix KV: prefill once, broadcast to all.
+
+The fleet's dominant redundant work is N replicas each re-prefilling an
+identical multi-hundred-token prefix (the optimized system prompt every
+episode shares). Per-replica lazy registration made the FIRST dispatch
+to each replica pay the full prefill; this store makes registration
+**prefill-once / broadcast-to-all** — the RadixAttention / PagedAttention
+economics (PAPERS.md) applied across engines instead of within one:
+
+1. On the first dispatch of a fleet prefix, the chosen replica becomes
+   the **donor**: it prefills the tokens (``engine.register_prefix``)
+   and exports its one-slot KV buffer (``engine.export_prefix``).
+2. The store installs that buffer into every other LIVE replica via
+   ``engine.import_prefix`` — a ``jax.device_put`` device-to-device
+   copy, validated against the receiver's pool layout and accounted in
+   its prefix LRU like a locally-prefilled entry. TTFT for
+   prefix-bearing requests on those replicas drops from O(prefix FLOPs)
+   to O(HBM bandwidth).
+3. Replicas that join late, resurrect after death, or were DRAINING
+   during the broadcast are **backfilled** on their next prefix-bearing
+   dispatch (:meth:`ensure` runs in the dispatch path).
+
+Invalidation follows the no-version-mixing rule: the store subscribes
+to ``WeightPublisher.begin`` and drops every shared entry the moment a
+publish starts — old-policy KV must never serve under new weights. A
+stale fleet ``prefix_id`` then raises ``KeyError`` at submit, exactly
+the single-engine contract auto_prefix clients already recover from.
+
+Degradation: any export or install failure (chaos engine, layout
+mismatch → :class:`~..rollout.engine.PrefixImportError`, OOM) marks the
+entry failed and falls back to the pre-store behavior — each replica
+lazily prefills on first use (``EngineReplica.submit``). The store can
+make serving faster, never wedge it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from .replica import LIVE, EngineReplica
+
+
+@dataclasses.dataclass
+class _SharedPrefix:
+    """One fleet prefix and its broadcast state."""
+
+    pid: int
+    tokens: List[int]
+    version: int                      # publisher version at registration
+    donor_id: Optional[str] = None    # replica that paid the one prefill
+    kv: Any = None                    # exported one-slot KVCache
+    last_logits: Any = None           # donor's final-token logits (host)
+    installed: Set[str] = dataclasses.field(default_factory=set)
+    failed: bool = False              # degraded to per-replica lazy path
+
+
+class SharedPrefixStore:
+    """Fleet-level prefix registry + one-prefill broadcast protocol.
+
+    Owns the pid namespace the :class:`ServingFleet` hands to clients.
+    ``replicas`` is the fleet's LIVE list object (shared, not copied) so
+    replicas added after construction participate automatically. All
+    calls happen under the fleet's lock — no locking of its own."""
+
+    def __init__(self, replicas: Sequence[EngineReplica], publisher, *,
+                 registry=None, enabled: bool = True):
+        self.replicas = replicas
+        self.publisher = publisher
+        self.enabled = bool(enabled)
+        self._entries: Dict[int, _SharedPrefix] = {}
+        # (tuple(tokens), version) -> pid: O(1) content dedup, replacing
+        # the fleet's former O(pids) linear scan per register call.
+        self._by_key: Dict[Tuple[tuple, int], int] = {}
+        self._next_pid = 0
+        publisher.subscribe_begin(self._on_publish)
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._broadcasts_total = registry.counter(
+            "senweaver_serve_prefix_broadcasts_total",
+            "Shared-prefix KV buffers installed into non-donor replicas.")
+        self._avoided_total = registry.counter(
+            "senweaver_serve_prefix_prefills_avoided_total",
+            "Prefix prefills avoided by serving an installed copy "
+            "instead of recomputing.")
+        self._failures_total = registry.counter(
+            "senweaver_serve_prefix_broadcast_failures_total",
+            "Shared-prefix exports/installs that failed (entry degrades "
+            "to per-replica lazy prefill).")
+        self._invalidations_total = registry.counter(
+            "senweaver_serve_prefix_invalidations_total",
+            "Shared prefixes dropped by a weight publish.")
+        self._install_ms = registry.histogram(
+            "senweaver_serve_prefix_install_ms",
+            "Wall time of one shared-prefix install (device-to-device "
+            "KV copy + validation).")
+        self._shared_gauge = registry.gauge(
+            "senweaver_serve_prefix_shared",
+            "Shared prefixes currently registered in the store.")
+        self._shared_gauge.set(0)
+
+    # -- registry ------------------------------------------------------------
+    def register(self, tokens: List[int]) -> int:
+        """Fleet prefix id for ``tokens`` under the current weight
+        version. Content-identical registrations dedup to one pid; the
+        KV materializes lazily at first dispatch (donor prefill +
+        broadcast)."""
+        if not tokens:
+            raise ValueError("empty prefix")
+        key = (tuple(tokens), self.publisher.version)
+        pid = self._by_key.get(key)
+        if pid is not None:
+            return pid
+        pid = self._next_pid
+        self._next_pid += 1
+        self._entries[pid] = _SharedPrefix(
+            pid=pid, tokens=list(tokens), version=self.publisher.version)
+        self._by_key[key] = pid
+        self._shared_gauge.set(len(self._entries))
+        return pid
+
+    def lookup(self, pid: int) -> Optional[_SharedPrefix]:
+        """The entry behind ``pid`` — None when unknown or stale (its
+        registration version predates the current weights)."""
+        entry = self._entries.get(pid)
+        if entry is None or entry.version != self.publisher.version:
+            return None
+        return entry
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "shared_prefixes": len(self._entries),
+            "prefixes_materialized": sum(
+                e.kv is not None for e in self._entries.values()),
+            "prefixes_failed": sum(
+                e.failed for e in self._entries.values()),
+        }
+
+    # -- broadcast protocol --------------------------------------------------
+    def ensure(self, replica: EngineReplica,
+               tokens: List[int]) -> None:
+        """Dispatch-path hook: make ``replica`` warm for ``tokens``
+        before the request lands on it. Never raises — every failure
+        path degrades to the replica's own lazy prefill in
+        ``EngineReplica.submit``."""
+        if not self.enabled or not tokens:
+            return
+        key = (tuple(tokens), self.publisher.version)
+        pid = self._by_key.get(key)
+        if pid is None:
+            return                       # not a fleet-registered prefix
+        entry = self._entries[pid]
+        if entry.failed:
+            return                       # degraded: lazy per-replica
+        if replica.holds_prefix(tuple(tokens)):
+            entry.installed.add(replica.replica_id)
+            return
+        if entry.kv is None:
+            self._donate(entry, replica)
+        else:
+            # Late joiner / resurrected replica / was DRAINING during
+            # the broadcast: backfill from the stored buffer.
+            self._install(entry, replica)
+
+    def _donate(self, entry: _SharedPrefix,
+                replica: EngineReplica) -> None:
+        """First dispatch: ``replica`` pays the ONE prefill, then its
+        buffer broadcasts to every other live replica."""
+        try:
+            tokens, kv, last = replica.register_shared_prefix(
+                entry.tokens)
+        except Exception:
+            # Donor prefill failed (chaos / OOM): leave kv unset so the
+            # next dispatch elects a new donor; repeated failure is the
+            # replica fault path's problem, not the store's.
+            self._failures_total.inc()
+            return
+        entry.donor_id = replica.replica_id
+        entry.kv = kv
+        entry.last_logits = last
+        entry.installed.add(replica.replica_id)
+        for peer in self.replicas:
+            if (peer.replica_id == replica.replica_id
+                    or peer.state != LIVE):
+                continue
+            self._install(entry, peer)
+
+    def _install(self, entry: _SharedPrefix,
+                 replica: EngineReplica) -> bool:
+        from ..rollout.engine import PrefixImportError
+        t0 = time.perf_counter()
+        try:
+            replica.install_shared_prefix(entry.tokens, entry.kv,
+                                          entry.last_logits)
+        except PrefixImportError:
+            # Import refused: the buffer doesn't fit this pool's layout.
+            # That's a fleet-config property, not a transient — it would
+            # repeat on every replica, so degrade the whole entry to the
+            # lazy per-replica path.
+            self._failures_total.inc()
+            entry.failed = True
+            return False
+        except Exception:
+            # Replica-local blow-up (chaos / OOM): this replica serves
+            # via its own lazy prefill; the entry keeps broadcasting to
+            # the others.
+            self._failures_total.inc()
+            return False
+        entry.installed.add(replica.replica_id)
+        self._broadcasts_total.inc()
+        self._avoided_total.inc()
+        self._install_ms.observe((time.perf_counter() - t0) * 1000.0)
+        return True
+
+    # -- invalidation --------------------------------------------------------
+    def _on_publish(self, version: int) -> None:
+        """WeightPublisher.begin hook: every shared entry's KV belongs
+        to the OLD policy — drop them all (no version mixing). Stale
+        pids then fail :meth:`lookup` and submit raises KeyError."""
+        dropped = len(self._entries)
+        self._entries.clear()
+        self._by_key.clear()
+        if dropped:
+            self._invalidations_total.inc(dropped)
+        self._shared_gauge.set(0)

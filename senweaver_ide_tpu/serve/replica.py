@@ -79,9 +79,16 @@ class EngineReplica:
             "senweaver_serve_replica_faults_total",
             "Faults recorded against fleet replicas.",
             labelnames=("replica", "reason"))
+        self._decode_tokens_gauge = registry.gauge(
+            "senweaver_serve_replica_decode_tokens",
+            "Remaining decode tokens (max_new_tokens - emitted) across "
+            "this replica's in-flight requests — the router's "
+            "outstanding-work signal.",
+            labelnames=("replica",))
         self._state_gauge.set(0, replica=replica_id)
         self._inflight_gauge.set(0, replica=replica_id)
         self._version_gauge.set(0, replica=replica_id)
+        self._decode_tokens_gauge.set(0, replica=replica_id)
 
     # -- capacity / routing signals -----------------------------------------
     @property
@@ -94,6 +101,24 @@ class EngineReplica:
             return len(self.inflight)
 
     @property
+    def outstanding_decode_tokens(self) -> int:
+        """Remaining decode work in TOKENS: Σ max(0, max_new_tokens −
+        emitted) over in-flight requests. A replica holding two nearly-
+        finished generations has less outstanding work than one holding
+        a single fresh 512-token request — in-flight COUNT can't see
+        that; this can (the router's primary load signal)."""
+        with self._lock:
+            return sum(max(0, r.max_new_tokens - r.emitted)
+                       for r in self.inflight.values())
+
+    def _update_decode_gauge(self) -> None:
+        """Caller holds the lock."""
+        self._decode_tokens_gauge.set(
+            sum(max(0, r.max_new_tokens - r.emitted)
+                for r in self.inflight.values()),
+            replica=self.replica_id)
+
+    @property
     def accepting(self) -> bool:
         """Routable: live with a free decode slot."""
         with self._lock:
@@ -102,6 +127,35 @@ class EngineReplica:
     def holds_prefix(self, tokens: Tuple[int, ...]) -> bool:
         with self._lock:
             return tokens in self._prefixes
+
+    # -- shared prefix broadcast (serve/prefix_store.py) ---------------------
+    def register_shared_prefix(self, tokens: List[int]):
+        """Donor side of the fleet broadcast: prefill ``tokens`` locally
+        (once, content-deduped by the engine) and export the one-slot KV
+        buffer. Returns ``(tokens, kv, last_logits)``."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            key = tuple(tokens)
+            prefix_id = self._prefixes.get(key)
+            if prefix_id is None:
+                prefix_id = self.engine.register_prefix(list(tokens))
+                self._prefixes[key] = prefix_id
+            return self.engine.export_prefix(prefix_id)
+
+    def install_shared_prefix(self, tokens: List[int], kv,
+                              last_logits=None) -> int:
+        """Receive side: adopt a peer's prefix KV without prefilling
+        (``engine.import_prefix`` — device-to-device copy, validated,
+        LRU-accounted). Raises ``PrefixImportError`` on layout mismatch;
+        the store translates that into graceful degradation."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            prefix_id = self.engine.import_prefix(list(tokens), kv,
+                                                  last_logits)
+            self._prefixes[tuple(tokens)] = prefix_id
+            return prefix_id
 
     # -- lifecycle -----------------------------------------------------------
     def drain(self) -> None:
@@ -134,6 +188,7 @@ class EngineReplica:
             orphans = list(self.inflight.values())
             self.inflight.clear()
             self._inflight_gauge.set(0, replica=self.replica_id)
+            self._decode_tokens_gauge.set(0, replica=self.replica_id)
             return orphans
 
     def record_fault(self, reason: str = REASON_ERROR) -> bool:
@@ -175,6 +230,7 @@ class EngineReplica:
             self._consecutive_faults = 0
             self._inflight_gauge.set(len(self.inflight),
                                      replica=self.replica_id)
+            self._update_decode_gauge()
             return rid
 
     def adopt(self, rid: int, req: FleetRequest) -> None:
@@ -188,6 +244,7 @@ class EngineReplica:
             req.version_at_dispatch = self.weight_version
             self._inflight_gauge.set(len(self.inflight),
                                      replica=self.replica_id)
+            self._update_decode_gauge()
 
     def step(self) -> Tuple[Dict[int, List[int]], List[FleetRequest]]:
         """One engine step. Returns (emitted {engine_rid: [tokens]},
@@ -198,6 +255,10 @@ class EngineReplica:
                 return {}, []
             emitted = self.engine.step()
             self._consecutive_faults = 0
+            for rid, toks in emitted.items():
+                req = self.inflight.get(rid)
+                if req is not None:
+                    req.emitted += len(toks)
             done: List[FleetRequest] = []
             for rid in list(self.inflight):
                 if self.engine.is_done(rid):
@@ -205,6 +266,7 @@ class EngineReplica:
             if done:
                 self._inflight_gauge.set(len(self.inflight),
                                          replica=self.replica_id)
+            self._update_decode_gauge()
             return emitted, done
 
     def has_work(self) -> bool:

@@ -7,9 +7,13 @@ Two signals, in order:
    prefix's KV: installing it there is one HBM copy
    (``engine._install_prefix``) instead of a full prefill on a cold
    replica. Ties break by least outstanding work.
-2. **Least outstanding work** — otherwise the live replica with the
-   fewest in-flight requests wins (the classic least-loaded policy; with
-   uniform decode cost per step, in-flight count IS outstanding work).
+2. **Least outstanding work, in decode TOKENS** — otherwise the live
+   replica with the fewest REMAINING decode tokens wins
+   (Σ ``max_new_tokens − emitted`` over its in-flight requests, which
+   replicas already track per request). In-flight count treats a
+   replica two steps from draining the same as one holding fresh
+   512-token generations; remaining tokens is the actual queue-time
+   signal. Count is kept as the tiebreaker.
 
 Replica death is the router's second job: orphaned in-flight requests
 come back through :meth:`on_replica_death`, which either schedules a
@@ -65,13 +69,16 @@ class Router:
         accepting = [r for r in self.replicas if r.accepting]
         if not accepting:
             return None
+        def load(r: EngineReplica):
+            return (r.outstanding_decode_tokens, r.outstanding)
+
         if req.prefix_tokens:
             key = tuple(req.prefix_tokens)
             warm = [r for r in accepting if r.holds_prefix(key)]
             if warm:
                 self._affinity_hits.inc()
-                return min(warm, key=lambda r: r.outstanding)
-        return min(accepting, key=lambda r: r.outstanding)
+                return min(warm, key=load)
+        return min(accepting, key=load)
 
     # -- failure handling ----------------------------------------------------
     def on_replica_death(self, replica: EngineReplica, now: float
@@ -92,6 +99,7 @@ class Router:
             req.engine_rid = None
             req.version_at_dispatch = None
             req.first_token_at = None
+            req.emitted = 0     # partial tokens died with the replica
             if not have_survivors:
                 shed.append(Rejected(
                     ticket=req.ticket, priority=req.priority,

@@ -53,6 +53,16 @@ class WeightPublisher:
             "senweaver_serve_replicas_rolled_total",
             "Per-replica weight swaps completed.")
         self._skew_gauge.set(0)
+        # begin() observers, called with the NEW version the moment a
+        # publish is staged — before any replica swaps. The shared
+        # prefix store invalidates here: its KV belongs to the old
+        # policy from the instant a roll starts.
+        self._on_begin: List = []
+
+    def subscribe_begin(self, fn) -> None:
+        """Register ``fn(version)`` to run at every :meth:`begin`."""
+        with self._lock:
+            self._on_begin.append(fn)
 
     @property
     def in_progress(self) -> bool:
@@ -84,6 +94,8 @@ class WeightPublisher:
             self._roll_queue = [r for r in self.replicas
                                 if r.state != DEAD]
             self._current = None
+            for fn in self._on_begin:
+                fn(self.version)
             return self.version
 
     def advance(self) -> bool:

@@ -222,6 +222,16 @@ class DashboardService:
                                  if skew is not None else 0),
                 "ttft_ms_mean": hist_mean("senweaver_serve_ttft_ms"),
                 "e2e_ms_mean": hist_mean("senweaver_serve_e2e_ms"),
+                "prefix_broadcasts":
+                    total("senweaver_serve_prefix_broadcasts_total"),
+                "prefix_prefills_avoided": total(
+                    "senweaver_serve_prefix_prefills_avoided_total"),
+                "prefix_broadcast_failures": total(
+                    "senweaver_serve_prefix_broadcast_failures_total"),
+                "prefix_install_ms_mean":
+                    hist_mean("senweaver_serve_prefix_install_ms"),
+                "decode_tokens_outstanding": total(
+                    "senweaver_serve_replica_decode_tokens"),
             }
         except Exception as e:
             return {"error": str(e)}

@@ -1,0 +1,375 @@
+"""Fleet-shared prefix KV store + decode-aware EDF routing tests.
+
+Covers the serve/prefix_store.py broadcast protocol end to end —
+one-prefill/broadcast-to-all accounting, donor death, publish
+invalidation, late-replica backfill, graceful degradation — plus the
+engine-level ``import_prefix`` contract (typed errors, LRU accounting)
+and the router/admission upgrades (remaining-decode-token load signal,
+EDF within a priority class). Everything runs the tiny test model on
+CPU with deterministic greedy sampling and a fake clock.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout import RolloutEngine
+from senweaver_ide_tpu.rollout.engine import PrefixImportError
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import (AdmissionConfig, AdmissionQueue,
+                                     FleetRequest, INTERACTIVE, Router,
+                                     ServingFleet, TRAIN_ROLLOUT)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+PREFIX = [5, 9, 2, 7, 4, 4, 8]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_engine(model, num_slots=2, max_len=64, **kw):
+    params, config = model
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY, **kw)
+
+
+def make_fleet(model, n=4, **kw):
+    return ServingFleet([make_engine(model) for _ in range(n)], **kw)
+
+
+def fleet_engine_stat(fleet, key):
+    """Sum an engine stat across LIVE replicas (a dead replica's engine
+    object still reports, but it no longer serves)."""
+    return sum(r["engine"][key]
+               for r in fleet.stats()["replicas"].values()
+               if r["state"] != "dead"
+               and isinstance(r["engine"], dict) and key in r["engine"])
+
+
+def registry_total(name):
+    m = obs.get_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(float(v) for v in m.samples().values())
+
+
+# ---- engine-level import/export ------------------------------------------
+
+def test_import_prefix_token_exact(model):
+    """An imported prefix serves byte-identical tokens to a fresh
+    prefill — with a suffix, with zero suffix + donor logits, and with
+    zero suffix + NO donor logits (the 1-token re-feed path)."""
+    donor = make_engine(model)
+    pid = donor.register_prefix(PREFIX)
+    tokens, kv, last = donor.export_prefix(pid)
+    assert donor.stats()["prefix_prefills"] == 1
+    assert donor.stats()["prefix_exports"] == 1
+
+    ref_eng = make_engine(model)
+    r = ref_eng.submit(PREFIX + [1, 3], max_new_tokens=8)
+    ref_suffix = ref_eng.run()[r]
+    r = ref_eng.submit(list(PREFIX), max_new_tokens=8)
+    ref_exact = ref_eng.run()[r]
+
+    for last_arg in (last, None):
+        eng = make_engine(model)
+        ipid = eng.import_prefix(tokens, kv, last_arg)
+        stats = eng.stats()
+        assert stats["prefix_imports"] == 1
+        assert stats["prefix_prefills"] == 0
+        r = eng.submit(PREFIX + [1, 3], max_new_tokens=8, prefix_id=ipid)
+        assert eng.run()[r] == ref_suffix
+        r = eng.submit(list(PREFIX), max_new_tokens=8, prefix_id=ipid)
+        assert eng.run()[r] == ref_exact
+
+
+def test_import_prefix_typed_errors(model):
+    """Layout mismatches raise PrefixImportError (a ValueError), never
+    install silently: wrong pool shape, wrong dtype, wrong recorded
+    length. Content-duplicate imports dedup to the existing pid."""
+    donor = make_engine(model, max_len=64)
+    _, kv, last = donor.export_prefix(donor.register_prefix(PREFIX))
+
+    small = make_engine(model, max_len=32)      # different pool shape
+    with pytest.raises(PrefixImportError):
+        small.import_prefix(PREFIX, kv, last)
+
+    eng = make_engine(model, max_len=64)
+    bad_dtype = kv._replace(k=kv.k.astype(jnp.bfloat16),
+                            v=kv.v.astype(jnp.bfloat16))
+    with pytest.raises(PrefixImportError):
+        eng.import_prefix(PREFIX, bad_dtype, last)
+
+    with pytest.raises(PrefixImportError):      # 2 tokens declared, 7 in kv
+        eng.import_prefix(PREFIX[:2], kv, last)
+
+    assert isinstance(PrefixImportError("x"), ValueError)
+    pid1 = eng.import_prefix(PREFIX, kv, last)
+    pid2 = eng.import_prefix(PREFIX, kv, last)  # dedup, no second entry
+    assert pid1 == pid2
+    assert eng.stats()["prefix_imports"] == 1
+
+
+def test_import_prefix_lru_accounting(model):
+    """Imports charge the same LRU budget as local registrations: the
+    third distinct prefix on a max_prefixes=2 engine evicts the least
+    recently used one, which then 404s like any evicted prefix."""
+    donor = make_engine(model, max_prefixes=4)
+    exports = []
+    for i in range(3):
+        toks = PREFIX + [10 + i]
+        exports.append(donor.export_prefix(donor.register_prefix(toks)))
+
+    eng = make_engine(model, max_prefixes=2)
+    pids = [eng.import_prefix(t, kv, last) for t, kv, last in exports]
+    stats = eng.stats()
+    assert stats["prefix_imports"] == 3
+    assert stats["prefix_evictions"] == 1
+    with pytest.raises(KeyError):               # pid 0 was the LRU victim
+        eng.submit(PREFIX + [10, 1], max_new_tokens=2, prefix_id=pids[0])
+    r = eng.submit(PREFIX + [12, 1], max_new_tokens=2, prefix_id=pids[2])
+    assert eng.run()[r]
+
+
+# ---- fleet broadcast accounting ------------------------------------------
+
+def test_one_prefill_broadcast_to_all(model):
+    """Acceptance: 4-replica fleet, one fleet prefix → exactly 1 prefix
+    prefill and N−1 broadcast installs across the fleet, and prefix
+    requests complete token-identically to a single engine."""
+    fleet = make_fleet(model, n=4)
+    pid = fleet.register_prefix(PREFIX)
+    tickets = [fleet.submit(PREFIX + [i + 1], max_new_tokens=6,
+                            prefix_id=pid) for i in range(8)]
+    out = fleet.run()
+    assert all(t in out for t in tickets)
+
+    assert fleet_engine_stat(fleet, "prefix_prefills") == 1
+    assert fleet_engine_stat(fleet, "prefix_imports") == 3
+    assert registry_total(
+        "senweaver_serve_prefix_broadcasts_total") == 3
+    assert registry_total(
+        "senweaver_serve_prefix_prefills_avoided_total") == 3
+
+    single = make_engine(model)
+    spid = single.register_prefix(PREFIX)
+    rid = single.submit(PREFIX + [1], max_new_tokens=6, prefix_id=spid)
+    assert out[tickets[0]] == single.run()[rid]
+
+    snap = fleet.snapshot_event()
+    assert snap["prefix_prefills_avoided"] == 3
+    assert snap["prefix_install_count"] == 3
+
+
+def test_shared_prefix_chaos(model):
+    """The ISSUE's chaos sequence: kill the donor mid-run, then roll a
+    publish. (a) survivors serve from their installed copies without
+    any re-prefill; (b) post-publish submits with the stale pid raise
+    KeyError; (c) a late/resurrected replica is backfilled on its next
+    dispatch."""
+    params, _ = model
+    fleet = make_fleet(model, n=4)
+    pid = fleet.register_prefix(PREFIX)
+    t0 = fleet.submit(PREFIX + [1], max_new_tokens=4, prefix_id=pid)
+    fleet.run()
+    donor_id = fleet.prefix_store.lookup(pid).donor_id
+    assert donor_id is not None
+
+    # (a) donor dies; survivors keep serving the prefix with ZERO new
+    # prefix prefills (their installed copies survive the donor).
+    fleet.kill_replica(donor_id)
+    before = fleet_engine_stat(fleet, "prefix_prefills")
+    assert before == 0          # the 1 prefill died with the donor
+    tickets = [fleet.submit(PREFIX + [i + 2], max_new_tokens=4,
+                            prefix_id=pid) for i in range(4)]
+    out = fleet.run()
+    assert all(t in out for t in tickets)
+    assert fleet_engine_stat(fleet, "prefix_prefills") == 0
+    assert fleet_engine_stat(fleet, "prefix_cache_hits") >= 4
+
+    # (b) a publish drops every shared entry; the old pid is stale.
+    fleet.update_params(params)
+    assert fleet.prefix_store.stats()["shared_prefixes"] == 0
+    with pytest.raises(KeyError):
+        fleet.submit(PREFIX + [1], max_new_tokens=4, prefix_id=pid)
+    assert registry_total(
+        "senweaver_serve_prefix_invalidations_total") == 1
+
+    # (c) re-register under the new version; a freshly added replica is
+    # backfilled (import, not prefill) on its first prefix dispatch.
+    pid2 = fleet.register_prefix(PREFIX)
+    t = fleet.submit(PREFIX + [9], max_new_tokens=4, prefix_id=pid2)
+    fleet.run()
+    newcomer = fleet.add_replica(make_engine(model))
+    for r in fleet.replicas:
+        if r.replica_id != newcomer.replica_id and r.state != "dead":
+            fleet.kill_replica(r.replica_id)
+    t = fleet.submit(PREFIX + [11], max_new_tokens=4, prefix_id=pid2)
+    assert t in fleet.run()
+    stats = newcomer.engine.stats()
+    assert stats["prefix_imports"] == 1
+    assert stats["prefix_prefills"] == 0
+
+
+def test_register_prefix_dedup_is_indexed(model):
+    """Content-identical registrations dedup to one pid via the
+    (tokens, version) index — and a publish namespaces pids by
+    version, so the same tokens get a NEW pid afterwards."""
+    params, _ = model
+    fleet = make_fleet(model, n=2)
+    pid = fleet.register_prefix(PREFIX)
+    assert fleet.register_prefix(PREFIX) == pid
+    assert fleet.register_prefix(list(PREFIX)) == pid
+    other = fleet.register_prefix(PREFIX + [1])
+    assert other != pid
+    store = fleet.prefix_store
+    assert store.stats()["shared_prefixes"] == 2
+    assert (tuple(PREFIX), fleet.publisher.version) in store._by_key
+    fleet.update_params(params)
+    assert fleet.register_prefix(PREFIX) != pid
+
+
+def test_broadcast_failure_degrades_to_lazy(model):
+    """An install that raises PrefixImportError (foreign pool layout)
+    marks the entry failed: serving continues via each replica's lazy
+    register_prefix — slower, never wedged."""
+    fleet = make_fleet(model, n=2)
+    # Sabotage: replica-1's engine pool is a different shape, so the
+    # donor's buffer can never install there.
+    fleet.replicas[1].engine = make_engine(model, max_len=32)
+    pid = fleet.register_prefix(PREFIX)
+    tickets = [fleet.submit(PREFIX + [i + 1], max_new_tokens=4,
+                            prefix_id=pid) for i in range(4)]
+    out = fleet.run()
+    assert all(t in out for t in tickets)
+    assert registry_total(
+        "senweaver_serve_prefix_broadcast_failures_total") >= 1
+    assert fleet.prefix_store.lookup(pid).failed
+    # every replica that served the prefix prefilled it itself
+    assert fleet_engine_stat(fleet, "prefix_imports") == 0
+
+
+def test_broadcast_can_be_disabled(model):
+    """shared_prefix_broadcast=False restores the pre-store behavior:
+    per-replica lazy prefill, zero imports."""
+    fleet = make_fleet(model, n=2, shared_prefix_broadcast=False)
+    pid = fleet.register_prefix(PREFIX)
+    tickets = [fleet.submit(PREFIX + [i + 1], max_new_tokens=4,
+                            prefix_id=pid) for i in range(4)]
+    out = fleet.run()
+    assert all(t in out for t in tickets)
+    assert fleet_engine_stat(fleet, "prefix_imports") == 0
+    assert registry_total(
+        "senweaver_serve_prefix_broadcasts_total") == 0
+
+
+# ---- decode-aware routing + EDF ------------------------------------------
+
+class _StubReplica:
+    def __init__(self, rid, decode_tokens, count, warm=False):
+        self.replica_id = rid
+        self.outstanding_decode_tokens = decode_tokens
+        self.outstanding = count
+        self.accepting = True
+        self._warm = warm
+
+    def holds_prefix(self, key):
+        return self._warm
+
+
+def test_router_prefers_fewest_remaining_decode_tokens():
+    """A replica with ONE fresh 500-token request is busier than one
+    with THREE nearly-done requests: remaining decode tokens ranks
+    them correctly where in-flight count inverts them."""
+    fresh = _StubReplica("fresh", decode_tokens=500, count=1)
+    draining = _StubReplica("draining", decode_tokens=6, count=3)
+    router = Router([fresh, draining])
+    req = FleetRequest(ticket=0, prompt=[1], max_new_tokens=4)
+    assert router.pick(req).replica_id == "draining"
+    # count is the tiebreaker at equal token load
+    a = _StubReplica("a", decode_tokens=10, count=2)
+    b = _StubReplica("b", decode_tokens=10, count=1)
+    assert Router([a, b]).pick(req).replica_id == "b"
+    # prefix affinity still dominates the load signal
+    warm = _StubReplica("warm", decode_tokens=500, count=2, warm=True)
+    cold = _StubReplica("cold", decode_tokens=0, count=0)
+    preq = FleetRequest(ticket=1, prompt=list(PREFIX) + [1],
+                        max_new_tokens=4, prefix_tokens=list(PREFIX))
+    assert Router([warm, cold]).pick(preq).replica_id == "warm"
+
+
+def test_replica_tracks_remaining_decode_tokens(model):
+    """EngineReplica.outstanding_decode_tokens = Σ(max_new_tokens −
+    emitted) shrinks as decoding progresses, and the gauge tracks it."""
+    fleet = make_fleet(model, n=1)
+    replica = fleet.replicas[0]
+    fleet.submit([3, 1, 4], max_new_tokens=10)
+    fleet.step()        # dispatch + first step
+    start = replica.outstanding_decode_tokens
+    assert 0 < start <= 10
+    fleet.step()
+    assert replica.outstanding_decode_tokens < start
+    gauge = obs.get_registry().get(
+        "senweaver_serve_replica_decode_tokens")
+    assert gauge is not None
+    val = gauge.samples().get((replica.replica_id,))
+    assert val == replica.outstanding_decode_tokens
+
+
+def test_edf_orders_within_class():
+    """Within one priority class the tightest queue-wait deadline
+    dispatches first (EDF); deadline-less requests follow in FIFO
+    order; priority classes still strictly dominate."""
+    q = AdmissionQueue(AdmissionConfig(), now=0.0)
+
+    def req(ticket, priority=TRAIN_ROLLOUT, deadline=None):
+        r = FleetRequest(ticket=ticket, prompt=[1], max_new_tokens=4,
+                         priority=priority, deadline=deadline,
+                         submitted_at=0.0)
+        assert q.offer(r, 0.0) is None
+        return r
+
+    req(0, deadline=30.0)
+    req(1)                      # no deadline
+    req(2, deadline=10.0)       # tightest — must go first
+    req(3, deadline=20.0)
+    req(4)                      # no deadline, after ticket 1
+
+    order = []
+    while True:
+        picked, sheds = q.pop_ready(1.0)
+        assert not sheds
+        if picked is None:
+            break
+        order.append(picked.ticket)
+    assert order == [2, 3, 0, 1, 4]
+
+    # interactive beats a tighter train_rollout deadline
+    req(5, deadline=5.0)
+    req(6, priority=INTERACTIVE, deadline=50.0)
+    picked, _ = q.pop_ready(1.0)
+    assert picked.ticket == 6
+
+    # not_before (retry backoff) is honored without losing the slot
+    r7 = req(7, deadline=300.0)
+    r7.not_before = 100.0
+    req(8, deadline=9.0)
+    picked, _ = q.pop_ready(1.0)
+    assert picked.ticket == 5   # 7 is backing off, 5 is next-tightest
+    picked, _ = q.pop_ready(1.0)
+    assert picked.ticket == 8
+    picked, _ = q.pop_ready(150.0)      # backoff floor passed
+    assert picked.ticket == 7
