@@ -1,0 +1,65 @@
+"""CLI for the static-analysis gate.
+
+    python -m senweaver_ide_tpu.analysis             # human output
+    python -m senweaver_ide_tpu.analysis --json      # machine output
+    python -m senweaver_ide_tpu.analysis --no-baseline   # raw findings
+
+Exit codes: 0 clean (every finding baselined), 1 non-baselined findings
+or invalid baseline, 2 usage errors. Stale baseline entries (matching
+nothing — the violation was fixed but the allowlist kept it) are
+reported and make the gate fail too: a baseline that can only grow is
+how allowlists rot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (BaselineError, collect_findings, load_baseline,
+               apply_baseline)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m senweaver_ide_tpu.analysis",
+        description="JAX purity + lock-discipline static analysis gate")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore analysis/baseline.json")
+    parser.add_argument("--baseline", default=None,
+                        help="alternate baseline file")
+    args = parser.parse_args(argv)
+
+    findings = collect_findings()
+    try:
+        entries = ([] if args.no_baseline
+                   else load_baseline(args.baseline))
+    except BaselineError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    result = apply_baseline(findings, entries)
+
+    if args.json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in result.new],
+            "baselined": [f.to_dict() for f in result.baselined],
+            "stale_baseline_entries": result.stale,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in result.new:
+            print(f.format())
+        for e in result.stale:
+            print(f"stale baseline entry: {e['rule']} {e['path']} "
+                  f"[{e['symbol']}] — no longer fires; remove it")
+        print(f"{len(result.new)} finding(s), "
+              f"{len(result.baselined)} baselined, "
+              f"{len(result.stale)} stale baseline entr(y/ies)")
+
+    return 1 if (result.new or result.stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,7 @@ class _Metric:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
-        self._cells: Dict[Tuple[str, ...], Any] = {}
+        self._cells: Dict[Tuple[str, ...], Any] = {}  # guarded-by: _lock
 
     def _key(self, labels: Dict[str, Any]) -> Tuple[str, ...]:
         if set(labels) != set(self.labelnames):
@@ -185,7 +185,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
 
     def _get_or_make(self, cls, name: str, help_text: str,
                      labelnames: Sequence[str], **kw) -> _Metric:

@@ -311,16 +311,16 @@ class RolloutEngine:
                              length=jnp.zeros((num_slots,), jnp.int32),
                              k_scale=ks0, v_scale=vs0)
         self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
-        self._slot_req: List[Optional[_Request]] = [None] * num_slots
+        self._slot_req: List[Optional[_Request]] = [None] * num_slots  # guarded-by: _lock
         # rid holding each slot's KV across turns (hold_slot), or None
-        self._slot_held: List[Optional[int]] = [None] * num_slots
+        self._slot_held: List[Optional[int]] = [None] * num_slots  # guarded-by: _lock
         # monotonic hold sequence per slot: eviction drops the OLDEST
         self._hold_seq = 0
-        self._slot_hold_seq: List[int] = [0] * num_slots
+        self._slot_hold_seq: List[int] = [0] * num_slots  # guarded-by: _lock
         # serving observability (read via stats()): how often the reuse
         # machinery actually engages — the metricsService-style counters
         # for the engine plane (SURVEY.md §5 observability).
-        self._stats = {"prefills": 0, "prefill_tokens": 0,
+        self._stats = {"prefills": 0, "prefill_tokens": 0,  # guarded-by: _lock
                        "batched_prefills": 0, "batched_prefill_slots": 0,
                        "prefix_installs": 0, "prefix_tokens_reused": 0,
                        "prefix_evictions": 0, "prefix_prefills": 0,
@@ -333,25 +333,25 @@ class RolloutEngine:
         # QueueFull past this many QUEUED requests — in-flight slots and
         # continuations (which bypass the queue) don't count.
         self.max_queue = max_queue
-        self._queue: Deque[_Request] = deque()
-        self._requests: Dict[int, _Request] = {}
-        self._next_rid = 0
+        self._queue: Deque[_Request] = deque()  # guarded-by: _lock
+        self._requests: Dict[int, _Request] = {}  # guarded-by: _lock
+        self._next_rid = 0                      # guarded-by: _lock
         # Tokens sampled during prefill, to be surfaced by the next step().
-        self._pending_emits: Dict[int, List[int]] = {}
+        self._pending_emits: Dict[int, List[int]] = {}  # guarded-by: _lock
         # Prefix cache: shared prompt prefixes (the agent system prompt)
         # prefilled ONCE into a pool-slot-shaped KV buffer and HBM-copied
         # into each slot that reuses them (replacing recompute).
-        self._prefixes: Dict[int, tuple] = {}
-        self._prefix_by_tokens: Dict[tuple, int] = {}   # content dedup
-        self._next_prefix_id = 0
+        self._prefixes: Dict[int, tuple] = {}   # guarded-by: _lock
+        self._prefix_by_tokens: Dict[tuple, int] = {}  # guarded-by: _lock
+        self._next_prefix_id = 0                # guarded-by: _lock
         # HBM budget for registered prefixes: each holds one pool-slot-
         # shaped KV buffer, so COUNT is the natural budget unit. LRU
         # eviction mirrors hold eviction — dropped prefixes silently
         # fall back to a full prefill (and auto_prefix clients
         # re-register on the KeyError).
         self.max_prefixes = max(1, int(max_prefixes))
-        self._prefix_last_use: Dict[int, int] = {}
-        self._prefix_use_seq = 0
+        self._prefix_last_use: Dict[int, int] = {}  # guarded-by: _lock
+        self._prefix_use_seq = 0                # guarded-by: _lock
         # Many agent loops (subagent threads) drive one engine: all state
         # mutation is serialized; concurrency = slots, not host threads.
         self._lock = threading.RLock()
@@ -408,6 +408,7 @@ class RolloutEngine:
                 prefix_id: Optional[int] = None,
                 hold_slot: bool = False,
                 continue_from: Optional[int] = None) -> int:
+        # guarded-by: caller
         if not prompt:
             raise ValueError("empty prompt")
         if continue_from is not None:
@@ -465,6 +466,7 @@ class RolloutEngine:
             return self._step()
 
     def _step(self) -> Dict[int, List[int]]:
+        # guarded-by: caller
         self._schedule()
         emitted = self._pending_emits
         self._pending_emits = {}
@@ -481,11 +483,13 @@ class RolloutEngine:
                 step_key, self.sample)
             self.cur_tok = next_tok
             self._stats["decode_steps"] += 1
-            # np.asarray blocks on the device step, so the span spans the
-            # actual decode, not just its dispatch.
-            toks = np.asarray(next_tok)
-            logps = np.asarray(logp)
-            lengths = np.asarray(self.cache.length)
+            # ONE batched device→host transfer per decode step (the
+            # analysis JIT110 budget): three separate np.asarray calls
+            # were three blocking roundtrips. device_get still blocks on
+            # the device step, so the span spans the actual decode, not
+            # just its dispatch.
+            toks, logps, lengths = jax.device_get(
+                (next_tok, logp, self.cache.length))
         if tracer.enabled:
             reg = get_registry()
             reg.counter("senweaver_engine_decode_steps_total",
@@ -552,6 +556,7 @@ class RolloutEngine:
     def _submit_continuation(self, prompt: List[int], *,
                              max_new_tokens: int, eos_id: Optional[int],
                              hold_slot: bool, continue_from: int) -> int:
+        # guarded-by: caller
         """Multi-turn continuation: append only the NEW tokens to a held
         slot's resident KV (hold_slot=True on the previous turn), instead
         of re-prefilling the whole conversation. ``prompt`` is the FULL
@@ -727,10 +732,16 @@ class RolloutEngine:
                 raise PrefixImportError(
                     f"prefix quantization {kv.quantized} != pool "
                     f"quantization {self.cache.quantized}")
-            if int(jax.device_get(kv.length)) != len(tokens):
+            # One batched admission sync: the declared-length check and
+            # the first-token logits come over in a single transfer.
+            got = jax.device_get(
+                (kv.length,) if last_logits is None
+                else (kv.length, last_logits))
+            kv_len = int(got[0])
+            last = got[1] if len(got) > 1 else None
+            if kv_len != len(tokens):
                 raise PrefixImportError(
-                    f"prefix KV records length "
-                    f"{int(jax.device_get(kv.length))} but "
+                    f"prefix KV records length {kv_len} but "
                     f"{len(tokens)} tokens were declared")
             while len(self._prefixes) >= self.max_prefixes:
                 lru = min(self._prefix_last_use,
@@ -744,8 +755,6 @@ class RolloutEngine:
             else:
                 dev = next(iter(self.cache.k.devices()))
                 placed = jax.device_put(kv, dev)
-            last = (None if last_logits is None
-                    else np.asarray(jax.device_get(last_logits)))
             pid = self._next_prefix_id
             self._next_prefix_id += 1
             self._prefixes[pid] = (list(tokens), placed, last)
@@ -755,6 +764,7 @@ class RolloutEngine:
             return pid
 
     def _touch_prefix(self, pid: int) -> None:
+        # guarded-by: caller
         self._prefix_use_seq += 1
         self._prefix_last_use[pid] = self._prefix_use_seq
 
@@ -770,6 +780,7 @@ class RolloutEngine:
 
     def _emit_first_token(self, req: "_Request", slot: int,
                           last_logits) -> None:
+        # guarded-by: caller
         """Sample and book-keep a request's first token after prefill
         (used by both fresh prefills and turn continuations)."""
         self._key, tok_key = jax.random.split(self._key)
@@ -777,9 +788,13 @@ class RolloutEngine:
                             temperature=self.sample.temperature,
                             top_k=self.sample.top_k,
                             top_p=self.sample.top_p)
-        tok0_i = int(tok0[0])
+        # One batched sync for (token, logprob) — not an int() plus a
+        # separate float(), which would be two device roundtrips.
+        tok0_h, logp0_h = jax.device_get(
+            (tok0[0], sampled_logprob(last_logits, tok0[0])))
+        tok0_i = int(tok0_h)
         req.tokens.append(tok0_i)
-        req.logps.append(float(sampled_logprob(last_logits, tok0[0])))
+        req.logps.append(float(logp0_h))
         self._stats["tokens_emitted"] += 1
         self._pending_emits.setdefault(req.rid, []).append(tok0_i)
         self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
@@ -788,6 +803,7 @@ class RolloutEngine:
             self._finish_request(req, slot)
 
     def _finish_request(self, req: "_Request", slot: int) -> None:
+        # guarded-by: caller
         """Mark a request done and either hold or free its slot."""
         req.done = True
         self._slot_req[slot] = None
@@ -804,6 +820,7 @@ class RolloutEngine:
             req.slot = None
 
     def _drop_hold(self, slot: int) -> None:
+        # guarded-by: caller
         """Invalidate a held conversation and free its slot."""
         rid = self._slot_held[slot]
         if rid is None:
@@ -831,6 +848,7 @@ class RolloutEngine:
                 if self._slot_req[s] is None and self._slot_held[s] is None]
 
     def _schedule(self) -> None:
+        # guarded-by: caller
         """Prefill queued requests into free slots (continuous batching).
 
         Same-bucket fresh prefills at the queue front batch into ONE
@@ -895,6 +913,7 @@ class RolloutEngine:
             self._schedule_single_impl(req, slot)
 
     def _schedule_single_impl(self, req: "_Request", slot: int) -> None:
+        # guarded-by: caller
         req.slot = slot
         self._slot_req[slot] = req
         true_len = len(req.prompt)
@@ -961,6 +980,7 @@ class RolloutEngine:
 
     def _schedule_batch_impl(self, group: List["_Request"],
                              slots: List[int], bucket: int) -> None:
+        # guarded-by: caller
         n = len(group)
         n_pad = 1
         while n_pad < n:

@@ -105,6 +105,12 @@ class FleetRequest:
     replica_id: Optional[str] = None
     engine_rid: Optional[int] = None
     version_at_dispatch: Optional[int] = None
+    # Stamped by the replica UNDER ITS LOCK at the instant the request
+    # is popped from ``inflight`` — the fleet must not re-read
+    # ``replica.weight_version`` at completion time, because the
+    # publisher may legally swap weights between the pop (zero
+    # in-flight) and the fleet's bookkeeping.
+    version_at_finish: Optional[int] = None
     first_token_at: Optional[float] = None
     dispatched_at: Optional[float] = None
 

@@ -112,9 +112,9 @@ class ServingFleet:
             self.replicas, self.publisher, registry=registry,
             enabled=shared_prefix_broadcast)
         self._lock = threading.RLock()
-        self._next_ticket = 0
-        self._requests: Dict[int, FleetRequest] = {}
-        self._outcomes: Dict[int, Union[Completed, Rejected]] = {}
+        self._next_ticket = 0                   # guarded-by: _lock
+        self._requests: Dict[int, FleetRequest] = {}    # guarded-by: _lock
+        self._outcomes: Dict[int, Union[Completed, Rejected]] = {}  # guarded-by: _lock
         self._dispatcher: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._requests_total = registry.counter(
@@ -207,6 +207,7 @@ class ServingFleet:
                              max_new_tokens: int, eos_id: Optional[int],
                              hold_slot: bool, continue_from: int,
                              priority: str) -> int:
+        # guarded-by: caller
         """Turn continuation: pinned to the replica holding the slot's
         KV, dispatched immediately (it extends a conversation that
         already passed admission). Raises ValueError when the slot is
@@ -385,9 +386,9 @@ class ServingFleet:
             replica = (engine if isinstance(engine, EngineReplica)
                        else EngineReplica(replica_id, engine,
                                           registry=self.registry))
-            replica.weight_version = self.publisher.version
-            replica._version_gauge.set(self.publisher.version,
-                                       replica=replica.replica_id)
+            # Through the replica's own locked mutator: weight_version
+            # is guarded by replica._lock, not ours (analysis LOCK102).
+            replica.stamp_version(self.publisher.version)
             # router and publisher hold their own list copies; the
             # prefix store shares self.replicas by reference.
             self.replicas.append(replica)
@@ -625,6 +626,7 @@ class ServingFleet:
 
     def _complete(self, replica: EngineReplica, req: FleetRequest,
                   now: float) -> None:
+        # guarded-by: caller
         tokens = replica.engine.result(req.engine_rid)
         logps = replica.engine.result_logps(req.engine_rid)
         e2e_ms = (now - req.submitted_at) * 1000.0
@@ -635,7 +637,9 @@ class ServingFleet:
             weight_version=(req.version_at_dispatch
                             if req.version_at_dispatch is not None
                             else replica.weight_version),
-            weight_version_at_finish=replica.weight_version,
+            weight_version_at_finish=(req.version_at_finish
+                                      if req.version_at_finish is not None
+                                      else replica.weight_version),
             attempts=req.attempts,
             ttft_ms=(None if req.first_token_at is None
                      else (req.first_token_at - req.submitted_at)
@@ -648,6 +652,7 @@ class ServingFleet:
         # Admission already counted its own sheds; router/fleet-origin
         # rejections (replica_failure / no_replicas) are counted here —
         # same counter, so the shed rate is one number.
+        # guarded-by: caller
         if rej.reason in (REJECT_REPLICA_FAILURE, REJECT_NO_REPLICAS):
             self._shed_total.inc(priority=rej.priority,
                                  reason=rej.reason)

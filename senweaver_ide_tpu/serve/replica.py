@@ -48,15 +48,15 @@ class EngineReplica:
                  registry=None):
         self.replica_id = replica_id
         self.engine = engine
-        self.state = LIVE
-        self.weight_version = 0
+        self.state = LIVE                       # guarded-by: _lock
+        self.weight_version = 0                 # guarded-by: _lock
         self.max_consecutive_faults = max(1, int(max_consecutive_faults))
-        self._consecutive_faults = 0
+        self._consecutive_faults = 0            # guarded-by: _lock
         # engine rid -> FleetRequest, the router's outstanding-work signal
-        self.inflight: Dict[int, FleetRequest] = {}
+        self.inflight: Dict[int, FleetRequest] = {}  # guarded-by: _lock
         # prefix tokens (tuple) -> engine prefix_id; cleared on weight
         # install (engine.update_params drops old-policy prefix KV)
-        self._prefixes: Dict[tuple, int] = {}
+        self._prefixes: Dict[tuple, int] = {}   # guarded-by: _lock
         self._lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -262,7 +262,14 @@ class EngineReplica:
             done: List[FleetRequest] = []
             for rid in list(self.inflight):
                 if self.engine.is_done(rid):
-                    done.append(self.inflight.pop(rid))
+                    req = self.inflight.pop(rid)
+                    # Capture the finish version here, while we still
+                    # hold the lock that install_weights needs: once we
+                    # return, in-flight may be zero and the publisher
+                    # can swap weights before the fleet records the
+                    # completion.
+                    req.version_at_finish = self.weight_version
+                    done.append(req)
             if done:
                 self._inflight_gauge.set(len(self.inflight),
                                          replica=self.replica_id)
@@ -288,6 +295,16 @@ class EngineReplica:
             self.engine.update_params(params)
             self.weight_version = int(version)
             self._prefixes.clear()      # engine dropped old-policy KV
+            self._version_gauge.set(version, replica=self.replica_id)
+
+    def stamp_version(self, version: int) -> None:
+        """Record the fleet's current published version on a replica
+        that just joined (no weight transfer — the caller constructed it
+        with current params). The fleet must NOT write
+        ``weight_version`` directly: that attribute is guarded by THIS
+        object's lock, which the fleet's own lock doesn't cover."""
+        with self._lock:
+            self.weight_version = int(version)
             self._version_gauge.set(version, replica=self.replica_id)
 
     # -- stepper thread (threaded mode) --------------------------------------

@@ -98,6 +98,7 @@ class Router:
             req.replica_id = None
             req.engine_rid = None
             req.version_at_dispatch = None
+            req.version_at_finish = None
             req.first_token_at = None
             req.emitted = 0     # partial tokens died with the replica
             if not have_survivors:

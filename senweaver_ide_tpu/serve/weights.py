@@ -35,10 +35,11 @@ class WeightPublisher:
     def __init__(self, replicas: Sequence[EngineReplica], *,
                  registry=None):
         self.replicas = list(replicas)
-        self.version = 0            # latest PUBLISHED (begun) version
-        self._pending_params = None
-        self._roll_queue: List[EngineReplica] = []
-        self._current: Optional[EngineReplica] = None
+        # latest PUBLISHED (begun) version
+        self.version = 0                        # guarded-by: _lock
+        self._pending_params = None             # guarded-by: _lock
+        self._roll_queue: List[EngineReplica] = []  # guarded-by: _lock
+        self._current: Optional[EngineReplica] = None  # guarded-by: _lock
         self._lock = threading.RLock()
         if registry is None:
             from ..obs import get_registry
@@ -57,7 +58,7 @@ class WeightPublisher:
         # publish is staged — before any replica swaps. The shared
         # prefix store invalidates here: its KV belongs to the old
         # policy from the instant a roll starts.
-        self._on_begin: List = []
+        self._on_begin: List = []               # guarded-by: _lock
 
     def subscribe_begin(self, fn) -> None:
         """Register ``fn(version)`` to run at every :meth:`begin`."""

@@ -60,9 +60,11 @@ class TraceCollector:
                  max_spans_per_trace: int = MAX_SPANS_PER_TRACE,
                  flush_interval_s: float = FLUSH_INTERVAL_S,
                  span_sink: Optional[Callable[[bytes], Any]] = None):
-        self._traces: Dict[str, Trace] = {}
-        self._active: Dict[str, str] = {}  # thread_id -> trace_id
-        self._feedbacks: Dict[str, Optional[str]] = {}  # "thread:idx" -> feedback
+        self._traces: Dict[str, Trace] = {}     # guarded-by: _lock
+        # thread_id -> trace_id
+        self._active: Dict[str, str] = {}       # guarded-by: _lock
+        # "thread:idx" -> feedback
+        self._feedbacks: Dict[str, Optional[str]] = {}  # guarded-by: _lock
         self._lock = threading.RLock()
         self._store = store
         self._reward_fn = reward_fn
@@ -73,8 +75,8 @@ class TraceCollector:
         # every accepted span is serialized and handed over, fire-and-forget
         # like the reference's queueMicrotask writes.
         self._span_sink = span_sink
-        self._last_flush = time.time()
-        self._dirty = False
+        self._last_flush = time.time()          # guarded-by: _lock
+        self._dirty = False                     # guarded-by: _lock
         if store is not None:
             for tr in store.load():
                 self._traces[tr.id] = tr
@@ -304,6 +306,7 @@ class TraceCollector:
                     timestamp=_now_ms(), data=data)
 
     def _add_span(self, tr: Trace, span: Span) -> None:
+        # guarded-by: caller
         if len(tr.spans) >= self._max_spans:  # ref :275-277 overflow guard
             return
         tr.spans.append(span)
@@ -328,6 +331,7 @@ class TraceCollector:
         self._maybe_flush()
 
     def _enforce_bounds(self) -> None:
+        # guarded-by: caller
         if len(self._traces) <= self._max_traces:
             return
         # Keep the newest (ref _saveToStorage :339-349).

@@ -65,7 +65,7 @@ class _SessionCounter:
 
     def __init__(self, start: int = 1):
         self._lock = threading.Lock()
-        self._next = int(start)
+        self._next = int(start)                 # guarded-by: _lock
 
     def __next__(self) -> int:
         with self._lock:

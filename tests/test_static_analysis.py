@@ -1,0 +1,597 @@
+"""Unit tests for the analysis/ gates: one true-positive and one
+true-negative per lint rule, baseline machinery, the dynamic lock-order
+recorder (including a deliberately seeded A→B/B→A cycle), and the
+whole-package gate that CI runs.
+
+Everything here is pure AST / pure threading — no jax arrays — so this
+file is fast and runs identically on any platform.
+"""
+
+import json
+import textwrap
+import threading
+
+import pytest
+
+from senweaver_ide_tpu import analysis
+from senweaver_ide_tpu.analysis import jit_lint, lock_lint
+from senweaver_ide_tpu.analysis.findings import (BaselineError, Finding,
+                                                 apply_baseline,
+                                                 load_baseline)
+from senweaver_ide_tpu.analysis.lock_order import LockOrderRecorder
+
+
+def _jit(src, **kw):
+    return jit_lint.lint_source(textwrap.dedent(src), **kw)
+
+
+def _lock(src):
+    return lock_lint.lint_source(textwrap.dedent(src))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# JIT101 — host-sync call in traced code
+# ---------------------------------------------------------------------------
+
+def test_jit101_true_positive():
+    fs = _jit("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            y = jnp.sum(x)
+            return y.item()
+    """)
+    assert "JIT101" in _rules(fs)
+    (f,) = [f for f in fs if f.rule == "JIT101"]
+    assert f.symbol == "f" and f.line > 0 and f.hint
+
+
+def test_jit101_true_negative_outside_jit():
+    # The same .item() is fine in plain host code.
+    fs = _jit("""
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.sum(x).item()
+    """)
+    assert "JIT101" not in _rules(fs)
+
+
+def test_jit101_reachable_helper():
+    # The sync hides one call DOWN from the jit root.
+    fs = _jit("""
+        import jax, jax.numpy as jnp
+
+        def helper(x):
+            return x.tolist()
+
+        @jax.jit
+        def f(x):
+            return helper(x)
+    """)
+    assert any(f.rule == "JIT101" and f.symbol == "helper" for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT102 — Python cast of a traced value
+# ---------------------------------------------------------------------------
+
+def test_jit102_true_positive():
+    fs = _jit("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return int(jnp.argmax(x))
+    """)
+    assert "JIT102" in _rules(fs)
+
+
+def test_jit102_true_negative_static_arg():
+    # Casting a static (non-tracer) argument is fine.
+    fs = _jit("""
+        import jax
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            return x * int(n)
+    """)
+    assert "JIT102" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT103 — print / logging at trace time
+# ---------------------------------------------------------------------------
+
+def test_jit103_true_positive():
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            print("tracing!", x)
+            return x
+    """)
+    assert "JIT103" in _rules(fs)
+
+
+def test_jit103_true_negative_debug_print():
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+    """)
+    assert "JIT103" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT104 — nonlocal/global/closure mutation in traced code
+# ---------------------------------------------------------------------------
+
+def test_jit104_true_positive_global():
+    fs = _jit("""
+        import jax
+
+        STEPS = 0
+
+        @jax.jit
+        def f(x):
+            global STEPS
+            STEPS += 1
+            return x
+    """)
+    assert "JIT104" in _rules(fs)
+
+
+def test_jit104_true_positive_closure_append():
+    fs = _jit("""
+        import jax
+
+        TRACE_LOG = []
+
+        @jax.jit
+        def f(x):
+            TRACE_LOG.append(1)
+            return x
+    """)
+    assert "JIT104" in _rules(fs)
+
+
+def test_jit104_true_negative_local_list():
+    # Mutating a LOCAL list while tracing is fine (pure construction).
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            parts = []
+            parts.append(x)
+            return parts[0]
+    """)
+    assert "JIT104" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT110 — hot host path exceeds the one-sync-per-step budget
+# ---------------------------------------------------------------------------
+
+def test_jit110_true_positive():
+    fs = _jit("""
+        import numpy as np
+        import jax
+
+        def decode_step(arrs: "jax.Array"):
+            a = np.asarray(arrs)
+            b = arrs.item()
+            return a, b
+    """, hot=True)
+    assert len([f for f in fs if f.rule == "JIT110"]) == 2
+
+
+def test_jit110_true_negative_single_batched_sync():
+    fs = _jit("""
+        import jax
+
+        def decode_step(a: "jax.Array", b: "jax.Array"):
+            ah, bh = jax.device_get((a, b))
+            return ah, bh
+    """, hot=True)
+    assert "JIT110" not in _rules(fs)
+
+
+def test_jit110_not_applied_to_cold_modules():
+    fs = _jit("""
+        import jax
+
+        def setup(a: "jax.Array", b: "jax.Array"):
+            return jax.device_get(a), jax.device_get(b)
+    """, hot=False)
+    assert "JIT110" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT201 — Python branch on a traced value
+# ---------------------------------------------------------------------------
+
+def test_jit201_true_positive():
+    fs = _jit("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            if jnp.sum(x) > 0:
+                return x
+            return -x
+    """)
+    assert "JIT201" in _rules(fs)
+
+
+def test_jit201_true_negative_structure_checks():
+    # `is None` and shape checks are trace-static — no finding.
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is not None and x.shape[0] > 1:
+                return x * mask
+            return x
+    """)
+    assert "JIT201" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT202 — loop bounded by a traced value
+# ---------------------------------------------------------------------------
+
+def test_jit202_true_positive():
+    fs = _jit("""
+        import jax, jax.numpy as jnp
+
+        @jax.jit
+        def f(x, n):
+            acc = x
+            for _ in range(n):
+                acc = acc + 1
+            return acc
+    """)
+    assert "JIT202" in _rules(fs)
+
+
+def test_jit202_true_negative_static_bound():
+    fs = _jit("""
+        import jax
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def f(x, n):
+            for _ in range(n):
+                x = x + 1
+            return x
+    """)
+    assert "JIT202" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT203 — set iteration under tracing
+# ---------------------------------------------------------------------------
+
+def test_jit203_true_positive():
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(params):
+            out = 0
+            for k in set(params):
+                out = out + params[k]
+            return out
+    """)
+    assert "JIT203" in _rules(fs)
+
+
+def test_jit203_true_negative_sorted():
+    fs = _jit("""
+        import jax
+
+        @jax.jit
+        def f(params):
+            out = 0
+            for k in sorted(params):
+                out = out + params[k]
+            return out
+    """)
+    assert "JIT203" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# JIT301 — unhashable static_argnames
+# ---------------------------------------------------------------------------
+
+def test_jit301_true_positive():
+    fs = _jit("""
+        import jax
+        import functools
+        from typing import List
+
+        @functools.partial(jax.jit, static_argnames=("shape",))
+        def f(x, shape: List[int]):
+            return x.reshape(shape)
+    """)
+    assert "JIT301" in _rules(fs)
+
+
+def test_jit301_true_negative_tuple():
+    fs = _jit("""
+        import jax
+        import functools
+        from typing import Tuple
+
+        @functools.partial(jax.jit, static_argnames=("shape",))
+        def f(x, shape: Tuple[int, ...]):
+            return x.reshape(shape)
+    """)
+    assert "JIT301" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# LOCK101 — guarded attribute written outside its lock
+# ---------------------------------------------------------------------------
+
+LOCKED_CLASS = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._count = 0          # guarded-by: _lock
+
+        def bump_unlocked(self):
+            self._count += 1
+
+        def bump_locked(self):
+            with self._lock:
+                self._count += 1
+
+        def _bump_caller_holds(self):
+            # guarded-by: caller
+            self._count += 1
+
+        def _bump_docstring(self):
+            \"\"\"Caller holds the lock.\"\"\"
+            self._count += 1
+"""
+
+
+def test_lock101_true_positive_and_negatives():
+    fs = _lock(LOCKED_CLASS)
+    assert [f.symbol for f in fs if f.rule == "LOCK101"] == \
+        ["Counter.bump_unlocked"]
+
+
+def test_lock101_mutating_method_call():
+    fs = _lock("""
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []     # guarded-by: _lock
+
+            def push(self, x):
+                self._items.append(x)
+
+            def pop(self):
+                with self._lock:
+                    return self._items.pop()
+    """)
+    assert [f.symbol for f in fs if f.rule == "LOCK101"] == ["Q.push"]
+
+
+def test_lock101_init_exempt_and_unannotated_free():
+    fs = _lock("""
+        import threading
+
+        class Free:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._guarded = 0    # guarded-by: _lock
+                self._guarded = 1    # re-assign in __init__: fine
+                self.plain = 0       # unannotated: never checked
+
+            def poke(self):
+                self.plain += 1
+    """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# LOCK102 — cross-object write to a guarded attribute
+# ---------------------------------------------------------------------------
+
+CROSS_OBJECT = """
+    import threading
+
+    class Replica:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.weight_epoch = 0    # guarded-by: _lock
+
+        def stamp(self, v):
+            with self._lock:
+                self.weight_epoch = v
+
+    class Fleet:
+        def __init__(self, replica):
+            self._lock = threading.Lock()
+            self.replica = replica
+
+        def bad_stamp(self, r, v):
+            with self._lock:
+                r.weight_epoch = v
+
+        def good_stamp(self, r, v):
+            r.stamp(v)
+"""
+
+
+def test_lock102_true_positive_and_negative():
+    fs = _lock(CROSS_OBJECT)
+    assert [f.symbol for f in fs if f.rule == "LOCK102"] == \
+        ["Fleet.bad_stamp"]
+
+
+# ---------------------------------------------------------------------------
+# findings / baseline machinery
+# ---------------------------------------------------------------------------
+
+def _finding(rule="JIT101", path="a.py", symbol="f", line=3):
+    return Finding(rule=rule, path=path, line=line, symbol=symbol,
+                   message="m", hint="h")
+
+
+def test_baseline_matches_on_symbol_not_line():
+    entries = [{"rule": "JIT101", "path": "a.py", "symbol": "f",
+                "reason": "documented"}]
+    res = apply_baseline([_finding(line=3), _finding(line=99)], entries)
+    assert res.new == [] and len(res.baselined) == 2 and res.stale == []
+
+
+def test_baseline_stale_entry_reported():
+    entries = [{"rule": "JIT101", "path": "a.py", "symbol": "gone",
+                "reason": "documented"}]
+    res = apply_baseline([_finding()], entries)
+    assert len(res.new) == 1 and res.stale == entries
+
+
+def test_baseline_requires_reason(tmp_path):
+    p = tmp_path / "baseline.json"
+    p.write_text(json.dumps({"entries": [
+        {"rule": "JIT101", "path": "a.py", "symbol": "f"}]}))
+    with pytest.raises(BaselineError):
+        load_baseline(str(p))
+
+
+# ---------------------------------------------------------------------------
+# dynamic lock-order recorder
+# ---------------------------------------------------------------------------
+
+def test_lock_order_detects_seeded_cycle():
+    rec = LockOrderRecorder(scope=None)
+    with rec:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        with lock_a:
+            with lock_b:        # A -> B
+                pass
+        with lock_b:
+            with lock_a:        # B -> A: the seeded inversion
+                pass
+    assert rec.cycles(), "A->B/B->A inversion must be a cycle"
+    with pytest.raises(AssertionError) as err:
+        rec.assert_acyclic()
+    assert "cycle" in str(err.value)
+
+
+def test_lock_order_acyclic_across_threads():
+    rec = LockOrderRecorder(scope=None)
+    with rec:
+        outer = threading.Lock()
+        inner = threading.Lock()
+
+        def worker():
+            for _ in range(50):
+                with outer:
+                    with inner:
+                        pass
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert rec.cycles() == []
+    assert ("%s" % rec.order_pairs()).count("(") >= 1
+    rec.assert_acyclic()
+
+
+def test_lock_order_rlock_reentrancy_is_not_an_edge():
+    rec = LockOrderRecorder(scope=None)
+    with rec:
+        r = threading.RLock()
+        with r:
+            with r:             # reentrant: same instance, no edge
+                pass
+    assert rec.cycles() == []
+    assert rec.order_pairs() == []
+
+
+def test_lock_order_scope_filter_skips_foreign_locks():
+    rec = LockOrderRecorder(scope="no_such_path_component")
+    with rec:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert rec.order_pairs() == []      # nothing instrumented
+    assert rec.cycles() == []
+
+
+def test_lock_order_uninstall_restores_factories():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    rec = LockOrderRecorder(scope=None)
+    with rec:
+        assert threading.Lock is not orig_lock
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+
+
+# ---------------------------------------------------------------------------
+# the whole-package gate (what CI runs)
+# ---------------------------------------------------------------------------
+
+def test_package_gate_is_clean():
+    result = analysis.run_package()
+    msgs = "\n".join(f.format() for f in result.new)
+    assert result.new == [], f"non-baselined findings:\n{msgs}"
+    assert result.stale == [], f"stale baseline entries: {result.stale}"
+
+
+def test_package_baseline_is_small_and_documented():
+    entries = load_baseline()
+    assert len(entries) <= 10
+    for e in entries:
+        assert len(e["reason"]) > 20   # a real sentence, not "ok"
+
+
+def test_package_gate_flags_real_regressions(tmp_path):
+    # End-to-end: drop a package with a violation on disk and make sure
+    # the gate convicts it (guards against the linter rotting into a
+    # no-op while the suite stays green).
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            print(x)
+            return x.item()
+    """))
+    result = analysis.run_package(root=str(pkg),
+                                  baseline_path=str(tmp_path / "nb.json"))
+    assert {"JIT101", "JIT103"} <= _rules(result.new)
