@@ -555,6 +555,99 @@ def _measure_prefix_fleet(*, n_replicas: int = 4, prefix_len: int = 48,
     }
 
 
+def _measure_fleet_remote(*, n_replicas: int = 4,
+                          n_requests: int = 8) -> dict:
+    """Cross-host dispatch economics: a loopback remote fleet
+    (serve/remote.py — full RPC framing, idempotency keys, breaker
+    bookkeeping; no sockets) vs the in-process fleet on the same
+    engines, plus the cost of a held-slot continuation replay after
+    the holder dies. Protocol-level numbers on the tiny model: the
+    acceptance signal is dispatch overhead small relative to decode
+    e2e, and replay latency ≈ one extra full prefill."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.resilience import RetryPolicy
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.serve import (EngineRpcHandler,
+                                         LoopbackTransport,
+                                         RemoteReplica, ServingFleet)
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    policy = RetryPolicy(max_retries=1, base_delay_s=0.0, jitter=False)
+
+    def engines():
+        return [RolloutEngine(params, config, num_slots=2, max_len=64,
+                              sample=greedy) for _ in range(n_replicas)]
+
+    def drive(fleet) -> dict:
+        t0 = _time.perf_counter()
+        tickets = [fleet.submit([11 + i, 22 + i, 33 + i],
+                                max_new_tokens=8)
+                   for i in range(n_requests)]
+        fleet.run()
+        wall = _time.perf_counter() - t0
+        e2es = [fleet.outcome(t).e2e_ms for t in tickets]
+        return {"wall_s": wall,
+                "e2e_ms_mean": sum(e2es) / max(1, len(e2es))}
+
+    def build_remote():
+        return ServingFleet(
+            [RemoteReplica(f"replica-{i}",
+                           LoopbackTransport(
+                               EngineRpcHandler(e),
+                               target=f"replica-{i}"),
+                           policy=policy, sleep=lambda s: None)
+             for i, e in enumerate(engines())],
+            retry_base_delay_s=0.0)
+
+    obs._reset_for_tests()
+    drive(ServingFleet(engines()))          # warm the jit caches
+    drive(build_remote())
+    # Interleave repetitions and keep the best of each mode: at the
+    # tiny model's ~50 ms scale, scheduler noise swamps a single run.
+    local = min((drive(ServingFleet(engines())) for _ in range(3)),
+                key=lambda r: r["e2e_ms_mean"])
+    remote_fleet = build_remote()
+    remote = min([drive(remote_fleet)] +
+                 [drive(build_remote()) for _ in range(2)],
+                 key=lambda r: r["e2e_ms_mean"])
+
+    # Held-slot continuation replay latency: holder dies, the full
+    # transcript re-prefills on a survivor.
+    held = remote_fleet.submit([5, 9, 2, 7], max_new_tokens=4,
+                               hold_slot=True)
+    remote_fleet.run()
+    out1 = list(remote_fleet.outcome(held).tokens)
+    remote_fleet.kill_replica(remote_fleet._requests[held].replica_id)
+    t0 = _time.perf_counter()
+    t2 = remote_fleet.submit([5, 9, 2, 7] + out1 + [6, 1],
+                             max_new_tokens=4, continue_from=held)
+    remote_fleet.run()
+    replay_ms = (_time.perf_counter() - t0) * 1000.0
+    assert remote_fleet.outcome(t2) is not None
+    obs._reset_for_tests()
+    return {
+        "replicas": n_replicas,
+        "requests": n_requests,
+        "e2e_ms_local": round(local["e2e_ms_mean"], 2),
+        "e2e_ms_remote": round(remote["e2e_ms_mean"], 2),
+        "dispatch_overhead_ms": round(
+            remote["e2e_ms_mean"] - local["e2e_ms_mean"], 2),
+        "dispatch_overhead_pct": round(
+            100.0 * (remote["e2e_ms_mean"] - local["e2e_ms_mean"])
+            / max(1e-9, local["e2e_ms_mean"]), 1),
+        "continuation_replay_ms": round(replay_ms, 2),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -663,6 +756,14 @@ def main() -> None:
         extra["prefix_fleet"] = _measure_prefix_fleet()
     except Exception as e:
         extra["prefix_fleet"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Cross-host dispatch economics (loopback remote fleet vs the same
+    # engines in-process) plus held-slot continuation replay latency.
+    try:
+        _log("remote fleet measure: fleet_remote")
+        extra["fleet_remote"] = _measure_fleet_remote()
+    except Exception as e:
+        extra["fleet_remote"] = f"error: {type(e).__name__}: {e}"[:200]
 
     baseline = _baseline()
     metric = (f"decode_tokens_per_sec_per_chip[{model_name}"

@@ -18,17 +18,24 @@ holds the policy objects they share.
 """
 
 from .chaos import (ChaosEngine, ChaosError, ChaosSession, EngineFault,
-                    EPISODE_FAULT_KINDS, FaultPlan, FaultSpec)
+                    EPISODE_FAULT_KINDS, FaultPlan, FaultSpec,
+                    NETWORK_FAULT_KINDS, NetworkFault, NetworkFaultPlan)
 from .faults import (FailedEpisode, REASON_ERROR, REASON_TIMEOUT,
                      ResilienceConfig, episode_retry_delay_s)
 from .guard import (REASON_LOSS_SPIKE, REASON_NONFINITE_GRAD,
                     REASON_NONFINITE_LOSS, UpdateGuard)
+from .retry import (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
+                    CircuitBreaker, RetryBudget, RetryPolicy,
+                    parse_retry_after)
 
 __all__ = [
     "ChaosEngine", "ChaosError", "ChaosSession", "EngineFault",
     "EPISODE_FAULT_KINDS", "FaultPlan", "FaultSpec",
+    "NETWORK_FAULT_KINDS", "NetworkFault", "NetworkFaultPlan",
     "FailedEpisode", "REASON_ERROR", "REASON_TIMEOUT",
     "ResilienceConfig", "episode_retry_delay_s",
     "REASON_LOSS_SPIKE", "REASON_NONFINITE_GRAD", "REASON_NONFINITE_LOSS",
     "UpdateGuard",
+    "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN",
+    "CircuitBreaker", "RetryBudget", "RetryPolicy", "parse_retry_after",
 ]

@@ -35,6 +35,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 EPISODE_FAULT_KINDS = ("raise", "hang", "nan_reward")
 ENGINE_FAULT_KINDS = ("raise", "hang")
+NETWORK_FAULT_KINDS = ("drop", "drop_response", "delay", "http_500",
+                       "partition")
 
 
 class ChaosError(RuntimeError):
@@ -226,6 +228,136 @@ class ChaosSession:
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+@dataclasses.dataclass(frozen=True)
+class NetworkFault:
+    """One scheduled network fault on the remote-replica transport.
+
+    Matching: ``target`` (the loopback transport's peer name; None = any
+    peer) and ``method`` (the rpc method; None = any). ``call_idx``
+    selects WHICH matching call fires the fault (0-based index among
+    calls that match this spec's filters; None = the first ``times``
+    matching calls). Kinds, in increasing nastiness:
+
+    - ``drop``          — the request never reaches the server (refused /
+                          reset → ``RpcTransportError``); safe to retry.
+    - ``drop_response`` — the server EXECUTES the call but the response
+                          is lost (→ ``RpcTimeout``). The dangerous one:
+                          a naive retry double-executes; the idempotent
+                          request-id cache is what makes it safe.
+    - ``delay``         — the response takes ``delay_s``. When that
+                          meets or exceeds the caller's timeout this is
+                          ``drop_response`` with extra steps (executed,
+                          then ``RpcTimeout``); under the timeout it is
+                          just latency (a slow-drip host the hedged
+                          probes must NOT declare dead).
+    - ``http_500``      — the server answers 5xx before executing
+                          (→ ``RpcServerError``); safe to retry.
+    - ``partition``     — this call and EVERY subsequent call to the
+                          target fail with ``RpcTransportError`` until
+                          :meth:`NetworkFaultPlan.heal`.
+    """
+
+    kind: str                   # one of NETWORK_FAULT_KINDS
+    target: Optional[str] = None
+    method: Optional[str] = None
+    call_idx: Optional[int] = None
+    times: int = 1
+    delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in NETWORK_FAULT_KINDS:
+            raise ValueError(f"unknown network fault kind {self.kind!r} "
+                             f"(want one of {NETWORK_FAULT_KINDS})")
+
+
+class NetworkFaultPlan:
+    """Deterministic schedule of network faults for loopback transports.
+
+    The serve-side twin of :class:`FaultPlan`: each
+    ``serve.rpc.LoopbackTransport`` consults :meth:`take` before (and
+    for response-loss kinds, after) delivering a call, so the remote-
+    fleet chaos tests inject drops, partitions, 5xx, and slow-drip
+    latency at exact call coordinates with no sockets and no real time.
+    Everything consumed is logged to :attr:`injected` and mirrored on
+    ``senweaver_chaos_network_faults_total{kind=}``.
+    """
+
+    def __init__(self, faults: Sequence[NetworkFault] = (), *,
+                 registry=None):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._remaining = [f.times for f in self.faults]  # guarded-by: _lock
+        # per-fault count of calls that matched its filters so far
+        self._seen = [0 for _ in self.faults]             # guarded-by: _lock
+        self._partitioned: set = set()                    # guarded-by: _lock
+        self.injected: List[Tuple[str, Tuple[str, str]]] = []  # guarded-by: _lock
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._injected_total = registry.counter(
+            "senweaver_chaos_network_faults_total",
+            "Network faults injected into the remote-replica transport",
+            labelnames=("kind",))
+
+    def partition(self, target: str) -> None:
+        """Partition ``target`` immediately (outside any call)."""
+        with self._lock:
+            self._partitioned.add(target)
+            self.injected.append(("partition", (target, "*")))
+            self._injected_total.inc(kind="partition")
+
+    def heal(self, target: Optional[str] = None) -> None:
+        """Lift the partition on ``target`` (None = all)."""
+        with self._lock:
+            if target is None:
+                self._partitioned.clear()
+            else:
+                self._partitioned.discard(target)
+
+    def is_partitioned(self, target: str) -> bool:
+        with self._lock:
+            return target in self._partitioned
+
+    def take(self, target: str, method: str) -> Optional[NetworkFault]:
+        """Consume the fault (if any) scheduled for this call. An active
+        partition dominates every scheduled fault."""
+        with self._lock:
+            if target in self._partitioned:
+                return NetworkFault(kind="partition", target=target)
+            # Every spec's call counter advances on every matching call
+            # (even when another spec fires), so a spec's ``call_idx``
+            # coordinate never depends on which other faults exist.
+            fired: Optional[Tuple[int, NetworkFault]] = None
+            for i, f in enumerate(self.faults):
+                if f.target is not None and f.target != target:
+                    continue
+                if f.method is not None and f.method != method:
+                    continue
+                seen = self._seen[i]
+                self._seen[i] += 1
+                if f.call_idx is not None and seen != f.call_idx:
+                    continue
+                if self._remaining[i] <= 0 or fired is not None:
+                    continue
+                fired = (i, f)
+            if fired is None:
+                return None
+            i, f = fired
+            self._remaining[i] -= 1
+            if f.kind == "partition":
+                self._partitioned.add(target)
+            self.injected.append((f.kind, (target, method)))
+            self._injected_total.inc(kind=f.kind)
+            return f
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for kind, _ in self.injected:
+                out[kind] = out.get(kind, 0) + 1
+            return out
 
 
 class ChaosEngine:

@@ -15,16 +15,28 @@ from .admission import (AdmissionConfig, AdmissionQueue, ClassPolicy,
                         RequestRejected, TRAIN_ROLLOUT, TokenBucket)
 from .frontend import Completed, ServingFleet
 from .prefix_store import SharedPrefixStore
+from .remote import (PROBE_DEAD, PROBE_OK, PROBE_SLOW,
+                     RemoteEngineClient, RemoteReplica)
+from .remote_server import EngineRpcHandler, serve_engine_http
 from .replica import (DEAD, DRAINING, EngineReplica, LIVE, ReplicaDead)
 from .router import Router
+from .rpc import (HttpTransport, LoopbackTransport, RpcApplicationError,
+                  RpcCircuitOpen, RpcError, RpcProtocolError,
+                  RpcServerError, RpcTimeout, RpcTransportError)
 from .weights import WeightPublisher
 
 __all__ = [
     "AdmissionConfig", "AdmissionQueue", "ClassPolicy", "Completed",
-    "DEAD", "DRAINING", "EngineReplica", "FleetRequest", "INTERACTIVE",
-    "LIVE", "PRIORITY_CLASSES", "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
+    "DEAD", "DRAINING", "EngineReplica", "EngineRpcHandler",
+    "FleetRequest", "HttpTransport", "INTERACTIVE",
+    "LIVE", "LoopbackTransport", "PRIORITY_CLASSES",
+    "PROBE_DEAD", "PROBE_OK", "PROBE_SLOW",
+    "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
     "REJECT_QUEUE_FULL", "REJECT_RATE_LIMITED", "REJECT_REPLICA_FAILURE",
-    "Rejected", "ReplicaDead", "RequestRejected", "Router",
-    "ServingFleet", "SharedPrefixStore", "TRAIN_ROLLOUT", "TokenBucket",
-    "WeightPublisher",
+    "Rejected", "RemoteEngineClient", "RemoteReplica", "ReplicaDead",
+    "RequestRejected", "Router", "RpcApplicationError", "RpcCircuitOpen",
+    "RpcError", "RpcProtocolError", "RpcServerError", "RpcTimeout",
+    "RpcTransportError", "ServingFleet", "SharedPrefixStore",
+    "TRAIN_ROLLOUT", "TokenBucket", "WeightPublisher",
+    "serve_engine_http",
 ]

@@ -42,13 +42,14 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
-from ..resilience.faults import REASON_ERROR, episode_retry_delay_s
+from ..resilience.faults import REASON_ERROR, REASON_TIMEOUT
 from .admission import (AdmissionConfig, AdmissionQueue, FleetRequest,
                         REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
                         Rejected, RequestRejected, TRAIN_ROLLOUT)
 from .prefix_store import SharedPrefixStore
 from .replica import DEAD, EngineReplica
 from .router import Router
+from .rpc import RpcError
 from .weights import WeightPublisher
 
 
@@ -81,7 +82,8 @@ class ServingFleet:
                  retry_max_delay_s: float = 2.0,
                  max_consecutive_faults: int = 3,
                  metrics_service=None,
-                 shared_prefix_broadcast: bool = True):
+                 shared_prefix_broadcast: bool = True,
+                 probe_interval_s: float = 1.0):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if registry is None:
@@ -141,6 +143,15 @@ class ServingFleet:
             "senweaver_serve_replicas_live",
             "Replicas not DEAD.")
         self._replicas_live.set(len(self.replicas))
+        self._continuation_replays = registry.counter(
+            "senweaver_serve_continuation_replays_total",
+            "Held-slot turn continuations replayed on a survivor after "
+            "their replica died (full re-prefill of the transcript "
+            "instead of the ValueError fallback).")
+        # Hedged health probing of replicas that support it (remote
+        # ones); local replicas have no probe() and are skipped.
+        self.probe_interval_s = float(probe_interval_s)
+        self._last_probe_at: Optional[float] = None  # guarded-by: _lock
 
     # -- single-engine API superset ------------------------------------------
     @property
@@ -210,31 +221,61 @@ class ServingFleet:
         # guarded-by: caller
         """Turn continuation: pinned to the replica holding the slot's
         KV, dispatched immediately (it extends a conversation that
-        already passed admission). Raises ValueError when the slot is
-        gone — same contract as the engine, so clients fall back to a
-        full prefill."""
+        already passed admission).
+
+        When the holding replica is dead/gone (or alive but its slot is
+        lost — e.g. the id was resurrected under a fresh engine), the
+        conversation is NOT lost: the engine's continuation contract
+        passes the FULL token stream, so ``prompt`` is the complete
+        transcript — the fleet re-prefills it on a survivor and re-pins
+        the ticket there (``senweaver_serve_continuation_replays_total``).
+        ValueError only when no survivor can take it — the same contract
+        as the engine, so clients still have their full-prefill
+        fallback."""
         prev = self._requests.get(continue_from)
         if prev is None or prev.replica_id is None:
             raise ValueError(
                 f"continue_from={continue_from}: unknown ticket")
         replica = next((r for r in self.replicas
                         if r.replica_id == prev.replica_id), None)
-        if replica is None or replica.state == DEAD:
-            raise ValueError(
-                f"continue_from={continue_from}: replica "
-                f"{prev.replica_id} is gone; slot released")
         now = self.clock()
         req = FleetRequest(
             ticket=ticket, prompt=list(prompt),
             max_new_tokens=max_new_tokens, priority=priority,
             eos_id=eos_id, hold_slot=hold_slot, submitted_at=now)
+        if replica is not None and replica.state != DEAD:
+            try:
+                rid = replica.engine.submit(
+                    prompt, max_new_tokens=max_new_tokens,
+                    continue_from=prev.engine_rid, hold_slot=hold_slot,
+                    eos_id=eos_id)
+            except (ValueError, KeyError, RpcError):
+                # The slot is gone even though the replica id answers
+                # (fresh engine behind a resurrected id), or a remote
+                # holder is unreachable: survivor replay below.
+                replica = None
+            else:
+                self._requests[ticket] = req
+                replica.adopt(rid, req)
+                req.dispatched_at = now
+                return ticket
+        # Survivor replay: full re-prefill of the recorded transcript,
+        # slot re-held on whichever live replica the router picks.
+        survivor = self.router.pick(req)
+        if survivor is None:
+            raise ValueError(
+                f"continue_from={continue_from}: replica "
+                f"{prev.replica_id} is gone and no survivor accepts; "
+                f"slot released")
+        kwargs = dict(max_new_tokens=max_new_tokens,
+                      hold_slot=hold_slot, eos_id=eos_id)
+        if getattr(survivor.engine, "supports_idempotency", False):
+            kwargs["idempotency_key"] = f"cont-{ticket}"
+        rid = survivor.engine.submit(list(prompt), **kwargs)
         self._requests[ticket] = req
-        rid = replica.engine.submit(
-            prompt, max_new_tokens=max_new_tokens,
-            continue_from=prev.engine_rid, hold_slot=hold_slot,
-            eos_id=eos_id)
-        replica.adopt(rid, req)
+        survivor.adopt(rid, req)
         req.dispatched_at = now
+        self._continuation_replays.inc()
         return ticket
 
     def register_prefix(self, tokens: List[int]) -> int:
@@ -308,6 +349,8 @@ class ServingFleet:
         with self._lock:
             now = self.clock()
             self.publisher.advance()
+            self._reap_quarantined(now)
+            self._probe_replicas(now)
             for rej in self.admission.shed_expired(now):
                 self._record_rejection(rej)
             self._dispatch(now)
@@ -381,8 +424,20 @@ class ServingFleet:
         with self._lock:
             if replica_id is None:
                 replica_id = f"replica-{len(self.replicas)}"
-            if self._replica_by_id(replica_id) is not None:
-                raise ValueError(f"replica id {replica_id!r} taken")
+            existing = self._replica_by_id(replica_id)
+            if existing is not None:
+                if existing.state != DEAD:
+                    raise ValueError(f"replica id {replica_id!r} taken")
+                # Resurrection: the id's previous incarnation is DEAD —
+                # drop the carcass from every membership list (fleet,
+                # router load tracking, publisher roll set) and from the
+                # prefix store's installed sets, so the new engine is
+                # lazily backfilled instead of assumed warm.
+                self.replicas.remove(existing)
+                self.router.replicas.remove(existing)
+                if existing in self.publisher.replicas:
+                    self.publisher.replicas.remove(existing)
+                self.prefix_store.forget_replica(replica_id)
             replica = (engine if isinstance(engine, EngineReplica)
                        else EngineReplica(replica_id, engine,
                                           registry=self.registry))
@@ -425,6 +480,8 @@ class ServingFleet:
                 with self._lock:
                     now = self.clock()
                     self.publisher.advance()
+                    self._reap_quarantined(now)
+                    self._probe_replicas(now)
                     for rej in self.admission.shed_expired(now):
                         self._record_rejection(rej)
                     self._dispatch(now)
@@ -538,6 +595,18 @@ class ServingFleet:
                     "senweaver_serve_prefix_invalidations_total"),
                 "prefix_install_ms_sum": inst_sum,
                 "prefix_install_count": inst_n,
+                "remote_rpcs": ctotal(
+                    "senweaver_serve_remote_rpcs_total"),
+                "remote_rpc_retries": ctotal(
+                    "senweaver_serve_remote_rpc_retries_total"),
+                "remote_rpc_errors": ctotal(
+                    "senweaver_serve_remote_rpc_errors_total"),
+                "breaker_opens": ctotal(
+                    "senweaver_serve_remote_breaker_opens_total"),
+                "continuation_replays": ctotal(
+                    "senweaver_serve_continuation_replays_total"),
+                "publish_quarantined": ctotal(
+                    "senweaver_serve_publish_quarantined_total"),
                 "ttft_by_priority": ttft_buckets(),
             }
 
@@ -598,10 +667,8 @@ class ServingFleet:
                             detail=f"submit failed "
                                    f"{req.attempts} times"))
                     else:
-                        req.not_before = now + episode_retry_delay_s(
-                            req.attempts,
-                            base_s=self.router.retry_base_delay_s,
-                            max_s=self.router.retry_max_delay_s)
+                        req.not_before = now + self.router.retry.backoff_s(
+                            req.attempts)
                         self.admission.requeue(req)
 
     def _ingest(self, replica: EngineReplica,
@@ -627,8 +694,39 @@ class ServingFleet:
     def _complete(self, replica: EngineReplica, req: FleetRequest,
                   now: float) -> None:
         # guarded-by: caller
-        tokens = replica.engine.result(req.engine_rid)
-        logps = replica.engine.result_logps(req.engine_rid)
+        try:
+            tokens = replica.engine.result(req.engine_rid)
+            logps = replica.engine.result_logps(req.engine_rid)
+        except Exception:
+            # The replica vanished between emitting ``done`` and the
+            # result fetch (a remote holder partitioned mid-handoff, or
+            # its breaker opened). The finished tokens died with it —
+            # route the request through the SAME retry/shed triage as a
+            # death orphan instead of losing an admitted ticket.
+            self._record_fault(replica, now)
+            req.attempts += 1
+            req.replica_id = None
+            req.engine_rid = None
+            req.version_at_dispatch = None
+            req.version_at_finish = None
+            req.first_token_at = None
+            req.emitted = 0
+            if not self.router.live_replicas():
+                self._record_rejection(Rejected(
+                    ticket=req.ticket, priority=req.priority,
+                    reason=REJECT_NO_REPLICAS,
+                    detail="result lost and no live replicas"))
+            elif req.attempts > self.router.max_retries:
+                self._record_rejection(Rejected(
+                    ticket=req.ticket, priority=req.priority,
+                    reason=REJECT_REPLICA_FAILURE,
+                    detail=f"result fetch failed after "
+                           f"{req.attempts - 1} retries"))
+            else:
+                req.not_before = now + self.router.retry.backoff_s(
+                    req.attempts)
+                self.admission.requeue(req)
+            return
         e2e_ms = (now - req.submitted_at) * 1000.0
         self._outcomes[req.ticket] = Completed(
             ticket=req.ticket, priority=req.priority,
@@ -674,6 +772,36 @@ class ServingFleet:
             for rej in self.admission.shed_all(
                     REJECT_NO_REPLICAS, "no live replicas"):
                 self._record_rejection(rej)
+
+    def _reap_quarantined(self, now: float) -> None:
+        """Turn publish-quarantined replicas (install unreachable mid-
+        roll) into proper deaths: the publisher has no router, so orphan
+        triage and live-count bookkeeping happen here."""
+        # guarded-by: caller
+        for replica in self.publisher.take_quarantined():
+            if replica.state != DEAD:
+                self._handle_death(replica, now)
+
+    def _probe_replicas(self, now: float) -> None:
+        """Hedged health probing of probe-capable (remote) replicas.
+        A PROBE_DEAD outcome records a timeout fault — the SAME
+        escalation budget real dispatch faults use — so a host that
+        stops answering dies through the one LIVE→DEAD path; PROBE_SLOW
+        is latency, counted but never lethal."""
+        # guarded-by: caller
+        if self.probe_interval_s <= 0:
+            return
+        if (self._last_probe_at is not None
+                and now - self._last_probe_at < self.probe_interval_s):
+            return
+        self._last_probe_at = now
+        for replica in list(self.replicas):
+            probe = getattr(replica, "probe", None)
+            if probe is None or replica.state == DEAD:
+                continue
+            if probe(now) == "dead":
+                if replica.record_fault(REASON_TIMEOUT):
+                    self._handle_death(replica, now)
 
     def _reap_faulted(self, now: float) -> None:
         """Threaded mode: stepper threads can only RECORD faults; the

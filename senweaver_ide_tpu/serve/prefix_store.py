@@ -214,6 +214,18 @@ class SharedPrefixStore:
         self._install_ms.observe((time.perf_counter() - t0) * 1000.0)
         return True
 
+    def forget_replica(self, replica_id: str) -> None:
+        """Drop ``replica_id`` from every entry's installed/donor
+        bookkeeping — a replica id being resurrected under a FRESH
+        engine holds none of the KV its predecessor did, so it must
+        fall back into the lazy-backfill set (``ensure`` reinstalls on
+        its next prefix-bearing dispatch). Retained donor buffers stay:
+        they are host/device copies, valid independent of the donor."""
+        for entry in self._entries.values():
+            entry.installed.discard(replica_id)
+            if entry.donor_id == replica_id:
+                entry.donor_id = None
+
     # -- invalidation --------------------------------------------------------
     def _on_publish(self, version: int) -> None:
         """WeightPublisher.begin hook: every shared entry's KV belongs

@@ -1,0 +1,201 @@
+"""Server shim: one local RolloutEngine behind the rpc protocol.
+
+:class:`EngineRpcHandler` is the whole remote side of the cross-host
+fleet — a method-dispatch table over one engine plus the one piece of
+state that makes retries SAFE: a bounded **idempotency cache** keyed by
+the client's ``request_id``. A retried mutating call (the client saw a
+timeout; the server may or may not have executed) replays the cached
+outcome instead of executing twice — that is the exactly-once half of
+the fleet's no-loss/no-double-execution guarantee (the retry policy is
+the no-loss half). Cached outcomes include application ERRORS: a submit
+that raised ValueError raises the same ValueError on replay rather than
+accidentally succeeding the second time.
+
+:func:`serve_engine_http` wraps the handler in a stdlib
+``ThreadingHTTPServer`` speaking the :data:`~.rpc.RPC_PATH` JSON frame —
+the real-socket deployment path. Tests mostly skip it and hand the
+handler to a ``LoopbackTransport``; one end-to-end test drives the HTTP
+stack on 127.0.0.1 to keep the wire honest.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .rpc import RPC_PATH, RpcApplicationError, RpcProtocolError, decode, \
+    encode
+
+# Methods that change engine state; only these consult/populate the
+# idempotency cache (reads are naturally idempotent and must see fresh
+# state — a cached ``step`` replay is correct, a cached ``health`` lie).
+MUTATING_METHODS = frozenset({
+    "submit", "step", "release_slot", "register_prefix", "import_prefix",
+    "release_prefix", "update_params"})
+
+
+class EngineRpcHandler:
+    """Dispatch table + idempotency cache over one local engine."""
+
+    def __init__(self, engine, *, idempotency_cache_size: int = 4096):
+        self.engine = engine
+        self._cache_size = max(1, int(idempotency_cache_size))
+        # request_id -> ("ok" | "err", payload) — replayed on duplicates
+        self._cache: "collections.OrderedDict[str, Tuple[str, Any]]" = \
+            collections.OrderedDict()       # guarded-by: _lock
+        self.executed: Dict[str, int] = {}  # method -> count, guarded-by: _lock
+        self.replays = 0                    # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    # -- dispatch ------------------------------------------------------------
+    def handle(self, method: str, params: Dict[str, Any], *,
+               request_id: Optional[str] = None) -> Any:
+        fn = getattr(self, f"_m_{method}", None)
+        if fn is None:
+            raise RpcProtocolError(f"unknown rpc method {method!r}")
+        cacheable = request_id is not None and method in MUTATING_METHODS
+        if cacheable:
+            with self._lock:
+                hit = self._cache.get(request_id)
+                if hit is not None:
+                    self._cache.move_to_end(request_id)
+                    self.replays += 1
+                    return self._replay(hit)
+        try:
+            result = fn(**params)
+            outcome = ("ok", result)
+        except RpcProtocolError:
+            raise
+        except Exception as e:
+            outcome = ("err", (type(e).__name__, str(e)))
+        if cacheable:
+            with self._lock:
+                self._cache[request_id] = outcome
+                while len(self._cache) > self._cache_size:
+                    self._cache.popitem(last=False)
+        with self._lock:
+            self.executed[method] = self.executed.get(method, 0) + 1
+        return self._replay(outcome)
+
+    @staticmethod
+    def _replay(outcome: Tuple[str, Any]) -> Any:
+        status, payload = outcome
+        if status == "ok":
+            return payload
+        raise RpcApplicationError(payload[0], payload[1])
+
+    # -- methods -------------------------------------------------------------
+    def _m_health(self) -> Dict[str, Any]:
+        return {"state": "ok",
+                "has_work": bool(getattr(self.engine, "has_work", False)),
+                "queue_depth": int(
+                    self.engine.stats().get("queue_depth", 0))}
+
+    def _m_meta(self) -> Dict[str, Any]:
+        return {"num_slots": int(getattr(self.engine, "num_slots", 8)),
+                "context_bound": int(
+                    getattr(self.engine, "context_bound", 1 << 30))}
+
+    def _m_submit(self, prompt, max_new_tokens=128, prefix_id=None,
+                  eos_id=None, hold_slot=False, continue_from=None) -> int:
+        return self.engine.submit(
+            list(prompt), max_new_tokens=max_new_tokens,
+            prefix_id=prefix_id, eos_id=eos_id, hold_slot=hold_slot,
+            continue_from=continue_from)
+
+    def _m_step(self) -> Dict[str, Any]:
+        # JSON object keys are strings; the client int()s them back.
+        return {str(rid): toks
+                for rid, toks in self.engine.step().items()}
+
+    def _m_is_done(self, rid) -> bool:
+        return bool(self.engine.is_done(int(rid)))
+
+    def _m_result(self, rid):
+        return list(self.engine.result(int(rid)))
+
+    def _m_result_logps(self, rid):
+        return [float(x) for x in self.engine.result_logps(int(rid))]
+
+    def _m_release_slot(self, rid) -> None:
+        self.engine.release_slot(int(rid))
+
+    def _m_register_prefix(self, tokens) -> int:
+        return int(self.engine.register_prefix(list(tokens)))
+
+    def _m_export_prefix(self, prefix_id):
+        return self.engine.export_prefix(int(prefix_id))
+
+    def _m_import_prefix(self, tokens, kv, last_logits=None) -> int:
+        return int(self.engine.import_prefix(list(tokens), kv,
+                                             last_logits))
+
+    def _m_release_prefix(self, prefix_id) -> None:
+        self.engine.release_prefix(int(prefix_id))
+
+    def _m_update_params(self, params) -> None:
+        self.engine.update_params(params)
+
+    def _m_stats(self) -> Dict[str, Any]:
+        return dict(self.engine.stats())
+
+
+def serve_engine_http(engine_or_handler, *, host: str = "127.0.0.1",
+                      port: int = 0):
+    """Serve one engine over real HTTP; returns ``(server, port)``.
+
+    ``server`` is a started ``ThreadingHTTPServer`` (daemon thread);
+    call ``server.shutdown()`` when done. Port 0 picks a free port —
+    the test-friendly default.
+    """
+    import http.server
+
+    handler = (engine_or_handler
+               if isinstance(engine_or_handler, EngineRpcHandler)
+               else EngineRpcHandler(engine_or_handler))
+
+    class _Rpc(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):     # noqa: N802 (stdlib naming)
+            if self.path != RPC_PATH:
+                self.send_error(404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                frame = json.loads(self.rfile.read(length))
+                method = frame["method"]
+                params = decode(frame.get("params") or {})
+                request_id = frame.get("request_id")
+            except (ValueError, KeyError, TypeError):
+                self.send_error(400, "malformed rpc frame")
+                return
+            try:
+                result = handler.handle(method, params,
+                                        request_id=request_id)
+                body = {"ok": True, "result": encode(result)}
+            except RpcApplicationError as e:
+                body = {"ok": False, "error_type": e.error_type,
+                        "message": e.message}
+            except RpcProtocolError as e:
+                self.send_error(400, str(e))
+                return
+            except Exception as e:      # crash mid-call → 5xx
+                self.send_error(500, str(e))
+                return
+            payload = json.dumps(body).encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *args):   # keep test output quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), _Rpc)
+    server.daemon_threads = True
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serve-rpc-http", daemon=True)
+    thread.start()
+    return server, server.server_address[1]

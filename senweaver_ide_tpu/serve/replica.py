@@ -219,10 +219,18 @@ class EngineReplica:
                     prefix_id = self.engine.register_prefix(
                         list(req.prefix_tokens))
                     self._prefixes[key] = prefix_id
-            rid = self.engine.submit(
-                req.prompt, max_new_tokens=req.max_new_tokens,
-                prefix_id=prefix_id, eos_id=req.eos_id,
-                hold_slot=req.hold_slot)
+            kwargs = dict(max_new_tokens=req.max_new_tokens,
+                          prefix_id=prefix_id, eos_id=req.eos_id,
+                          hold_slot=req.hold_slot)
+            if getattr(self.engine, "supports_idempotency", False):
+                # Stable per (ticket, dispatch attempt): an in-call
+                # retry after a lost response REPLAYS on the server
+                # instead of double-executing; a fresh requeue attempt
+                # gets a fresh key (a cached transient error must not
+                # shadow a later genuine try).
+                kwargs["idempotency_key"] = \
+                    f"ticket-{req.ticket}-a{req.attempts}"
+            rid = self.engine.submit(req.prompt, **kwargs)
             self.inflight[rid] = req
             req.replica_id = self.replica_id
             req.engine_rid = rid

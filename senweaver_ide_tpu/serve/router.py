@@ -29,7 +29,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
-from ..resilience.faults import episode_retry_delay_s
+from ..resilience.retry import RetryPolicy
 from .admission import (REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
                         FleetRequest, Rejected)
 from .replica import EngineReplica
@@ -42,9 +42,13 @@ class Router:
                  retry_max_delay_s: float = 2.0,
                  registry=None):
         self.replicas = list(replicas)
-        self.max_retries = int(max_retries)
-        self.retry_base_delay_s = retry_base_delay_s
-        self.retry_max_delay_s = retry_max_delay_s
+        # The shared resilience retry shape; UNJITTERED — requeue
+        # backoff is enforced by fake-clock-friendly `not_before`
+        # timestamps, and deterministic delays keep the SLO tests exact.
+        self.retry = RetryPolicy(max_retries=int(max_retries),
+                                 base_delay_s=retry_base_delay_s,
+                                 max_delay_s=retry_max_delay_s,
+                                 jitter=False)
         if registry is None:
             from ..obs import get_registry
             registry = get_registry()
@@ -113,9 +117,20 @@ class Router:
                     detail=f"retry budget spent "
                            f"({req.attempts - 1} retries)"))
             else:
-                req.not_before = now + episode_retry_delay_s(
-                    req.attempts, base_s=self.retry_base_delay_s,
-                    max_s=self.retry_max_delay_s)
+                req.not_before = now + self.retry.backoff_s(req.attempts)
                 self._retries_total.inc()
                 requeue.append(req)
         return requeue, shed
+
+    # -- policy accessors (fleet + legacy callers) ---------------------------
+    @property
+    def max_retries(self) -> int:
+        return self.retry.max_retries
+
+    @property
+    def retry_base_delay_s(self) -> float:
+        return self.retry.base_delay_s
+
+    @property
+    def retry_max_delay_s(self) -> float:
+        return self.retry.max_delay_s

@@ -1,0 +1,327 @@
+"""Remote-replica RPC: typed fault taxonomy, wire codec, transports.
+
+The instant a replica lives across a socket, every engine interaction
+gains failure modes the in-process fleet treats as impossible. This
+module names them as a TYPED taxonomy so callers can decide per class
+instead of catching Exception:
+
+====================  =========  =============================================
+error                 retriable  meaning
+====================  =========  =============================================
+RpcTransportError     yes        the request never reached the server
+                                 (refused / reset / DNS) — definitely not
+                                 executed, retry freely
+RpcTimeout            yes        no response within the deadline — the server
+                                 MAY have executed it; only safe to retry
+                                 because the server's idempotent request-id
+                                 cache replays instead of re-executing
+RpcServerError        yes        server answered 5xx before doing the work
+RpcProtocolError      no         malformed frame — a bug, not weather
+RpcApplicationError   no         the remote ENGINE raised (KeyError /
+                                 ValueError / QueueFull…); re-raised locally
+                                 as the original type so fleet semantics are
+                                 transparent to distance
+RpcCircuitOpen        no         the local circuit breaker is refusing calls
+                                 to this peer (failing fast, not a wire error)
+====================  =========  =============================================
+
+Two transports speak the same ``call(method, params)`` surface:
+
+- :class:`HttpTransport` — stdlib urllib POST of a JSON frame to
+  ``{base_url}/rpc`` (the ``traces.http_trace_transport`` idiom; no SDK
+  dependency). Arrays and pytrees cross the wire via :func:`encode` /
+  :func:`decode` (JSON + tagged base64 ndarrays; pickle fallback for
+  exotica — the fleet protocol is TRUSTED-PEER, same trust model as
+  shipping raw weights).
+- :class:`LoopbackTransport` — in-process delivery to an
+  ``EngineRpcHandler``, consulting a
+  :class:`~..resilience.chaos.NetworkFaultPlan` on every call. This is
+  how ALL remote-fleet tests run hermetically on CPU: same taxonomy,
+  same retry/idempotency paths, zero sockets, fake clocks.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+RPC_PATH = "/rpc"
+
+
+# -- fault taxonomy ----------------------------------------------------------
+class RpcError(RuntimeError):
+    """Base for every remote-call failure."""
+
+    retriable = False
+
+
+class RpcTransportError(RpcError):
+    """Connection-level failure before the server saw the request."""
+
+    retriable = True
+
+
+class RpcTimeout(RpcError):
+    """No response within the deadline (the server may have executed)."""
+
+    retriable = True
+
+
+class RpcServerError(RpcError):
+    """Server-side 5xx before the call did its work."""
+
+    retriable = True
+
+
+class RpcProtocolError(RpcError):
+    """Malformed request or response frame."""
+
+
+class RpcCircuitOpen(RpcError):
+    """Local circuit breaker is refusing calls to this peer."""
+
+
+class RpcApplicationError(RpcError):
+    """The remote engine raised; carries the original type name so the
+    client re-raises it LOCALLY (KeyError stays KeyError across the
+    wire — ``EnginePolicyClient`` recovery paths must not notice the
+    network)."""
+
+    def __init__(self, error_type: str, message: str):
+        super().__init__(f"{error_type}: {message}")
+        self.error_type = error_type
+        self.message = message
+
+    def raise_local(self):
+        """Re-raise as the original exception type where that type is
+        part of the engine contract; unknown types stay RpcApplication-
+        Error (still typed, still not retried)."""
+        builtin = {"KeyError": KeyError, "ValueError": ValueError,
+                   "RuntimeError": RuntimeError, "TypeError": TypeError,
+                   "IndexError": IndexError}.get(self.error_type)
+        if builtin is not None:
+            raise builtin(self.message) from self
+        if self.error_type == "QueueFull":
+            from ..rollout.engine import QueueFull
+            raise QueueFull(self.message) from self
+        if self.error_type == "PrefixImportError":
+            from ..rollout.engine import PrefixImportError
+            raise PrefixImportError(self.message) from self
+        raise self
+
+
+# -- wire codec --------------------------------------------------------------
+def encode(obj: Any) -> Any:
+    """JSON-able encoding of engine call payloads. Scalars/str/None pass
+    through; containers recurse; arrays (numpy or jax) become tagged
+    base64 buffers; namedtuples (KVCache) are rebuilt by import path;
+    anything else rides a tagged pickle (trusted-peer protocol)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, dict):
+        if all(isinstance(k, str) for k in obj):
+            return {"__d__": {k: encode(v) for k, v in obj.items()}}
+        return _encode_pickle(obj)
+    if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+        cls = type(obj)
+        return {"__nt__": f"{cls.__module__}:{cls.__qualname__}",
+                "f": {name: encode(getattr(obj, name))
+                      for name in obj._fields}}
+    if isinstance(obj, list):
+        return [encode(v) for v in obj]
+    if isinstance(obj, tuple):
+        return {"__t__": [encode(v) for v in obj]}
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        import numpy as np
+        arr = np.asarray(obj)
+        return {"__nd__": {"dtype": str(arr.dtype),
+                           "shape": list(arr.shape),
+                           "data": base64.b64encode(
+                               np.ascontiguousarray(arr).tobytes()
+                           ).decode("ascii")}}
+    return _encode_pickle(obj)
+
+
+def _encode_pickle(obj: Any) -> Dict[str, str]:
+    return {"__py__": base64.b64encode(
+        pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    ).decode("ascii")}
+
+
+def decode(obj: Any) -> Any:
+    """Inverse of :func:`encode`. Arrays come back as numpy (jax ops and
+    ``jax.device_put`` consume them directly)."""
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, list):
+        return [decode(v) for v in obj]
+    if isinstance(obj, dict):
+        if "__d__" in obj:
+            return {k: decode(v) for k, v in obj["__d__"].items()}
+        if "__t__" in obj:
+            return tuple(decode(v) for v in obj["__t__"])
+        if "__nt__" in obj:
+            import importlib
+            mod_name, qualname = obj["__nt__"].split(":")
+            cls = importlib.import_module(mod_name)
+            for part in qualname.split("."):
+                cls = getattr(cls, part)
+            return cls(**{k: decode(v) for k, v in obj["f"].items()})
+        if "__nd__" in obj:
+            import numpy as np
+            spec = obj["__nd__"]
+            buf = base64.b64decode(spec["data"])
+            return np.frombuffer(buf, dtype=np.dtype(spec["dtype"])
+                                 ).reshape(spec["shape"]).copy()
+        if "__py__" in obj:
+            return pickle.loads(base64.b64decode(obj["__py__"]))
+        raise RpcProtocolError(f"unknown frame tags: {sorted(obj)}")
+    raise RpcProtocolError(f"unencodable frame element: {type(obj)!r}")
+
+
+# -- transports --------------------------------------------------------------
+class HttpTransport:
+    """urllib POST of one JSON frame per call to ``{base_url}/rpc``.
+
+    Maps wire weather onto the taxonomy: connection errors →
+    :class:`RpcTransportError`, deadline → :class:`RpcTimeout`, 5xx →
+    :class:`RpcServerError` (with any ``Retry-After`` parsed onto
+    ``.retry_after_s``), and an ``ok=false`` body →
+    :class:`RpcApplicationError`. No retrying here — the client's
+    RetryPolicy owns that.
+    """
+
+    def __init__(self, base_url: str, *, timeout_s: float = 5.0,
+                 target: Optional[str] = None):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self.target = target or self.base_url
+
+    def call(self, method: str, params: Optional[Dict[str, Any]] = None,
+             *, request_id: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> Any:
+        import socket
+        import urllib.error
+        import urllib.request
+
+        frame = {"method": method, "params": encode(params or {})}
+        if request_id is not None:
+            frame["request_id"] = request_id
+        body = json.dumps(frame).encode("utf-8")
+        req = urllib.request.Request(
+            self.base_url + RPC_PATH, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                payload = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            if e.code >= 500:
+                err = RpcServerError(f"{method}: HTTP {e.code}")
+                err.retry_after_s = _header_retry_after(e)
+                raise err from e
+            raise RpcProtocolError(f"{method}: HTTP {e.code}") from e
+        except (socket.timeout, TimeoutError) as e:
+            raise RpcTimeout(f"{method}: no response in {timeout}s") from e
+        except urllib.error.URLError as e:
+            if isinstance(getattr(e, "reason", None),
+                          (socket.timeout, TimeoutError)):
+                raise RpcTimeout(
+                    f"{method}: no response in {timeout}s") from e
+            raise RpcTransportError(f"{method}: {e.reason}") from e
+        except OSError as e:
+            raise RpcTransportError(f"{method}: {e}") from e
+        except json.JSONDecodeError as e:
+            raise RpcProtocolError(f"{method}: bad response body") from e
+        return _unwrap(method, payload)
+
+
+def _header_retry_after(e) -> Optional[float]:
+    from ..resilience.retry import parse_retry_after
+    headers = getattr(e, "headers", None)
+    if headers is None:
+        return None
+    return parse_retry_after(headers.get("Retry-After"))
+
+
+def _unwrap(method: str, payload: Any) -> Any:
+    if not isinstance(payload, dict) or "ok" not in payload:
+        raise RpcProtocolError(f"{method}: malformed response frame")
+    if payload["ok"]:
+        return decode(payload.get("result"))
+    raise RpcApplicationError(payload.get("error_type", "RuntimeError"),
+                              payload.get("message", ""))
+
+
+class LoopbackTransport:
+    """In-process transport: the hermetic twin of :class:`HttpTransport`.
+
+    Delivers calls straight into a handler's ``handle()`` (values pass
+    by reference — no serialization cost — unless ``wire_codec=True``,
+    which round-trips every frame through encode/decode to exercise the
+    codec without sockets). A :class:`NetworkFaultPlan` injects the full
+    weather taxonomy deterministically; ``clock`` only matters for
+    bookkeeping, so chaos tests run on fake clocks with zero sleeps.
+
+    Fault semantics (see ``NetworkFault``): ``drop``/``http_500``/
+    ``partition`` fail BEFORE the handler runs; ``drop_response`` runs
+    the handler then loses the answer (RpcTimeout — the retry must hit
+    the server's idempotency cache, not a second execution); ``delay``
+    executes and then times out only when ``delay_s`` >= the call's
+    timeout, otherwise it just records latency.
+    """
+
+    def __init__(self, handler, *, target: str = "loopback",
+                 fault_plan=None, timeout_s: float = 5.0,
+                 wire_codec: bool = False):
+        self.handler = handler
+        self.target = target
+        self.fault_plan = fault_plan
+        self.timeout_s = timeout_s
+        self.wire_codec = wire_codec
+        self.calls = 0                      # guarded-by: _lock
+        self.simulated_latency_s = 0.0      # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    def call(self, method: str, params: Optional[Dict[str, Any]] = None,
+             *, request_id: Optional[str] = None,
+             timeout_s: Optional[float] = None) -> Any:
+        with self._lock:
+            self.calls += 1
+        timeout = self.timeout_s if timeout_s is None else timeout_s
+        fault = (self.fault_plan.take(self.target, method)
+                 if self.fault_plan is not None else None)
+        if fault is not None:
+            if fault.kind == "partition":
+                raise RpcTransportError(
+                    f"{method}: {self.target} partitioned")
+            if fault.kind == "drop":
+                raise RpcTransportError(
+                    f"{method}: connection reset by chaos")
+            if fault.kind == "http_500":
+                raise RpcServerError(f"{method}: injected HTTP 500")
+        try:
+            result = self.handler.handle(method, dict(params or {}),
+                                         request_id=request_id)
+        except RpcError:
+            raise
+        except Exception as e:     # handler bug = server crash mid-call
+            raise RpcServerError(f"{method}: server crashed: {e}") from e
+        if fault is not None:
+            if fault.kind == "drop_response":
+                raise RpcTimeout(
+                    f"{method}: executed but response lost")
+            if fault.kind == "delay":
+                with self._lock:
+                    self.simulated_latency_s += fault.delay_s
+                if fault.delay_s >= timeout:
+                    raise RpcTimeout(
+                        f"{method}: response after {fault.delay_s}s "
+                        f"> timeout {timeout}s")
+        if self.wire_codec:
+            result = _unwrap(method, json.loads(json.dumps(
+                {"ok": True, "result": encode(result)})))
+        return result

@@ -53,6 +53,14 @@ class WeightPublisher:
         self._rolled_total = registry.counter(
             "senweaver_serve_replicas_rolled_total",
             "Per-replica weight swaps completed.")
+        self._quarantined_total = registry.counter(
+            "senweaver_serve_publish_quarantined_total",
+            "Replicas quarantined mid-publish (install unreachable/"
+            "failed); the roll completes on the reachable set.")
+        # install_weights failures collected here for the fleet to turn
+        # into proper deaths (orphan triage included); the publisher
+        # itself never kills — it has no router.
+        self._quarantined: List[EngineReplica] = []  # guarded-by: _lock
         self._skew_gauge.set(0)
         # begin() observers, called with the NEW version the moment a
         # publish is staged — before any replica swaps. The shared
@@ -129,7 +137,25 @@ class WeightPublisher:
                 self._update_skew()
                 return False
             if cur.outstanding == 0:
-                cur.install_weights(self._pending_params, self.version)
+                try:
+                    cur.install_weights(self._pending_params,
+                                        self.version)
+                except Exception:
+                    # Unreachable (or otherwise failed) mid-publish: the
+                    # roll must converge on the REACHABLE set, not wedge
+                    # behind one dead host. Quarantine the replica for
+                    # the fleet to reap — a straggler that recovers
+                    # re-syncs through add_replica (version stamp) and
+                    # the lazy prefix backfill path.
+                    self._quarantined_total.inc()
+                    self._quarantined.append(cur)
+                    self._current = None
+                    if not self._roll_queue:
+                        self._pending_params = None
+                        self._update_skew()
+                        return True
+                    self._update_skew()
+                    return False
                 cur.resume()
                 self._rolled_total.inc()
                 self._current = None
@@ -139,6 +165,14 @@ class WeightPublisher:
                     return True
             self._update_skew()
             return False
+
+    def take_quarantined(self) -> List[EngineReplica]:
+        """Drain the replicas whose install failed mid-roll; the fleet
+        escalates each through its normal death path."""
+        with self._lock:
+            out = self._quarantined
+            self._quarantined = []
+            return out
 
     def _update_skew(self) -> None:
         self._skew_gauge.set(self.skew())

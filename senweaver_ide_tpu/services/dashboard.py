@@ -190,6 +190,14 @@ class DashboardService:
                 return 0
             return sum(float(v) for v in m.samples().values())
 
+        def total_where(name: str, idx: int, want: str) -> float:
+            """Sum only the cells whose ``idx``-th label == ``want``."""
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for k, v in m.samples().items()
+                       if len(k) > idx and k[idx] == want)
+
         def hist_mean(name: str) -> Optional[float]:
             m = self.registry.get(name)
             if m is None:
@@ -232,6 +240,20 @@ class DashboardService:
                     hist_mean("senweaver_serve_prefix_install_ms"),
                 "decode_tokens_outstanding": total(
                     "senweaver_serve_replica_decode_tokens"),
+                "remote_rpcs": total(
+                    "senweaver_serve_remote_rpcs_total"),
+                "remote_rpc_retries": total(
+                    "senweaver_serve_remote_rpc_retries_total"),
+                "remote_rpc_errors": total(
+                    "senweaver_serve_remote_rpc_errors_total"),
+                "breaker_opens": total(
+                    "senweaver_serve_remote_breaker_opens_total"),
+                "probes_dead": total_where(
+                    "senweaver_serve_remote_probes_total", 1, "dead"),
+                "continuation_replays": total(
+                    "senweaver_serve_continuation_replays_total"),
+                "publish_quarantined": total(
+                    "senweaver_serve_publish_quarantined_total"),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -655,7 +677,13 @@ async function refresh() {
     ["weight version", sv.weight_version],
     ["version skew", sv.version_skew],
     ["ttft ms (mean)", sv.ttft_ms_mean],
-    ["e2e ms (mean)", sv.e2e_ms_mean]]);
+    ["e2e ms (mean)", sv.e2e_ms_mean],
+    ["remote rpcs", sv.remote_rpcs],
+    ["rpc retries", sv.remote_rpc_retries],
+    ["breaker opens", sv.breaker_opens],
+    ["probes dead", sv.probes_dead],
+    ["continuation replays", sv.continuation_replays],
+    ["publish quarantined", sv.publish_quarantined]]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

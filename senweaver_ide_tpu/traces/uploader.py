@@ -101,11 +101,14 @@ def http_trace_transport(url: str, *, timeout: float = 10.0,
     traceCollectorService.ts:797-899). 2xx → True. Stdlib urllib — no
     SDK dependency for the fleet ingest path.
 
-    TRANSIENT failures (connection errors, timeouts, 5xx) are retried
-    in-call up to ``max_retries`` times with the agent loop's 1.5x
-    exponential backoff (agents/loop.py ``retry_delay_s`` shape, via
-    resilience.faults) plus 0.5–1.5x jitter — each retry increments
-    ``senweaver_uploader_retries_total``. PERMANENT failures (4xx: the
+    TRANSIENT failures (connection errors, timeouts, 5xx, and 429) are
+    retried in-call up to ``max_retries`` times under the SHARED
+    ``resilience.retry.RetryPolicy`` (the 1.5x exponential the episode
+    boundary and the serving router also use) with 0.5–1.5x jitter —
+    each retry increments ``senweaver_uploader_retries_total``. A
+    ``Retry-After`` header on the response (5xx backpressure or 429
+    throttling) is honored as a FLOOR under the backoff: the server's
+    ask is never undercut by jitter. PERMANENT failures (other 4xx: the
     batch itself is rejected; malformed url) fail fast: retrying a
     client error only hammers the ingest endpoint. Exhausted retries
     return False — the uploader's own retry-next-cycle contract takes
@@ -117,38 +120,46 @@ def http_trace_transport(url: str, *, timeout: float = 10.0,
     import urllib.request
 
     from ..obs import get_registry
-    from ..resilience.faults import episode_retry_delay_s
+    from ..resilience.retry import (RetryBudget, RetryPolicy,
+                                    parse_retry_after)
 
     sleep = sleep or _time.sleep
     rng = rng or random.Random()
+    policy = RetryPolicy(max_retries=max_retries,
+                         base_delay_s=retry_base_s,
+                         max_delay_s=retry_max_s, jitter=True)
     retries_total = get_registry().counter(
         "senweaver_uploader_retries_total",
         "Transient-error retries inside the HTTP trace transport")
 
     def transport(batch: List[Dict]) -> bool:
         body = json.dumps({"traces": batch}).encode("utf-8")
-        attempt = 0
+        budget = RetryBudget(policy, now=_time.monotonic(), rng=rng)
         while True:
-            attempt += 1
             req = urllib.request.Request(
                 url, data=body, method="POST",
                 headers={"Content-Type": "application/json",
                          **(headers or {})})
+            retry_after = None
             try:
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     return 200 <= resp.status < 300
             except urllib.error.HTTPError as e:
-                if e.code < 500:
+                if e.code < 500 and e.code != 429:
                     return False        # 4xx: permanent, fail fast
+                # 5xx / 429: transient; the server may name its own
+                # backpressure interval.
+                retry_after = parse_retry_after(
+                    (getattr(e, "headers", None) or {}).get("Retry-After"))
             except ValueError:
                 return False            # malformed url: permanent
             except (urllib.error.URLError, OSError):
                 pass                    # transient: refused/timeout/DNS
-            if attempt > max_retries:
+            delay = budget.next_delay(now=_time.monotonic(),
+                                      retry_after_s=retry_after)
+            if delay is None:
                 return False
             retries_total.inc()
-            delay = episode_retry_delay_s(
-                attempt, base_s=retry_base_s, max_s=retry_max_s)
-            sleep(delay * (0.5 + rng.random()))
+            sleep(delay)
 
     return transport

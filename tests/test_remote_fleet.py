@@ -1,0 +1,638 @@
+"""Cross-host serving fleet: RPC taxonomy, retries, idempotency,
+circuit breakers, hedged probes, and network-chaos acceptance.
+
+Everything here is HERMETIC on CPU: remote replicas speak to in-process
+``EngineRpcHandler``s over ``LoopbackTransport`` (the same taxonomy and
+retry/idempotency paths as the HTTP transport, zero sockets), chaos
+comes from a deterministic :class:`NetworkFaultPlan`, and time is a
+fake clock — except one end-to-end test that crosses a real loopback
+HTTP socket (the ``test_uploader_http`` posture).
+
+The ISSUE acceptance invariants:
+
+- a retried dispatch NEVER double-executes (the server-side idempotent
+  request-id cache replays instead);
+- a mid-decode host kill loses no admitted request — orphans requeue
+  onto survivors and every ticket completes exactly once;
+- a partition during a rolling publish quarantines the unreachable
+  replica and the publish CONVERGES on the reachable set;
+- hedged probes distinguish a slow host (never killed) from a dead one
+  (fed into the one LIVE→DEAD escalation path);
+- a held-slot continuation whose holder died is REPLAYED on a survivor
+  (``senweaver_serve_continuation_replays_total``), not a ValueError.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.resilience import (CircuitBreaker, NetworkFault,
+                                          NetworkFaultPlan, RetryBudget,
+                                          RetryPolicy, parse_retry_after)
+from senweaver_ide_tpu.rollout import RolloutEngine
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import (Completed, DEAD, EngineRpcHandler,
+                                     HttpTransport, LIVE,
+                                     LoopbackTransport, PROBE_DEAD,
+                                     PROBE_OK, PROBE_SLOW,
+                                     RemoteReplica, RpcCircuitOpen,
+                                     RpcTransportError, ServingFleet,
+                                     serve_engine_http)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_engine(model, num_slots=2, max_len=64):
+    params, config = model
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# A fast, deterministic client policy: still multiple attempts (so
+# idempotency is exercised), but zero backoff and no jitter.
+FAST = RetryPolicy(max_retries=2, base_delay_s=0.0, jitter=False)
+
+
+def make_remote_fleet(model, n, *, clock=None, plan=None, num_slots=2,
+                      probe_interval_s=0.0, max_retries=2,
+                      policy=FAST, wire_codec=False, replica_kw=None):
+    """N remote replicas over loopback transports into real engines.
+
+    Returns (fleet, handlers, transports); ``handlers[i].executed`` is
+    the ground truth for the exactly-once assertions."""
+    clock = clock or time.monotonic
+    handlers, transports, replicas = [], [], []
+    for i in range(n):
+        h = EngineRpcHandler(make_engine(model, num_slots=num_slots))
+        tr = LoopbackTransport(h, target=f"replica-{i}", fault_plan=plan,
+                               wire_codec=wire_codec)
+        r = RemoteReplica(f"replica-{i}", tr, policy=policy,
+                          clock=clock, sleep=lambda s: None,
+                          **(replica_kw or {}))
+        handlers.append(h)
+        transports.append(tr)
+        replicas.append(r)
+    fleet = ServingFleet(replicas, clock=clock,
+                         retry_base_delay_s=0.0,
+                         max_retries=max_retries,
+                         probe_interval_s=probe_interval_s)
+    return fleet, handlers, transports
+
+
+# ---- retry policy / budget / breaker units (fake clock) ------------------
+
+def test_retry_budget_backoff_shape_and_exhaustion():
+    policy = RetryPolicy(max_retries=3, base_delay_s=0.1,
+                         max_delay_s=10.0, jitter=False)
+    budget = RetryBudget(policy, now=0.0)
+    # 1.5x exponential, unjittered: 0.1, 0.15, 0.225, then spent.
+    assert budget.next_delay(now=0.0) == pytest.approx(0.1)
+    assert budget.next_delay(now=0.0) == pytest.approx(0.15)
+    assert budget.next_delay(now=0.0) == pytest.approx(0.225)
+    assert budget.next_delay(now=0.0) is None
+
+
+def test_retry_budget_deadline_and_retry_after_floor():
+    policy = RetryPolicy(max_retries=5, base_delay_s=0.1,
+                         jitter=False, deadline_s=1.0)
+    budget = RetryBudget(policy, now=0.0)
+    # Retry-After is a floor over the computed backoff...
+    assert budget.next_delay(now=0.0, retry_after_s=0.5) == 0.5
+    # ...and a delay that would sleep past the deadline gives up early.
+    assert budget.next_delay(now=0.9, retry_after_s=0.5) is None
+
+
+def test_parse_retry_after_forms():
+    assert parse_retry_after(None) is None
+    assert parse_retry_after("2") == 2.0
+    assert parse_retry_after(" 0.5 ") == 0.5
+    assert parse_retry_after("not-a-date-or-number") is None
+    # An HTTP-date in the past asks for an immediate retry, not None.
+    assert parse_retry_after("Wed, 21 Oct 2015 07:28:00 GMT") == 0.0
+
+
+def test_circuit_breaker_state_machine_fake_clock():
+    opened = []
+    b = CircuitBreaker(failure_threshold=2, reset_timeout_s=10.0,
+                       on_state_change=opened.append)
+    assert b.allow(0.0) and b.state_code == 0
+    b.record_failure(0.0)
+    assert b.allow(0.0)                      # one failure: still closed
+    b.record_failure(1.0)
+    assert b.state_code == 2 and b.opens_total == 1
+    assert not b.allow(2.0)                  # open: fail fast
+    assert not b.would_allow(2.0)
+    # Reset timeout elapses: exactly ONE half-open probe is admitted,
+    # and the passive check never consumes that probe slot.
+    assert b.would_allow(11.0)
+    assert b.allow(11.0) and b.state_code == 1
+    assert not b.allow(11.0)                 # probe already in flight
+    b.record_failure(11.0)                   # probe failed: re-open
+    assert b.state_code == 2 and b.opens_total == 2
+    assert b.allow(22.0)                     # next probe
+    b.record_success(22.0)
+    assert b.state_code == 0 and b.allow(22.0)
+
+
+# ---- loopback parity ------------------------------------------------------
+
+def test_remote_fleet_matches_single_engine(model):
+    """A remote fleet is token-for-token the single engine — distance
+    (and the full wire codec) is invisible to greedy decoding."""
+    prompt = [5, 9, 2, 7, 1, 3]
+    ref_eng = make_engine(model)
+    ref_rid = ref_eng.submit(prompt, max_new_tokens=10)
+    ref = ref_eng.run()[ref_rid]
+
+    fleet, handlers, _ = make_remote_fleet(model, 2, wire_codec=True)
+    t = fleet.submit(prompt, max_new_tokens=10)
+    fleet.run()
+    out = fleet.outcome(t)
+    assert isinstance(out, Completed)
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref))
+    assert sum(h.executed.get("submit", 0) for h in handlers) == 1
+    reg = obs.get_registry()
+    rpcs = reg.get("senweaver_serve_remote_rpcs_total").samples()
+    assert sum(v for (replica, method), v in rpcs.items()
+               if method == "submit") == 1
+
+
+def test_shared_prefix_broadcast_crosses_the_wire(model):
+    """The one-prefill broadcast's KV export/import survives the wire
+    codec (KVCache namedtuple + arrays as tagged base64)."""
+    prefix = [7, 7, 7, 7, 2, 2]
+    fleet, handlers, _ = make_remote_fleet(model, 2, wire_codec=True)
+    pid = fleet.register_prefix(prefix)
+    t1 = fleet.submit(prefix + [5, 1], max_new_tokens=4, prefix_id=pid)
+    t2 = fleet.submit(prefix + [9, 3], max_new_tokens=4, prefix_id=pid)
+    fleet.run()
+    assert isinstance(fleet.outcome(t1), Completed)
+    assert isinstance(fleet.outcome(t2), Completed)
+    # Both replicas hold the prefix: one paid the prefill, the other
+    # imported the donor's exported KV across the codec.
+    entry = fleet.prefix_store.lookup(pid)
+    assert entry.installed == {"replica-0", "replica-1"}
+
+    ref_eng = make_engine(model)
+    ref_rid = ref_eng.submit(prefix + [5, 1], max_new_tokens=4)
+    ref = ref_eng.run()[ref_rid]
+    np.testing.assert_array_equal(
+        np.asarray(fleet.outcome(t1).tokens), np.asarray(ref))
+
+
+# ---- idempotency: retried dispatch never double-executes -----------------
+
+def test_lost_response_retries_replay_not_reexecute(model):
+    """drop_response is the trap: the server EXECUTED the submit but the
+    response died. The retry carries the same request id, so the server
+    replays the cached rid — executed exactly once."""
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop_response", method="submit", call_idx=0)])
+    fleet, handlers, _ = make_remote_fleet(model, 1, plan=plan)
+    prompt = [4, 8, 15, 16, 23, 42]
+    t = fleet.submit(prompt, max_new_tokens=6)
+    fleet.run()
+    out = fleet.outcome(t)
+    assert isinstance(out, Completed)
+    h = handlers[0]
+    assert h.executed.get("submit", 0) == 1     # exactly once
+    assert h.replays == 1                       # the retry hit the cache
+    ref_eng = make_engine(model)
+    ref_rid = ref_eng.submit(prompt, max_new_tokens=6)
+    ref = ref_eng.run()[ref_rid]
+    np.testing.assert_array_equal(np.asarray(out.tokens), np.asarray(ref))
+    reg = obs.get_registry()
+    retries = reg.get("senweaver_serve_remote_rpc_retries_total").samples()
+    assert retries[("replica-0",)] == 1
+    assert plan.injected_counts() == {"drop_response": 1}
+
+
+def test_pre_execution_faults_retry_transparently(model):
+    """drop and http_500 fail BEFORE the server executes — the retry is
+    a true first execution, no replay involved."""
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop", method="submit", call_idx=0),
+        NetworkFault(kind="http_500", method="submit", call_idx=1)])
+    fleet, handlers, _ = make_remote_fleet(model, 1, plan=plan)
+    t = fleet.submit([3, 1, 4, 1, 5], max_new_tokens=4)
+    fleet.run()
+    assert isinstance(fleet.outcome(t), Completed)
+    assert handlers[0].executed.get("submit", 0) == 1
+    assert handlers[0].replays == 0
+    assert plan.injected_counts() == {"drop": 1, "http_500": 1}
+
+
+# ---- circuit breaker on the live path ------------------------------------
+
+def test_open_breaker_fails_fast_and_recovers(model):
+    """A condemned peer is refused locally (no transport touch, no
+    timeout burn); after the reset window one probe call re-closes."""
+    clock = FakeClock()
+    plan = NetworkFaultPlan()
+    h = EngineRpcHandler(make_engine(model))
+    tr = LoopbackTransport(h, target="replica-0", fault_plan=plan)
+    rep = RemoteReplica(
+        "replica-0", tr, clock=clock, sleep=lambda s: None,
+        policy=RetryPolicy(max_retries=0, base_delay_s=0.0),
+        breaker_failure_threshold=3, breaker_reset_timeout_s=5.0)
+    plan.partition("replica-0")
+    for _ in range(3):
+        with pytest.raises(RpcTransportError):
+            rep.client.stats()
+    assert rep.breaker.state_code == 2
+    assert not rep.accepting                    # router skips it
+    calls_before = tr.calls
+    with pytest.raises(RpcCircuitOpen):
+        rep.client.stats()
+    assert tr.calls == calls_before             # failed fast, no wire
+    # Heal + reset window: the half-open probe call closes the circuit.
+    plan.heal()
+    clock.advance(6.0)
+    assert isinstance(rep.client.stats(), dict)
+    assert rep.breaker.state_code == 0 and rep.accepting
+    reg = obs.get_registry()
+    opens = reg.get("senweaver_serve_remote_breaker_opens_total").samples()
+    assert opens[("replica-0",)] == 1
+
+
+# ---- hedged probes: slow is not dead -------------------------------------
+
+def test_hedged_probe_distinguishes_slow_from_dead(model):
+    clock = FakeClock()
+    plan = NetworkFaultPlan([
+        # One health response delayed past the probe timeout: the first
+        # attempt times out, the hedge answers — SLOW, not dead.
+        NetworkFault(kind="delay", method="health", delay_s=2.0,
+                     call_idx=0)])
+    h = EngineRpcHandler(make_engine(model))
+    tr = LoopbackTransport(h, target="replica-0", fault_plan=plan)
+    rep = RemoteReplica("replica-0", tr, clock=clock,
+                        sleep=lambda s: None, policy=FAST,
+                        probe_timeout_s=0.5, probe_hedges=1)
+    assert rep.probe() == PROBE_SLOW
+    assert rep.state == LIVE                    # latency never kills
+    assert rep.probe() == PROBE_OK              # weather passed
+    plan.partition("replica-0")
+    assert rep.probe() == PROBE_DEAD            # nothing answers
+    reg = obs.get_registry()
+    probes = reg.get("senweaver_serve_remote_probes_total").samples()
+    assert probes[("replica-0", "slow")] == 1
+    assert probes[("replica-0", "ok")] == 1
+    assert probes[("replica-0", "dead")] == 1
+
+
+# ---- mid-decode host kill: probe-driven failover -------------------------
+
+def test_mid_decode_partition_fails_over_exactly_once(model):
+    """Partition a replica while its requests are decoding: the probe
+    pump escalates it LIVE→DEAD through the shared fault budget, orphans
+    requeue onto the survivor, and every ticket completes exactly once.
+    """
+    clock = FakeClock()
+    plan = NetworkFaultPlan()
+    fleet, handlers, _ = make_remote_fleet(
+        model, 2, clock=clock, plan=plan, probe_interval_s=1.0,
+        max_retries=4)
+    tickets = [fleet.submit([10 + i, 20 + i, 30 + i], max_new_tokens=4)
+               for i in range(4)]
+    fleet.step()                       # dispatch lands on both replicas
+    dispatched_to_0 = handlers[0].executed.get("submit", 0)
+    assert dispatched_to_0 >= 1
+
+    plan.partition("replica-0")        # the host goes silent mid-decode
+    for _ in range(40):
+        if not fleet.pending():
+            break
+        clock.advance(1.0)             # next probe window
+        fleet.step()
+    assert not fleet.pending()
+
+    dead = fleet.replicas[0]
+    assert dead.replica_id == "replica-0" and dead.state == DEAD
+    outs = [fleet.outcome(t) for t in tickets]
+    assert all(isinstance(o, Completed) for o in outs)
+    # Exactly once per ticket: 4 outcomes, greedy runs to max tokens.
+    assert all(len(o.tokens) == 4 for o in outs)
+    # The survivor executed everything that finished; the dead handler
+    # saw each of its dispatches exactly once (no double execution).
+    assert handlers[0].executed.get("submit", 0) == dispatched_to_0
+    reg = obs.get_registry()
+    deaths = reg.get("senweaver_serve_replica_deaths_total").samples()
+    assert sum(deaths.values()) == 1
+    probes = reg.get("senweaver_serve_remote_probes_total").samples()
+    assert probes[("replica-0", "dead")] >= 3   # the escalation budget
+
+
+# ---- partition during rolling publish: quarantine + convergence ----------
+
+def test_partition_during_publish_quarantines_and_converges(model):
+    params, config = model
+    fleet, handlers, _ = make_remote_fleet(model, 3)
+    t0 = fleet.submit([1, 2, 3], max_new_tokens=3)
+    fleet.run()
+    assert isinstance(fleet.outcome(t0), Completed)
+
+    # The plan is injected mid-flight: partition one replica, then roll.
+    plan = NetworkFaultPlan()
+    fleet.replicas[1].engine.transport.fault_plan = plan
+    plan.partition("replica-1")
+    version = fleet.update_params(init_params(config,
+                                              jax.random.PRNGKey(2)))
+    assert version == 1
+    # Publish CONVERGED on the reachable set; the unreachable replica
+    # was quarantined into the normal death path, not waited on.
+    assert fleet.replicas[1].state == DEAD
+    live = [r for r in fleet.replicas if r.state != DEAD]
+    assert len(live) == 2
+    assert all(r.weight_version == 1 for r in live)
+    assert not fleet.publisher.in_progress
+    reg = obs.get_registry()
+    quarantined = reg.get(
+        "senweaver_serve_publish_quarantined_total").samples()
+    assert sum(quarantined.values()) == 1
+    # Post-roll traffic serves v1 from the survivors.
+    t1 = fleet.submit([4, 5, 6], max_new_tokens=3)
+    fleet.run()
+    assert fleet.outcome(t1).weight_version_at_finish == 1
+
+
+# ---- held-slot continuation: survivor replay, not ValueError -------------
+
+def test_continuation_replays_on_survivor_after_holder_death(model):
+    """The holder of a held slot dies between turns. The fleet re-
+    prefills the full recorded transcript on a survivor instead of
+    raising — greedy output identical to an unbroken conversation."""
+    fleet, handlers, _ = make_remote_fleet(model, 2)
+    p1 = [5, 9, 2, 7, 1, 3]
+    t1 = fleet.submit(p1, max_new_tokens=5, hold_slot=True)
+    fleet.run()
+    out1 = list(fleet.outcome(t1).tokens)
+    holder = fleet._requests[t1].replica_id
+
+    fleet.kill_replica(holder)
+    full2 = p1 + out1 + [8, 4]
+    t2 = fleet.submit(full2, max_new_tokens=5, continue_from=t1)
+    fleet.run()
+    out2 = fleet.outcome(t2)
+    assert isinstance(out2, Completed)
+    assert out2.replica_id != holder            # re-pinned to a survivor
+
+    ref_eng = make_engine(model)
+    ref_rid = ref_eng.submit(full2, max_new_tokens=5)
+    ref = ref_eng.run()[ref_rid]
+    np.testing.assert_array_equal(np.asarray(out2.tokens),
+                                  np.asarray(ref))
+    reg = obs.get_registry()
+    replays = reg.get(
+        "senweaver_serve_continuation_replays_total").samples()
+    assert sum(replays.values()) == 1
+
+
+def test_continuation_with_no_survivor_still_raises(model):
+    fleet, _, _ = make_remote_fleet(model, 1)
+    t1 = fleet.submit([1, 2, 3], max_new_tokens=3, hold_slot=True)
+    fleet.run()
+    fleet.kill_replica("replica-0")
+    with pytest.raises(ValueError, match="no survivor"):
+        fleet.submit([1, 2, 3, 9], max_new_tokens=3, continue_from=t1)
+
+
+# ---- dead-id resurrection (add_replica regression) -----------------------
+
+def test_add_replica_resurrects_dead_id_cleanly(model):
+    """Re-adding a DEAD replica id must drop the carcass from every
+    membership list and the prefix store's installed sets — the fresh
+    engine is lazily backfilled, never assumed warm."""
+    fleet, handlers, _ = make_remote_fleet(model, 2)
+    prefix = [6, 6, 6, 2]
+    pid = fleet.register_prefix(prefix)
+    t0 = fleet.submit(prefix + [1], max_new_tokens=3, prefix_id=pid)
+    fleet.run()
+    assert isinstance(fleet.outcome(t0), Completed)
+    assert "replica-0" in fleet.prefix_store.lookup(pid).installed
+
+    # A LIVE id is still taken.
+    with pytest.raises(ValueError, match="taken"):
+        fleet.add_replica(make_engine(model), replica_id="replica-0")
+
+    fleet.kill_replica("replica-0")
+    h = EngineRpcHandler(make_engine(model))
+    fresh = RemoteReplica("replica-0",
+                          LoopbackTransport(h, target="replica-0"),
+                          policy=FAST, sleep=lambda s: None)
+    fleet.add_replica(fresh, replica_id="replica-0")
+
+    # Exactly one replica-0 anywhere, and it is the fresh LIVE one.
+    for members in (fleet.replicas, fleet.router.replicas,
+                    fleet.publisher.replicas):
+        zeros = [r for r in members if r.replica_id == "replica-0"]
+        assert zeros == [fresh]
+    assert fresh.state == LIVE
+    # The prefix store forgot the dead incarnation: the fresh engine is
+    # in the backfill set, not presumed to hold the KV.
+    assert "replica-0" not in fleet.prefix_store.lookup(pid).installed
+
+    # And it serves: prefix-bearing traffic backfills + completes.
+    t1 = fleet.submit(prefix + [3], max_new_tokens=3, prefix_id=pid)
+    t2 = fleet.submit(prefix + [4], max_new_tokens=3, prefix_id=pid)
+    fleet.run()
+    assert isinstance(fleet.outcome(t1), Completed)
+    assert isinstance(fleet.outcome(t2), Completed)
+
+
+# ---- real HTTP end-to-end ------------------------------------------------
+
+def test_http_transport_end_to_end(model):
+    """One replica across a REAL loopback HTTP socket: submit, decode,
+    weight publish, and stats all cross the wire via the JSON codec."""
+    params, config = model
+    server, port = serve_engine_http(make_engine(model))
+    try:
+        rep = RemoteReplica(
+            "replica-0",
+            HttpTransport(f"http://127.0.0.1:{port}", timeout_s=30.0,
+                          target="replica-0"),
+            policy=RetryPolicy(max_retries=1, base_delay_s=0.01))
+        fleet = ServingFleet([rep])
+        prompt = [5, 9, 2, 7, 1, 3]
+        t = fleet.submit(prompt, max_new_tokens=6)
+        fleet.run()
+        out = fleet.outcome(t)
+        assert isinstance(out, Completed)
+        ref_eng = make_engine(model)
+        ref_rid = ref_eng.submit(prompt, max_new_tokens=6)
+        ref = ref_eng.run()[ref_rid]
+        np.testing.assert_array_equal(np.asarray(out.tokens),
+                                      np.asarray(ref))
+        assert fleet.update_params(
+            init_params(config, jax.random.PRNGKey(2))) == 1
+        assert rep.weight_version == 1
+        assert isinstance(rep.client.stats(), dict)
+        assert rep.client.num_slots == 2        # the meta RPC
+    finally:
+        server.shutdown()
+
+
+# ---- full chaos acceptance -----------------------------------------------
+
+def test_chaos_acceptance_no_request_lost_or_doubled(model):
+    """The ISSUE acceptance scenario, hermetic on a fake clock: a
+    3-replica remote fleet under mixed load with a held slot; chaos
+    kills the holder mid-decode and partitions a second replica through
+    a rolling publish. Invariants: every admitted request completes
+    EXACTLY once, nothing double-executes, the publish converges on the
+    reachable set, and the held-slot continuation replays on a survivor.
+    """
+    params, config = model
+    clock = FakeClock()
+    plan = NetworkFaultPlan()
+    fleet, handlers, _ = make_remote_fleet(
+        model, 3, clock=clock, plan=plan, probe_interval_s=1.0,
+        max_retries=6)
+    held = fleet.submit([5, 9, 2, 7], max_new_tokens=4, hold_slot=True)
+    load = [fleet.submit([11 + i, 22 + i, 33 + i], max_new_tokens=4)
+            for i in range(5)]
+    fleet.step()                        # dispatch across the fleet
+    holder = fleet._requests[held].replica_id
+    assert holder == "replica-0"        # first pick: least-loaded order
+
+    # -- host kill mid-decode --------------------------------------------
+    plan.partition(holder)
+    for _ in range(60):
+        if not fleet.pending():
+            break
+        clock.advance(1.0)
+        fleet.step()
+    assert not fleet.pending()
+    outs = {t: fleet.outcome(t) for t in [held] + load}
+    assert all(isinstance(o, Completed) for o in outs.values())
+    assert all(len(o.tokens) == 4 for o in outs.values())
+    assert fleet._replica_by_id(holder).state == DEAD
+
+    # -- partition a SECOND replica, then roll weights -------------------
+    plan.partition("replica-1")
+    version = fleet.update_params(init_params(config,
+                                              jax.random.PRNGKey(3)))
+    assert version == 1
+    live = [r for r in fleet.replicas if r.state != DEAD]
+    assert [r.replica_id for r in live] == ["replica-2"]
+    assert all(r.weight_version == 1 for r in live)
+    assert not fleet.publisher.in_progress
+
+    # -- held-slot continuation replays on the last survivor -------------
+    out1 = list(outs[held].tokens)
+    full2 = [5, 9, 2, 7] + out1 + [6, 1]
+    t2 = fleet.submit(full2, max_new_tokens=4, continue_from=held)
+    for _ in range(60):
+        if not fleet.pending():
+            break
+        clock.advance(1.0)
+        fleet.step()
+    out2 = fleet.outcome(t2)
+    assert isinstance(out2, Completed)
+    assert out2.replica_id == "replica-2"
+
+    # -- exactly-once ledger ---------------------------------------------
+    # Fleet-level: one outcome per admitted ticket, all completed.
+    assert fleet.pending() == 0
+    assert len(fleet._outcomes) == len(fleet._requests) == 7
+    # Server-level: total submit EXECUTIONS ≥ tickets (death retries
+    # re-prefill on survivors — by design), but replays never execute.
+    reg = obs.get_registry()
+    replays = reg.get(
+        "senweaver_serve_continuation_replays_total").samples()
+    assert sum(replays.values()) == 1
+    quarantined = reg.get(
+        "senweaver_serve_publish_quarantined_total").samples()
+    assert sum(quarantined.values()) == 1
+    deaths = reg.get("senweaver_serve_replica_deaths_total").samples()
+    assert sum(deaths.values()) == 2
+    counts = plan.injected_counts()
+    assert counts.get("partition", 0) >= 2
+
+
+# ---- threaded fleet under the lock-order recorder ------------------------
+
+def test_threaded_remote_fleet_lock_order_acyclic(model):
+    """Threaded remote serving under chaos with every package lock
+    instrumented: submissions race a replica death, all tickets resolve,
+    and the recorded lock graph is ACYCLIC (no potential deadlock was
+    even possible across fleet/replica/client/handler locks)."""
+    from senweaver_ide_tpu.analysis.lock_order import LockOrderRecorder
+
+    rec = LockOrderRecorder(scope="senweaver_ide_tpu")
+    with rec:
+        plan = NetworkFaultPlan()
+        handlers = [EngineRpcHandler(make_engine(model, num_slots=2))
+                    for _ in range(2)]
+        replicas = [
+            RemoteReplica(
+                f"replica-{i}",
+                LoopbackTransport(h, target=f"replica-{i}",
+                                  fault_plan=plan),
+                policy=FAST, sleep=lambda s: None)
+            for i, h in enumerate(handlers)]
+        fleet = ServingFleet(replicas, retry_base_delay_s=0.0,
+                             max_retries=4, probe_interval_s=0.05)
+        fleet.start()
+        try:
+            tickets, tickets_lock = [], threading.Lock()
+
+            def submitter(seed):
+                for i in range(6):
+                    t = fleet.submit([seed + i, seed + i + 1, 3],
+                                     max_new_tokens=4)
+                    with tickets_lock:
+                        tickets.append(t)
+                    time.sleep(0.002)
+
+            subs = [threading.Thread(target=submitter, args=(s,))
+                    for s in (10, 40)]
+            for th in subs:
+                th.start()
+            time.sleep(0.03)
+            plan.partition("replica-0")     # chaos mid-traffic
+            for th in subs:
+                th.join()
+            deadline = time.monotonic() + 120.0
+            while fleet.pending():
+                if time.monotonic() > deadline:
+                    pytest.fail("fleet did not drain")
+                time.sleep(0.01)
+        finally:
+            fleet.stop()
+        with tickets_lock:
+            assert len(tickets) == 12
+            assert all(fleet.is_done(t) for t in tickets)
+    rec.assert_acyclic()

@@ -22,6 +22,7 @@ class _TracesHandler(http.server.BaseHTTPRequestHandler):
     received = []          # class-level: one server per fixture
     fail_next = 0
     fail_code = 500
+    retry_after = None     # sent as a Retry-After header on failures
     requests = 0
 
     def do_POST(self):
@@ -34,6 +35,9 @@ class _TracesHandler(http.server.BaseHTTPRequestHandler):
         if _TracesHandler.fail_next > 0:
             _TracesHandler.fail_next -= 1
             self.send_response(_TracesHandler.fail_code)
+            if _TracesHandler.retry_after is not None:
+                self.send_header("Retry-After",
+                                 str(_TracesHandler.retry_after))
             self.end_headers()
             return
         payload = json.loads(body)
@@ -52,6 +56,7 @@ def traces_server():
     _TracesHandler.received = []
     _TracesHandler.fail_next = 0
     _TracesHandler.fail_code = 500
+    _TracesHandler.retry_after = None
     _TracesHandler.requests = 0
     srv = http.server.HTTPServer(("127.0.0.1", 0), _TracesHandler)
     t = threading.Thread(target=srv.serve_forever, daemon=True)
@@ -112,6 +117,38 @@ def test_transient_5xx_retried_in_call(traces_server, tmp_path):
     # one backoff slept: base 0.5s scaled by the 0.5–1.5x jitter
     assert len(sleeps) == 1
     assert 0.25 <= sleeps[0] <= 0.75
+
+
+def test_retry_after_header_is_a_backoff_floor(traces_server, tmp_path):
+    """A 503 naming its own backpressure interval is honored: the
+    retry sleeps at least Retry-After seconds, never the (smaller)
+    jittered exponential."""
+    traces = _ended_traces(1)
+    sleeps = []
+    up = TraceUploader(
+        http_trace_transport(traces_server, sleep=sleeps.append),
+        uploaded_ids_path=str(tmp_path / "ids.json"))
+    _TracesHandler.fail_next = 1
+    _TracesHandler.fail_code = 503
+    _TracesHandler.retry_after = 2
+    assert up.upload(traces) == 1
+    assert len(sleeps) == 1
+    assert sleeps[0] >= 2.0                # floor, not the 0.25–0.75 base
+
+
+def test_429_is_transient_and_retried(traces_server, tmp_path):
+    """Throttling (429) is backpressure, not batch rejection — it
+    retries like a 5xx instead of failing fast like other 4xx."""
+    traces = _ended_traces(1)
+    sleeps = []
+    up = TraceUploader(
+        http_trace_transport(traces_server, sleep=sleeps.append),
+        uploaded_ids_path=str(tmp_path / "ids.json"))
+    _TracesHandler.fail_next = 1
+    _TracesHandler.fail_code = 429
+    assert up.upload(traces) == 1          # 429 → retry → 200
+    assert _TracesHandler.requests == 2
+    assert len(sleeps) == 1
 
 
 def test_exhausted_retries_defer_to_next_cycle(traces_server, tmp_path):
