@@ -648,6 +648,114 @@ def _measure_fleet_remote(*, n_replicas: int = 4,
     }
 
 
+def _measure_learner_publish(*, n_replicas: int = 3,
+                             n_publishes: int = 4) -> dict:
+    """Disaggregated-learner publish economics: a fenced publish staged
+    over the loopback rpc gateway and polled to convergence
+    (serve/learner.py saga) vs the same fleet's in-process
+    ``update_params``, plus the recovery time for the crash path — a
+    learner killed mid-roll, its successor re-acquiring the lease at a
+    higher epoch and republishing the durable version until every live
+    replica reconverges. Protocol-level numbers on the tiny model: the
+    acceptance signal is gateway overhead small relative to the roll
+    itself, and recovery ≈ one extra full roll."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.resilience import RetryPolicy
+    from senweaver_ide_tpu.rollout import RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.serve import (FleetPublishClient,
+                                         FleetRpcHandler, LearnerConfig,
+                                         LearnerService,
+                                         LoopbackTransport, ServingFleet)
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    policy = RetryPolicy(max_retries=1, base_delay_s=0.0, jitter=False)
+
+    class Trainer:
+        class _State:
+            def __init__(self, p):
+                self.params = p
+
+        def __init__(self, p):
+            self.state = self._State(p)
+
+        def run_round(self):
+            pass                        # isolate publish cost from train
+
+    def build():
+        fleet = ServingFleet(
+            [RolloutEngine(params, config, num_slots=2, max_len=64,
+                           sample=greedy) for _ in range(n_replicas)],
+            retry_base_delay_s=0.0, probe_interval_s=0.0)
+        handler = FleetRpcHandler(fleet)
+        client = FleetPublishClient(
+            LoopbackTransport(handler, target="fleet-gw"),
+            name="bench-learner", policy=policy, sleep=lambda s: None)
+        learner = LearnerService(
+            Trainer(params), client,
+            config=LearnerConfig(holder="bench-learner"))
+        return fleet, handler, client, learner
+
+    obs._reset_for_tests()
+    # In-process baseline: the trainer-side blocking publish.
+    fleet_local, _, _, _ = build()
+    fleet_local.update_params(params)   # warm
+    t0 = _time.perf_counter()
+    for _ in range(n_publishes):
+        fleet_local.update_params(params)
+    inproc_ms = (_time.perf_counter() - t0) * 1000.0 / n_publishes
+
+    # Learner saga over the loopback gateway (stage + poll-to-converge).
+    fleet, handler, client, learner = build()
+    learner.start()
+    learner.run_round()                 # warm
+    t0 = _time.perf_counter()
+    for _ in range(n_publishes):
+        learner.run_round()
+    learner_ms = (_time.perf_counter() - t0) * 1000.0 / n_publishes
+
+    # Crash recovery: stage the next version, tear the roll after one
+    # pump, then time the successor's start() — lease re-acquire at a
+    # higher epoch + durable republish — until full reconvergence.
+    torn = learner.version + 1
+    client.publish(params, epoch=learner.epoch, version=torn)
+    fleet.step()                        # one replica swaps — torn roll
+    assert fleet.publisher.in_progress
+    successor = LearnerService(
+        Trainer(params),
+        FleetPublishClient(
+            LoopbackTransport(handler, target="fleet-gw"),
+            name="bench-learner-2", policy=policy, sleep=lambda s: None),
+        config=LearnerConfig(holder="bench-learner"))
+    successor.version = learner.version  # the durable state a restart reads
+    t0 = _time.perf_counter()
+    epoch2 = successor.client.acquire_lease("bench-learner")["epoch"]
+    successor.epoch = int(epoch2)
+    successor._publish(params, successor.version)
+    recovery_ms = (_time.perf_counter() - t0) * 1000.0
+    versions = {r.weight_version for r in fleet.replicas}
+    assert versions == {successor.version}, "reconvergence failed"
+    obs._reset_for_tests()
+    return {
+        "replicas": n_replicas,
+        "publishes": n_publishes,
+        "publish_ms_inprocess": round(inproc_ms, 2),
+        "publish_ms_learner": round(learner_ms, 2),
+        "gateway_overhead_ms": round(learner_ms - inproc_ms, 2),
+        "gateway_overhead_pct": round(
+            100.0 * (learner_ms - inproc_ms) / max(1e-9, inproc_ms), 1),
+        "recovery_reconverge_ms": round(recovery_ms, 2),
+    }
+
+
 def main() -> None:
     import jax
 
@@ -764,6 +872,14 @@ def main() -> None:
         extra["fleet_remote"] = _measure_fleet_remote()
     except Exception as e:
         extra["fleet_remote"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Disaggregated-learner publish economics (loopback gateway saga vs
+    # in-process update_params) plus crash-recovery reconvergence time.
+    try:
+        _log("learner publish measure: learner_publish")
+        extra["learner_publish"] = _measure_learner_publish()
+    except Exception as e:
+        extra["learner_publish"] = f"error: {type(e).__name__}: {e}"[:200]
 
     baseline = _baseline()
     metric = (f"decode_tokens_per_sec_per_chip[{model_name}"

@@ -9,7 +9,10 @@ The robustness layer for the online GRPO loop (docs/resilience.md):
   over optimizer steps;
 - :mod:`.chaos` — :class:`FaultPlan`, the seeded deterministic
   fault-injection harness (episode raise/hang/NaN-reward, engine
-  faults) the resilience tests drive every degraded path with.
+  faults) the resilience tests drive every degraded path with;
+- :mod:`.lease` — :class:`LeaseStore`, single-writer leases with
+  monotonically increasing fencing epochs (the learner's split-brain
+  protection; see docs/serving.md "Disaggregated learner").
 
 The episode fault boundary itself lives where the episodes run
 (``training/rl_loop.collect_group_trajectories``); preemption-safe
@@ -24,6 +27,7 @@ from .faults import (FailedEpisode, REASON_ERROR, REASON_TIMEOUT,
                      ResilienceConfig, episode_retry_delay_s)
 from .guard import (REASON_LOSS_SPIKE, REASON_NONFINITE_GRAD,
                     REASON_NONFINITE_LOSS, UpdateGuard)
+from .lease import Lease, LeaseLost, LeaseStore, LeaseUnavailable
 from .retry import (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
                     CircuitBreaker, RetryBudget, RetryPolicy,
                     parse_retry_after)
@@ -34,6 +38,7 @@ __all__ = [
     "NETWORK_FAULT_KINDS", "NetworkFault", "NetworkFaultPlan",
     "FailedEpisode", "REASON_ERROR", "REASON_TIMEOUT",
     "ResilienceConfig", "episode_retry_delay_s",
+    "Lease", "LeaseLost", "LeaseStore", "LeaseUnavailable",
     "REASON_LOSS_SPIKE", "REASON_NONFINITE_GRAD", "REASON_NONFINITE_LOSS",
     "UpdateGuard",
     "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN",

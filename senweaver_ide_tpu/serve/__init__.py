@@ -13,30 +13,41 @@ from .admission import (AdmissionConfig, AdmissionQueue, ClassPolicy,
                         REJECT_QUEUE_FULL, REJECT_RATE_LIMITED,
                         REJECT_REPLICA_FAILURE, Rejected,
                         RequestRejected, TRAIN_ROLLOUT, TokenBucket)
+from .autoscale import (ACTION_ADD, ACTION_DRAIN, AutoscaleConfig,
+                        AutoscaleController)
 from .frontend import Completed, ServingFleet
+from .learner import (FleetPublishClient, LearnerConfig,
+                      LearnerPublishError, LearnerService)
+from .learner_server import FleetRpcHandler, serve_fleet_http
 from .prefix_store import SharedPrefixStore
 from .remote import (PROBE_DEAD, PROBE_OK, PROBE_SLOW,
                      RemoteEngineClient, RemoteReplica)
-from .remote_server import EngineRpcHandler, serve_engine_http
+from .remote_server import (EngineRpcHandler, RpcHandlerBase,
+                            serve_engine_http, serve_rpc_http)
 from .replica import (DEAD, DRAINING, EngineReplica, LIVE, ReplicaDead)
 from .router import Router
 from .rpc import (HttpTransport, LoopbackTransport, RpcApplicationError,
                   RpcCircuitOpen, RpcError, RpcProtocolError,
                   RpcServerError, RpcTimeout, RpcTransportError)
-from .weights import WeightPublisher
+from .weights import StalePublishError, WeightPublisher
 
 __all__ = [
-    "AdmissionConfig", "AdmissionQueue", "ClassPolicy", "Completed",
+    "ACTION_ADD", "ACTION_DRAIN",
+    "AdmissionConfig", "AdmissionQueue", "AutoscaleConfig",
+    "AutoscaleController", "ClassPolicy", "Completed",
     "DEAD", "DRAINING", "EngineReplica", "EngineRpcHandler",
-    "FleetRequest", "HttpTransport", "INTERACTIVE",
-    "LIVE", "LoopbackTransport", "PRIORITY_CLASSES",
+    "FleetPublishClient", "FleetRequest", "FleetRpcHandler",
+    "HttpTransport", "INTERACTIVE",
+    "LIVE", "LearnerConfig", "LearnerPublishError", "LearnerService",
+    "LoopbackTransport", "PRIORITY_CLASSES",
     "PROBE_DEAD", "PROBE_OK", "PROBE_SLOW",
     "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
     "REJECT_QUEUE_FULL", "REJECT_RATE_LIMITED", "REJECT_REPLICA_FAILURE",
     "Rejected", "RemoteEngineClient", "RemoteReplica", "ReplicaDead",
     "RequestRejected", "Router", "RpcApplicationError", "RpcCircuitOpen",
-    "RpcError", "RpcProtocolError", "RpcServerError", "RpcTimeout",
-    "RpcTransportError", "ServingFleet", "SharedPrefixStore",
+    "RpcError", "RpcHandlerBase", "RpcProtocolError", "RpcServerError",
+    "RpcTimeout", "RpcTransportError", "ServingFleet",
+    "SharedPrefixStore", "StalePublishError",
     "TRAIN_ROLLOUT", "TokenBucket", "WeightPublisher",
-    "serve_engine_http",
+    "serve_engine_http", "serve_fleet_http", "serve_rpc_http",
 ]

@@ -152,6 +152,9 @@ class ServingFleet:
         # ones); local replicas have no probe() and are skipped.
         self.probe_interval_s = float(probe_interval_s)
         self._last_probe_at: Optional[float] = None  # guarded-by: _lock
+        # Optional admission-driven autoscaler (attach_autoscaler);
+        # evaluated once per pump, inside the fleet lock.
+        self.autoscaler = None                       # guarded-by: _lock
 
     # -- single-engine API superset ------------------------------------------
     @property
@@ -353,6 +356,8 @@ class ServingFleet:
             self._probe_replicas(now)
             for rej in self.admission.shed_expired(now):
                 self._record_rejection(rej)
+            if self.autoscaler is not None:
+                self.autoscaler.evaluate(now)
             self._dispatch(now)
             emitted_by_ticket: Dict[int, List[int]] = {}
             for replica in list(self.replicas):
@@ -396,13 +401,18 @@ class ServingFleet:
             self.step()
 
     # -- weights -------------------------------------------------------------
-    def update_params(self, params) -> int:
+    def update_params(self, params, *, epoch: Optional[int] = None,
+                      version: Optional[int] = None) -> int:
         """Versioned rolling publish (the ``engine.update_params``
         drop-in the online loop calls). Blocks until every live replica
         serves the new version, pumping the fleet meanwhile — serving
-        never stops, generations never mix versions."""
-        with self._lock:
-            version = self.publisher.begin(params)
+        never stops, generations never mix versions.
+
+        ``(epoch, version)`` is the optional fencing token (see
+        :meth:`WeightPublisher.begin`); a stale pair raises
+        :class:`~.weights.StalePublishError` without touching any
+        replica."""
+        v = self.begin_publish(params, epoch=epoch, version=version)
         if self._dispatcher is not None:
             # Threaded mode: the dispatcher pumps the roll forward.
             while self.publisher.in_progress:
@@ -410,7 +420,22 @@ class ServingFleet:
         else:
             while self.publisher.in_progress:
                 self.step()
-        return version
+        return v
+
+    def begin_publish(self, params, *, epoch: Optional[int] = None,
+                      version: Optional[int] = None) -> int:
+        """Stage a fenced publish WITHOUT blocking on the roll — the
+        learner-gateway path: the fleet's own pump (manual ``step()``
+        or the dispatcher thread) rolls it forward while the learner
+        polls convergence over rpc."""
+        with self._lock:
+            return self.publisher.begin(params, epoch=epoch,
+                                        version=version)
+
+    @property
+    def threaded(self) -> bool:
+        """True when the dispatcher thread owns the pump (start()ed)."""
+        return self._dispatcher is not None
 
     # -- chaos / operations --------------------------------------------------
     def add_replica(self, engine, *,
@@ -455,6 +480,21 @@ class ServingFleet:
             replica.start(self._on_replica_step)
         return replica
 
+    def attach_autoscaler(self, spawn_engine, *, config=None):
+        """Wire the admission-driven autoscaler: queue-depth and
+        shed-rate signals drive ``add_replica``/drain through a
+        hysteresis controller evaluated once per pump.
+        ``spawn_engine()`` must return an engine already holding the
+        CURRENT published params (``add_replica`` stamps the version);
+        it runs under the fleet lock, so keep it cheap or pre-built."""
+        from .autoscale import AutoscaleConfig, AutoscaleController
+        with self._lock:
+            self.autoscaler = AutoscaleController(
+                self, spawn_engine,
+                config=config or AutoscaleConfig(),
+                registry=self.registry)
+            return self.autoscaler
+
     def kill_replica(self, replica_id: str) -> None:
         """Declare a replica dead (chaos hook / operator action); its
         in-flight requests are retried elsewhere or shed explicitly."""
@@ -484,6 +524,8 @@ class ServingFleet:
                     self._probe_replicas(now)
                     for rej in self.admission.shed_expired(now):
                         self._record_rejection(rej)
+                    if self.autoscaler is not None:
+                        self.autoscaler.evaluate(now)
                     self._dispatch(now)
                     self._reap_faulted(now)
                 time.sleep(dispatch_interval_s)
@@ -525,6 +567,7 @@ class ServingFleet:
                 "completed": completed,
                 "rejected": rejected,
                 "weight_version": self.publisher.version,
+                "publish_epoch": self.publisher.epoch,
                 "weight_version_skew": self.publisher.skew(),
                 "publish_in_progress": self.publisher.in_progress,
                 **self.prefix_store.stats(),
@@ -607,6 +650,14 @@ class ServingFleet:
                     "senweaver_serve_continuation_replays_total"),
                 "publish_quarantined": ctotal(
                     "senweaver_serve_publish_quarantined_total"),
+                "weight_version": self.publisher.version,
+                "publish_epoch": self.publisher.epoch,
+                "stale_publishes": ctotal(
+                    "senweaver_serve_stale_publish_total"),
+                "autoscale_actions": ctotal(
+                    "senweaver_serve_autoscale_actions_total"),
+                "learner_publishes": ctotal(
+                    "senweaver_learner_publishes_total"),
                 "ttft_by_priority": ttft_buckets(),
             }
 

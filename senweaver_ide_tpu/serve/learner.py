@@ -1,0 +1,380 @@
+"""Disaggregated learner: crash-tolerant training-side weight publication.
+
+PR 6 built the actor half of the Podracer "Sebulba" split — replicas
+that serve across a transport. This module is the learner half: a
+process that trains (an :class:`~..training.online.OnlineImprovementLoop`
+or any round-running trainer) and publishes versioned weights to a
+:class:`~.frontend.ServingFleet` over the same rpc transport, surviving
+every failure a separate process invites:
+
+- **zombie/duplicate learners** — every publish carries the
+  ``(lease_epoch, weight_version)`` fencing token from the fleet-side
+  :class:`~..resilience.lease.LeaseStore`; a superseded learner's
+  publishes raise :class:`~.weights.StalePublishError` /
+  :class:`~..resilience.lease.LeaseLost` fleet-wide instead of applying.
+- **crash/resume** — :meth:`LearnerService.start` re-acquires the lease
+  (strictly higher epoch) and, when the durable state file records a
+  prior publish, REPUBLISHES that version. A publish torn by the crash
+  is superseded by the republish (higher epoch), so the fleet converges
+  on the learner's last durable weights — serving never runs a policy
+  the trainer cannot resume from.
+- **partitions mid-publish** — publish is a resumable saga: stage
+  (idempotent under retried request ids, bounded by a learner-side
+  :class:`~..resilience.retry.RetryBudget`) → the fleet pump rolls →
+  the learner polls convergence. A replica unreachable mid-roll is
+  quarantined fleet-side and backfills through ``add_replica``; the
+  learner's poll still converges on the reachable set.
+
+The transport is injected: ``LoopbackTransport`` for hermetic CPU tests
+(with ``NetworkFaultPlan`` chaos), ``HttpTransport`` against
+:func:`~.learner_server.serve_fleet_http` for real deployment.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..resilience.lease import LeaseLost
+from ..resilience.retry import RetryBudget, RetryPolicy
+from .rpc import RpcApplicationError, RpcError
+from .weights import StalePublishError
+
+_client_counter = itertools.count()
+
+
+class LearnerPublishError(RuntimeError):
+    """A staged publish failed to converge within the deadline (the
+    fleet is unreachable or wedged — NOT a fencing rejection)."""
+
+
+class FleetPublishClient:
+    """Learner-side rpc proxy to a :class:`~.learner_server.FleetRpcHandler`.
+
+    The retry story mirrors ``RemoteEngineClient._call``: transient wire
+    errors retry under a shared :class:`RetryPolicy` (the learner-side
+    RetryBudget that bounds retry storms), mutating calls carry stable
+    request ids so a retried publish REPLAYS server-side, and remote
+    application errors re-raise locally as their original types
+    (``LeaseLost`` stays ``LeaseLost`` across the wire)."""
+
+    def __init__(self, transport, *, name: Optional[str] = None,
+                 policy: RetryPolicy = RetryPolicy(max_retries=3,
+                                                   base_delay_s=0.05,
+                                                   max_delay_s=2.0),
+                 clock=time.monotonic, sleep=None, rng=None,
+                 registry=None):
+        self.transport = transport
+        self.name = name or getattr(transport, "target",
+                                    f"learner-{next(_client_counter)}")
+        self.policy = policy
+        self.clock = clock
+        self.sleep = sleep or time.sleep
+        self._rng = rng
+        self._seq = itertools.count()
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._rpcs_total = registry.counter(
+            "senweaver_learner_rpcs_total",
+            "Learner→fleet RPCs attempted (per attempt, not per call).",
+            labelnames=("method",))
+        self._retries_total = registry.counter(
+            "senweaver_learner_rpc_retries_total",
+            "Learner→fleet RPC retries (transient error, budget left).")
+
+    def _call(self, method: str,
+              params: Optional[Dict[str, Any]] = None, *,
+              idempotency_key: Optional[str] = None,
+              timeout_s: Optional[float] = None) -> Any:
+        request_id = idempotency_key or f"{self.name}:{next(self._seq)}"
+        budget = RetryBudget(self.policy, now=self.clock(), rng=self._rng)
+        while True:
+            self._rpcs_total.inc(method=method)
+            try:
+                return self.transport.call(
+                    method, params, request_id=request_id,
+                    timeout_s=timeout_s)
+            except RpcApplicationError as e:
+                e.raise_local()     # LeaseLost / StalePublishError / …
+            except RpcError as e:
+                if not e.retriable:
+                    raise
+                delay = budget.next_delay(
+                    now=self.clock(),
+                    retry_after_s=getattr(e, "retry_after_s", None))
+                if delay is None:
+                    raise
+                self._retries_total.inc()
+                if delay > 0:
+                    self.sleep(delay)
+
+    # -- gateway surface -----------------------------------------------------
+    def acquire_lease(self, holder: str, *,
+                      steal: bool = False) -> Dict[str, Any]:
+        return self._call("acquire_lease",
+                          {"holder": holder, "steal": steal})
+
+    def renew_lease(self, holder: str, epoch: int) -> Dict[str, Any]:
+        return self._call("renew_lease",
+                          {"holder": holder, "epoch": epoch})
+
+    def release_lease(self, holder: str, epoch: int) -> Dict[str, Any]:
+        return self._call("release_lease",
+                          {"holder": holder, "epoch": epoch})
+
+    def publish(self, params, *, epoch: int, version: int,
+                timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        # The idempotency key is the fencing token itself: a retried
+        # stage of (epoch, version) must replay, never double-stage.
+        return self._call(
+            "publish",
+            {"params": params, "epoch": epoch, "version": version},
+            idempotency_key=f"{self.name}:publish:e{epoch}:v{version}",
+            timeout_s=timeout_s)
+
+    def publish_status(self) -> Dict[str, Any]:
+        return self._call("publish_status")
+
+    def signals(self) -> Dict[str, Any]:
+        return self._call("signals")
+
+    def fleet_stats(self) -> Dict[str, Any]:
+        return self._call("fleet_stats")
+
+
+@dataclasses.dataclass(frozen=True)
+class LearnerConfig:
+    """Knobs for one learner process."""
+
+    holder: str = "learner-0"
+    # Durable (version, rounds) JSON beside the trainer's checkpoints;
+    # None = in-memory only (no crash/resume republish).
+    state_path: Optional[str] = None
+    publish_timeout_s: float = 30.0
+    # Sleep between convergence polls; 0 = poll hot (loopback tests —
+    # each poll pumps the fleet one step anyway).
+    publish_poll_interval_s: float = 0.0
+    steal_lease: bool = False
+
+
+class LearnerService:
+    """One GRPO learner: train a round, publish fenced weights, repeat.
+
+    ``trainer`` is either an object with ``run_round()`` + a
+    ``state.params`` attribute (the :class:`OnlineImprovementLoop`
+    contract) or a bare callable returning fresh params. The service
+    owns no training logic — only leadership, versioning, and the
+    publish saga."""
+
+    def __init__(self, trainer, client: FleetPublishClient, *,
+                 config: LearnerConfig = LearnerConfig(),
+                 clock=time.monotonic, sleep=None, registry=None):
+        self.trainer = trainer
+        self.client = client
+        self.config = config
+        self.clock = clock
+        self.sleep = sleep or time.sleep
+        self.epoch = 0              # guarded-by: _lock
+        self.version = 0            # guarded-by: _lock
+        self.rounds = 0             # guarded-by: _lock
+        self._lease_expires_at: Optional[float] = None  # guarded-by: _lock
+        self._lock = threading.Lock()
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._rounds_total = registry.counter(
+            "senweaver_learner_rounds_total",
+            "Training rounds the learner completed.")
+        self._publishes_total = registry.counter(
+            "senweaver_learner_publishes_total",
+            "Fenced weight publishes that converged fleet-wide.")
+        self._publish_failures_total = registry.counter(
+            "senweaver_learner_publish_failures_total",
+            "Publishes that failed to stage or converge.")
+        self._resumes_total = registry.counter(
+            "senweaver_learner_resume_republishes_total",
+            "Crash/resume republishes of the last durable version.")
+        self._lease_lost_total = registry.counter(
+            "senweaver_learner_lease_lost_total",
+            "Lease losses observed (superseded by another learner).")
+        self._epoch_gauge = registry.gauge(
+            "senweaver_learner_lease_epoch",
+            "This learner's fencing epoch (0 = no lease).")
+        self._version_gauge = registry.gauge(
+            "senweaver_learner_weight_version",
+            "Last weight version this learner published durably.")
+        self._epoch_gauge.set(0)
+        self._version_gauge.set(0)
+
+    # -- durable state -------------------------------------------------------
+    def _load_state(self) -> Dict[str, Any]:
+        path = self.config.state_path
+        if path is None or not os.path.exists(path):
+            return {}
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # Torn write from a crash — treat as no durable state; the
+            # save path is atomic, so this only covers external damage.
+            return {}
+
+    def _save_state(self) -> None:
+        path = self.config.state_path
+        if path is None:
+            return
+        with self._lock:
+            payload = {"weight_version": self.version,
+                       "rounds": self.rounds}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+
+    # -- leadership ----------------------------------------------------------
+    def start(self) -> int:
+        """Acquire the lease (a strictly higher epoch — fencing out any
+        previous incarnation) and reconverge the fleet: republish the
+        last durable version if one exists, else adopt the fleet's
+        current version so the next publish lands above it. Returns
+        the lease epoch."""
+        saved = self._load_state()
+        lease = self.client.acquire_lease(self.config.holder,
+                                          steal=self.config.steal_lease)
+        with self._lock:
+            self.epoch = int(lease["epoch"])
+            self._lease_expires_at = float(lease["expires_at"])
+            self.rounds = int(saved.get("rounds", 0))
+        self._epoch_gauge.set(self.epoch)
+        durable_version = int(saved.get("weight_version", 0))
+        if durable_version > 0:
+            with self._lock:
+                self.version = durable_version
+            self._resumes_total.inc()
+            self._publish(self._params(), durable_version)
+        else:
+            fleet_version = int(
+                self.client.signals().get("weight_version", 0))
+            with self._lock:
+                self.version = max(self.version, fleet_version)
+        self._version_gauge.set(self.version)
+        return self.epoch
+
+    def stop(self) -> None:
+        """Voluntary leadership release (best-effort — a crash skips
+        this and the TTL/fencing path covers it)."""
+        with self._lock:
+            epoch = self.epoch
+        if epoch > 0:
+            try:
+                self.client.release_lease(self.config.holder, epoch)
+            except (RpcError, LeaseLost):
+                pass
+
+    def _renew(self) -> None:
+        try:
+            lease = self.client.renew_lease(self.config.holder,
+                                            self.epoch)
+        except LeaseLost:
+            self._lease_lost_total.inc()
+            self._epoch_gauge.set(0)
+            raise
+        with self._lock:
+            self._lease_expires_at = float(lease["expires_at"])
+
+    # -- the round -----------------------------------------------------------
+    def _params(self):
+        t = self.trainer
+        state = getattr(t, "state", None)
+        if state is not None and hasattr(state, "params"):
+            return state.params
+        raise ValueError(
+            "trainer has no state.params; callable trainers return "
+            "params from run_round — call run_round() instead")
+
+    def _train(self):
+        t = self.trainer
+        if hasattr(t, "run_round"):
+            t.run_round()
+            return t.state.params
+        return t()
+
+    def run_round(self) -> int:
+        """Renew leadership, train one round, publish the new version;
+        returns the published version. Raises :class:`LeaseLost` /
+        :class:`StalePublishError` when fenced out — the caller must
+        stop training, not retry."""
+        self._renew()
+        params = self._train()
+        with self._lock:
+            self.version += 1
+            version = self.version
+        try:
+            self._publish(params, version)
+        except (LeaseLost, StalePublishError):
+            # Fenced out mid-round: roll the version back so a (buggy)
+            # caller that keeps going cannot silently skip numbers.
+            with self._lock:
+                self.version = version - 1
+            self._lease_lost_total.inc()
+            raise
+        with self._lock:
+            self.rounds += 1
+        self._rounds_total.inc()
+        self._save_state()
+        self._version_gauge.set(version)
+        return version
+
+    def run(self, rounds: int) -> int:
+        for _ in range(rounds):
+            self.run_round()
+        return self.version
+
+    # -- the publish saga ----------------------------------------------------
+    def _publish(self, params, version: int) -> None:
+        """Stage (idempotent, retry-bounded) then poll to convergence."""
+        deadline = self.clock() + self.config.publish_timeout_s
+        try:
+            self.client.publish(params, epoch=self.epoch,
+                                version=version)
+        except (LeaseLost, StalePublishError):
+            self._publish_failures_total.inc()
+            raise
+        except RpcError as e:
+            self._publish_failures_total.inc()
+            raise LearnerPublishError(
+                f"publish v{version} failed to stage: {e}") from e
+        while True:
+            try:
+                status = self.client.publish_status()
+            except RpcError as e:
+                self._publish_failures_total.inc()
+                raise LearnerPublishError(
+                    f"publish v{version} staged but convergence poll "
+                    f"failed: {e}") from e
+            if (status.get("converged")
+                    and int(status.get("version", -1)) == version
+                    and int(status.get("epoch", -1)) == self.epoch):
+                break
+            if int(status.get("epoch", 0)) > self.epoch:
+                # Another learner took over while we rolled.
+                self._publish_failures_total.inc()
+                raise LeaseLost(
+                    f"fleet moved to epoch {status.get('epoch')} while "
+                    f"publishing at epoch {self.epoch}")
+            if self.clock() >= deadline:
+                self._publish_failures_total.inc()
+                raise LearnerPublishError(
+                    f"publish v{version} staged but did not converge "
+                    f"within {self.config.publish_timeout_s}s "
+                    f"(status: {status})")
+            if self.config.publish_poll_interval_s > 0:
+                self.sleep(self.config.publish_poll_interval_s)
+        self._publishes_total.inc()
+        self._save_state()

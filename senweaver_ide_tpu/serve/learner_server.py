@@ -1,0 +1,152 @@
+"""Fleet-side learner gateway: lease authority + fenced publish over rpc.
+
+The serving fleet is the single authority over its replicas, so it is
+also the natural home for the learner lease: colocating the
+:class:`~..resilience.lease.LeaseStore` with the fleet gives
+single-writer semantics without a coordination service. The handler
+exposes exactly the surface a disaggregated learner needs:
+
+====================  ========================================================
+method                semantics
+====================  ========================================================
+acquire_lease         grant the publish lease at a fresh (higher) fencing
+                      epoch; a restarted learner fences out its zombie twin
+renew_lease           heartbeat; raises ``LeaseLost`` when superseded/expired
+release_lease         voluntary release (the epoch is retired, never reused)
+publish               STAGE a fenced ``(epoch, version)`` publish; the
+                      fleet's own pump rolls it replica by replica. Validated
+                      twice: live-lease check here, monotonic high-water
+                      check in ``WeightPublisher.begin``. Idempotent under
+                      retried request ids — a publish whose response was
+                      lost replays instead of staging twice.
+publish_status        roll progress + convergence; in manual-pump fleets each
+                      poll also advances the fleet one step, so a learner
+                      polling over loopback drives the roll it is waiting on
+signals / fleet_stats the autoscaler-ish load surface (queue depth, sheds,
+                      versions) a learner or operator reads over the wire
+====================  ========================================================
+
+Publishes are a resumable saga: stage (durable fleet-side) → roll
+(advanced by the fleet pump, partition-tolerant via quarantine) →
+confirm (the learner polls ``publish_status``). A learner killed after
+stage loses nothing — the roll still lands; its successor re-acquires
+the lease at a higher epoch and republishes its last durable version,
+which supersedes any torn roll.
+
+:func:`serve_fleet_http` puts the handler on a real socket (same JSON
+frame as the engine shim); tests run it behind ``LoopbackTransport``
+with a ``NetworkFaultPlan`` for deterministic partition chaos.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ..resilience.lease import LeaseStore
+from .remote_server import RpcHandlerBase, serve_rpc_http
+from .replica import DEAD
+
+# Lease mutations and publish staging consult the idempotency cache;
+# status/signals are reads and must see fresh state.
+LEARNER_MUTATING_METHODS = frozenset({
+    "acquire_lease", "renew_lease", "release_lease", "publish"})
+
+
+class FleetRpcHandler(RpcHandlerBase):
+    """Lease + fenced-publish dispatch table over one ServingFleet."""
+
+    mutating_methods = LEARNER_MUTATING_METHODS
+
+    def __init__(self, fleet, *, lease_store: Optional[LeaseStore] = None,
+                 lease_ttl_s: float = 30.0, clock=None,
+                 idempotency_cache_size: int = 1024, registry=None):
+        super().__init__(idempotency_cache_size=idempotency_cache_size)
+        self.fleet = fleet
+        self.clock = clock if clock is not None else fleet.clock
+        if registry is None:
+            registry = fleet.registry
+        self.lease_store = lease_store or LeaseStore(
+            ttl_s=lease_ttl_s, registry=registry)
+
+    # -- lease ---------------------------------------------------------------
+    def _m_acquire_lease(self, holder, steal=False) -> Dict[str, Any]:
+        lease = self.lease_store.acquire(str(holder), now=self.clock(),
+                                         steal=bool(steal))
+        return {"epoch": lease.epoch, "expires_at": lease.expires_at,
+                "ttl_s": self.lease_store.ttl_s}
+
+    def _m_renew_lease(self, holder, epoch) -> Dict[str, Any]:
+        lease = self.lease_store.renew(str(holder), int(epoch),
+                                       now=self.clock())
+        return {"epoch": lease.epoch, "expires_at": lease.expires_at}
+
+    def _m_release_lease(self, holder, epoch) -> Dict[str, Any]:
+        return {"released": self.lease_store.release(str(holder),
+                                                     int(epoch))}
+
+    # -- publish saga --------------------------------------------------------
+    def _m_publish(self, params, epoch, version) -> Dict[str, Any]:
+        # Fencing check 1: the epoch must be the LIVE lease (raises
+        # LeaseLost across the wire). Check 2 is the publisher's own
+        # monotonic high-water mark — both must pass.
+        self.lease_store.validate(int(epoch), now=self.clock())
+        v = self.fleet.begin_publish(params, epoch=int(epoch),
+                                     version=int(version))
+        return {"version": v, "epoch": int(epoch), "staged": True}
+
+    def _m_publish_status(self) -> Dict[str, Any]:
+        # Manual-pump fleets advance one step per poll so a loopback
+        # learner's status loop drives the roll it waits on; threaded
+        # fleets are already pumped by their dispatcher.
+        if not self.fleet.threaded:
+            self.fleet.step()
+        pub = self.fleet.publisher
+        versions = [r.weight_version for r in self.fleet.replicas
+                    if r.state != DEAD]
+        return {
+            "in_progress": pub.in_progress,
+            "version": pub.version,
+            "epoch": pub.epoch,
+            "skew": pub.skew(),
+            "replicas_live": len(versions),
+            "min_version": min(versions) if versions else 0,
+            "max_version": max(versions) if versions else 0,
+            "converged": (not pub.in_progress and versions != []
+                          and min(versions) == max(versions)
+                          == pub.version),
+        }
+
+    # -- load / health surface -----------------------------------------------
+    def _m_signals(self) -> Dict[str, Any]:
+        pub = self.fleet.publisher
+        return {
+            "queue_depth": self.fleet.admission.depth(),
+            "replicas_live": sum(r.state != DEAD
+                                 for r in self.fleet.replicas),
+            "weight_version": pub.version,
+            "publish_epoch": pub.epoch,
+            "publish_in_progress": pub.in_progress,
+        }
+
+    def _m_fleet_stats(self) -> Dict[str, Any]:
+        s = self.fleet.stats()
+        return {k: s[k] for k in (
+            "replicas_live", "queue_depth", "pending", "completed",
+            "rejected", "weight_version", "publish_epoch",
+            "weight_version_skew", "publish_in_progress") if k in s}
+
+    def _m_health(self) -> Dict[str, Any]:
+        return {"state": "ok",
+                "replicas_live": sum(r.state != DEAD
+                                     for r in self.fleet.replicas)}
+
+
+def serve_fleet_http(fleet_or_handler, *, host: str = "127.0.0.1",
+                     port: int = 0):
+    """Serve a fleet's learner gateway over real HTTP; returns
+    ``(server, port)`` (started daemon ``ThreadingHTTPServer``)."""
+    handler = (fleet_or_handler
+               if isinstance(fleet_or_handler, FleetRpcHandler)
+               else FleetRpcHandler(fleet_or_handler))
+    return serve_rpc_http(handler, host=host, port=port,
+                          thread_name="serve-learner-http")

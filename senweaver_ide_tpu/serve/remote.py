@@ -52,6 +52,10 @@ class RemoteEngineClient:
 
     # EngineReplica.submit checks this before passing idempotency_key.
     supports_idempotency = True
+    # EngineReplica.install_weights checks this before passing the
+    # (epoch, version) fencing token — the remote handler keeps its own
+    # high-water mark and rejects stale writers at the host boundary.
+    supports_versioned_update = True
 
     def __init__(self, transport, *, name: Optional[str] = None,
                  policy: RetryPolicy = RetryPolicy(max_retries=2,
@@ -207,8 +211,13 @@ class RemoteEngineClient:
     def release_prefix(self, prefix_id: int) -> None:
         self._call("release_prefix", {"prefix_id": prefix_id})
 
-    def update_params(self, params) -> None:
-        self._call("update_params", {"params": params})
+    def update_params(self, params, *, version: Optional[int] = None,
+                      epoch: Optional[int] = None) -> None:
+        call_params: Dict[str, Any] = {"params": params}
+        if version is not None:
+            call_params["version"] = int(version)
+            call_params["epoch"] = 0 if epoch is None else int(epoch)
+        self._call("update_params", call_params)
 
     def stats(self) -> Dict[str, Any]:
         return dict(self._call("stats"))

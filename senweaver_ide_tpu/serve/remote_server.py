@@ -36,11 +36,18 @@ MUTATING_METHODS = frozenset({
     "release_prefix", "update_params"})
 
 
-class EngineRpcHandler:
-    """Dispatch table + idempotency cache over one local engine."""
+class RpcHandlerBase:
+    """Dispatch table + idempotency cache; subclasses provide ``_m_*``
+    methods and declare which of them mutate via ``mutating_methods``.
 
-    def __init__(self, engine, *, idempotency_cache_size: int = 4096):
-        self.engine = engine
+    The cache is the exactly-once half of the fleet's retry contract: a
+    retried mutating call (the client saw a timeout; the server may or
+    may not have executed) replays the cached outcome — including cached
+    application ERRORS — instead of executing twice."""
+
+    mutating_methods: frozenset = frozenset()
+
+    def __init__(self, *, idempotency_cache_size: int = 4096):
         self._cache_size = max(1, int(idempotency_cache_size))
         # request_id -> ("ok" | "err", payload) — replayed on duplicates
         self._cache: "collections.OrderedDict[str, Tuple[str, Any]]" = \
@@ -55,7 +62,8 @@ class EngineRpcHandler:
         fn = getattr(self, f"_m_{method}", None)
         if fn is None:
             raise RpcProtocolError(f"unknown rpc method {method!r}")
-        cacheable = request_id is not None and method in MUTATING_METHODS
+        cacheable = (request_id is not None
+                     and method in self.mutating_methods)
         if cacheable:
             with self._lock:
                 hit = self._cache.get(request_id)
@@ -85,6 +93,31 @@ class EngineRpcHandler:
         if status == "ok":
             return payload
         raise RpcApplicationError(payload[0], payload[1])
+
+
+class EngineRpcHandler(RpcHandlerBase):
+    """The whole remote side of the cross-host fleet: a dispatch table
+    over one local engine (plus the idempotency cache from the base)."""
+
+    mutating_methods = MUTATING_METHODS
+
+    def __init__(self, engine, *, idempotency_cache_size: int = 4096,
+                 registry=None):
+        super().__init__(idempotency_cache_size=idempotency_cache_size)
+        self.engine = engine
+        # Host-side fencing high-water mark for versioned publishes —
+        # the last line of defense against a stale writer reaching this
+        # replica directly (same rule as WeightPublisher.begin, except
+        # an EQUAL version at a >= epoch is an idempotent reinstall).
+        self._hw_epoch = 0                  # guarded-by: _lock
+        self._hw_version = 0                # guarded-by: _lock
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._stale_total = registry.counter(
+            "senweaver_serve_stale_publish_total",
+            "Publishes rejected by (epoch, version) fencing — a stale "
+            "or duplicate writer was denied.")
 
     # -- methods -------------------------------------------------------------
     def _m_health(self) -> Dict[str, Any]:
@@ -135,7 +168,19 @@ class EngineRpcHandler:
     def _m_release_prefix(self, prefix_id) -> None:
         self.engine.release_prefix(int(prefix_id))
 
-    def _m_update_params(self, params) -> None:
+    def _m_update_params(self, params, version=None, epoch=None) -> None:
+        if version is not None:
+            from .weights import StalePublishError
+            v, e = int(version), int(epoch or 0)
+            with self._lock:
+                if e < self._hw_epoch or (e == self._hw_epoch
+                                          and v < self._hw_version):
+                    self._stale_total.inc()
+                    raise StalePublishError(
+                        f"update_params (epoch={e}, version={v}) behind "
+                        f"this host's high-water mark (epoch="
+                        f"{self._hw_epoch}, version={self._hw_version})")
+                self._hw_epoch, self._hw_version = e, v
         self.engine.update_params(params)
 
     def _m_stats(self) -> Dict[str, Any]:
@@ -150,11 +195,19 @@ def serve_engine_http(engine_or_handler, *, host: str = "127.0.0.1",
     call ``server.shutdown()`` when done. Port 0 picks a free port —
     the test-friendly default.
     """
-    import http.server
-
     handler = (engine_or_handler
-               if isinstance(engine_or_handler, EngineRpcHandler)
+               if isinstance(engine_or_handler, RpcHandlerBase)
                else EngineRpcHandler(engine_or_handler))
+    return serve_rpc_http(handler, host=host, port=port)
+
+
+def serve_rpc_http(handler: RpcHandlerBase, *, host: str = "127.0.0.1",
+                   port: int = 0, thread_name: str = "serve-rpc-http"):
+    """Serve any :class:`RpcHandlerBase` behind the :data:`~.rpc.RPC_PATH`
+    JSON frame over a stdlib ``ThreadingHTTPServer``; returns
+    ``(server, port)``. Shared by the engine shim above and the
+    learner gateway (``learner_server.serve_fleet_http``)."""
+    import http.server
 
     class _Rpc(http.server.BaseHTTPRequestHandler):
         def do_POST(self):     # noqa: N802 (stdlib naming)
@@ -196,6 +249,6 @@ def serve_engine_http(engine_or_handler, *, host: str = "127.0.0.1",
     server = http.server.ThreadingHTTPServer((host, port), _Rpc)
     server.daemon_threads = True
     thread = threading.Thread(target=server.serve_forever,
-                              name="serve-rpc-http", daemon=True)
+                              name=thread_name, daemon=True)
     thread.start()
     return server, server.server_address[1]

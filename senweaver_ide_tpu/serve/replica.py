@@ -290,17 +290,27 @@ class EngineReplica:
                 getattr(self.engine, "has_work", False))
 
     # -- weights -------------------------------------------------------------
-    def install_weights(self, params, version: int) -> None:
+    def install_weights(self, params, version: int,
+                        epoch: Optional[int] = None) -> None:
         """Swap in a published weight version. The publisher only calls
         this at zero in-flight (drain-first), which is the whole
         no-mixed-versions guarantee; asserting it here turns a publisher
-        bug into a loud error instead of silent off-policy tokens."""
+        bug into a loud error instead of silent off-policy tokens.
+
+        Version-aware engines (``RemoteEngineClient``) get the fencing
+        token too, so the REMOTE host enforces its own (epoch, version)
+        high-water mark — a stale writer that somehow reaches a replica
+        directly is still rejected at the engine boundary."""
         with self._lock:
             if self.inflight:
                 raise RuntimeError(
                     f"{self.replica_id}: install_weights with "
                     f"{len(self.inflight)} in flight — drain first")
-            self.engine.update_params(params)
+            if getattr(self.engine, "supports_versioned_update", False):
+                self.engine.update_params(params, version=int(version),
+                                          epoch=epoch)
+            else:
+                self.engine.update_params(params)
             self.weight_version = int(version)
             self._prefixes.clear()      # engine dropped old-policy KV
             self._version_gauge.set(version, replica=self.replica_id)

@@ -110,6 +110,15 @@ class RpcApplicationError(RpcError):
         if self.error_type == "PrefixImportError":
             from ..rollout.engine import PrefixImportError
             raise PrefixImportError(self.message) from self
+        if self.error_type == "StalePublishError":
+            from .weights import StalePublishError
+            raise StalePublishError(self.message) from self
+        if self.error_type == "LeaseLost":
+            from ..resilience.lease import LeaseLost
+            raise LeaseLost(self.message) from self
+        if self.error_type == "LeaseUnavailable":
+            from ..resilience.lease import LeaseUnavailable
+            raise LeaseUnavailable(self.message) from self
         raise self
 
 

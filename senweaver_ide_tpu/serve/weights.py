@@ -31,12 +31,26 @@ from typing import List, Optional, Sequence
 from .replica import DEAD, LIVE, EngineReplica
 
 
+class StalePublishError(RuntimeError):
+    """Fenced-out publish: the offered ``(lease_epoch, weight_version)``
+    is not ahead of the fleet's high-water mark. Raised instead of
+    applied — a zombie or duplicate learner can never roll the fleet
+    backward or interleave versions. Not retriable: the writer must
+    re-acquire the lease (a higher epoch) before publishing again."""
+
+
 class WeightPublisher:
     def __init__(self, replicas: Sequence[EngineReplica], *,
                  registry=None):
         self.replicas = list(replicas)
         # latest PUBLISHED (begun) version
         self.version = 0                        # guarded-by: _lock
+        # Fencing high-water mark: the lease epoch of the newest
+        # accepted publish. Within an epoch versions are strictly
+        # monotonic; a HIGHER epoch may republish any version (the
+        # crash-resume reconvergence path rolls back to the learner's
+        # last durable version); a LOWER epoch is rejected outright.
+        self.epoch = 0                          # guarded-by: _lock
         self._pending_params = None             # guarded-by: _lock
         self._roll_queue: List[EngineReplica] = []  # guarded-by: _lock
         self._current: Optional[EngineReplica] = None  # guarded-by: _lock
@@ -57,6 +71,10 @@ class WeightPublisher:
             "senweaver_serve_publish_quarantined_total",
             "Replicas quarantined mid-publish (install unreachable/"
             "failed); the roll completes on the reachable set.")
+        self._stale_total = registry.counter(
+            "senweaver_serve_stale_publish_total",
+            "Publishes rejected by (epoch, version) fencing — a stale "
+            "or duplicate writer was denied.")
         # install_weights failures collected here for the fleet to turn
         # into proper deaths (orphan triage included); the publisher
         # itself never kills — it has no router.
@@ -87,15 +105,37 @@ class WeightPublisher:
             return 0
         return max(versions) - min(versions)
 
-    def begin(self, params) -> int:
+    def begin(self, params, *, epoch: Optional[int] = None,
+              version: Optional[int] = None) -> int:
         """Stage a new version for rolling install; returns it. A begin
         during an unfinished roll fast-forwards: the in-progress roll
         retargets to the newest params (replicas already swapped to the
         superseded version will be re-rolled — they're in the queue
         again), which is the right semantics for a trainer publishing
-        faster than the fleet drains."""
+        faster than the fleet drains.
+
+        ``(epoch, version)`` is the fencing token a disaggregated
+        learner stamps on every publish. Defaults (None) mean the
+        in-process trainer path: current epoch, next version. The
+        monotonic rule: ``epoch`` below the high-water mark is rejected
+        (:class:`StalePublishError`); at the SAME epoch the version
+        must strictly increase; a HIGHER epoch may carry any version —
+        that is the crash-resume republish, which deliberately rolls
+        the fleet back to the new leader's last durable weights."""
         with self._lock:
-            self.version += 1
+            new_epoch = self.epoch if epoch is None else int(epoch)
+            new_version = (self.version + 1 if version is None
+                           else int(version))
+            if new_epoch < self.epoch or (
+                    new_epoch == self.epoch
+                    and new_version <= self.version):
+                self._stale_total.inc()
+                raise StalePublishError(
+                    f"publish (epoch={new_epoch}, version={new_version})"
+                    f" is behind the fleet's high-water mark "
+                    f"(epoch={self.epoch}, version={self.version})")
+            self.epoch = new_epoch
+            self.version = new_version
             self._pending_params = params
             self._publishes_total.inc()
             # (Re)build the roll queue: every non-dead replica needs the
@@ -139,7 +179,7 @@ class WeightPublisher:
             if cur.outstanding == 0:
                 try:
                     cur.install_weights(self._pending_params,
-                                        self.version)
+                                        self.version, epoch=self.epoch)
                 except Exception:
                     # Unreachable (or otherwise failed) mid-publish: the
                     # roll must converge on the REACHABLE set, not wedge

@@ -254,6 +254,26 @@ class DashboardService:
                     "senweaver_serve_continuation_replays_total"),
                 "publish_quarantined": total(
                     "senweaver_serve_publish_quarantined_total"),
+                "stale_publishes": total(
+                    "senweaver_serve_stale_publish_total"),
+                "lease_epoch": total("senweaver_lease_epoch"),
+                "learner_rounds": total(
+                    "senweaver_learner_rounds_total"),
+                "learner_publishes": total(
+                    "senweaver_learner_publishes_total"),
+                "learner_publish_failures": total(
+                    "senweaver_learner_publish_failures_total"),
+                "learner_resume_republishes": total(
+                    "senweaver_learner_resume_republishes_total"),
+                "learner_lease_lost": total(
+                    "senweaver_learner_lease_lost_total"),
+                "autoscale_adds": total_where(
+                    "senweaver_serve_autoscale_actions_total", 0, "add"),
+                "autoscale_drains": total_where(
+                    "senweaver_serve_autoscale_actions_total", 0,
+                    "drain"),
+                "autoscale_shed_rate": total(
+                    "senweaver_serve_autoscale_shed_rate"),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -453,6 +473,8 @@ input[type=text], input[type=password], textarea {
 <section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
 </section>
 <section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
+<section><h2>Learner &amp; autoscaler</h2>
+<div id="learner" class="tiles"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2>
 <div class="actionbar">
@@ -684,6 +706,17 @@ async function refresh() {
     ["probes dead", sv.probes_dead],
     ["continuation replays", sv.continuation_replays],
     ["publish quarantined", sv.publish_quarantined]]);
+  tiles(document.getElementById("learner"), [
+    ["lease epoch", sv.lease_epoch],
+    ["learner rounds", sv.learner_rounds],
+    ["learner publishes", sv.learner_publishes],
+    ["publish failures", sv.learner_publish_failures],
+    ["resume republishes", sv.learner_resume_republishes],
+    ["lease lost", sv.learner_lease_lost],
+    ["stale publishes fenced", sv.stale_publishes],
+    ["autoscale adds", sv.autoscale_adds],
+    ["autoscale drains", sv.autoscale_drains],
+    ["shed rate (1/s)", sv.autoscale_shed_rate]]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

@@ -163,6 +163,12 @@ class OnlineImprovementLoop:
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_every = checkpoint_every
         self._round = 0
+        # Last weight version a versioned engine (ServingFleet) acked
+        # for this loop's params; persisted so resume() can republish AT
+        # that version instead of letting a fresh publisher restart at 1
+        # (which would make the skew gauge and the round↔version metric
+        # trail lie after a restart).
+        self._published_version: Optional[int] = None
         # Atomic id source: sessions are created from the collection
         # pool's worker threads (a racy += would hand two episodes the
         # same thread_id and cross-attribute their traces). The loop
@@ -265,12 +271,13 @@ class OnlineImprovementLoop:
             # returns None. Record the version + serving state so the
             # metrics trail ties each training round to the weight
             # version its next round samples from.
-            if isinstance(published, int) \
-                    and self.metrics_service is not None:
-                self.metrics_service.capture("Weights Published", {
-                    "round": self._round,
-                    "weight_version": published,
-                })
+            if isinstance(published, int):
+                self._published_version = published
+                if self.metrics_service is not None:
+                    self.metrics_service.capture("Weights Published", {
+                        "round": self._round,
+                        "weight_version": published,
+                    })
             if hasattr(self.engine, "record_snapshot"):
                 self.engine.record_snapshot()
 
@@ -330,6 +337,7 @@ class OnlineImprovementLoop:
             "online_session_cursor": self._session_ids.peek(),
             "online_rules": self.current_rules(),
             "online_anchor": self._anchor is not None,
+            "online_weight_version": self._published_version,
         })
         if self._anchor is not None:
             import jax
@@ -386,5 +394,26 @@ class OnlineImprovementLoop:
                 loop._anchor = state.params
         if loop.engine is not None and hasattr(loop.engine,
                                                "update_params"):
-            loop.engine.update_params(state.params)
+            saved_version = meta.get("online_weight_version")
+            published = _republish(loop.engine, state.params,
+                                   saved_version)
+            if isinstance(published, int):
+                loop._published_version = published
         return loop
+
+
+def _republish(engine, params, saved_version: Optional[int]):
+    """Republish restored params, stamping the checkpointed weight
+    version onto versioned engines (ServingFleet).
+
+    Without the stamp a restarted fleet would hand out version 1 for
+    weights that are really round-N's, so the version-skew gauge and the
+    round↔version metric trail would lie after every resume. Only pass
+    the version when it actually advances the publisher — a fleet that
+    survived the trainer restart already holds >= saved_version and a
+    re-stamp would (correctly) be fenced as stale."""
+    publisher = getattr(engine, "publisher", None)
+    if (saved_version is not None and publisher is not None
+            and int(saved_version) > publisher.version):
+        return engine.update_params(params, version=int(saved_version))
+    return engine.update_params(params)

@@ -1,0 +1,428 @@
+"""Disaggregated learner: lease fencing, crash/resume republish,
+publish-saga chaos, and admission-driven autoscaling.
+
+Everything is HERMETIC on CPU: the learner speaks to an in-process
+:class:`FleetRpcHandler` over ``LoopbackTransport`` (same frames and
+retry/idempotency paths as HTTP, zero sockets), chaos comes from a
+deterministic :class:`NetworkFaultPlan`, and time is a fake clock —
+except one end-to-end test across a real loopback HTTP socket.
+
+The ISSUE acceptance invariants:
+
+- a learner killed mid-publish and restarted (higher lease epoch,
+  republish of its last DURABLE version) leaves every live replica on
+  exactly one version — no version mixing survives recovery;
+- a concurrent stale-epoch learner cannot publish: renew raises
+  ``LeaseLost``, direct publishes are fenced fleet-wide
+  (``StalePublishError`` / ``LeaseLost``), and the counter moves;
+- a retried publish whose response was lost REPLAYS server-side
+  (idempotency cache), never double-stages;
+- the autoscaler resolves sustained overload with exactly one
+  ``add``, retires on sustained idle with exactly one ``drain``, and
+  never flaps.
+"""
+
+import time
+
+import jax
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.resilience import (LeaseLost, LeaseStore,
+                                          LeaseUnavailable, NetworkFault,
+                                          NetworkFaultPlan, RetryPolicy)
+from senweaver_ide_tpu.rollout import RolloutEngine
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import (ACTION_ADD, ACTION_DRAIN,
+                                     AdmissionConfig, AutoscaleConfig,
+                                     ClassPolicy, DEAD, FleetPublishClient,
+                                     FleetRpcHandler, HttpTransport,
+                                     LearnerConfig, LearnerService,
+                                     LoopbackTransport, ServingFleet,
+                                     StalePublishError, serve_fleet_http)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+# Fast deterministic client policy: still multiple attempts (so the
+# idempotency replay path is exercised), zero backoff, no jitter.
+FAST = RetryPolicy(max_retries=2, base_delay_s=0.0, jitter=False)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_engine(model, num_slots=2, max_len=64):
+    params, config = model
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY)
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class FakeTrainer:
+    """The OnlineImprovementLoop contract the learner needs: run_round()
+    plus state.params, with params that visibly change per round."""
+
+    class _State:
+        def __init__(self, params):
+            self.params = params
+
+    def __init__(self, params):
+        self.state = self._State(params)
+        self.rounds = 0
+
+    def run_round(self):
+        self.rounds += 1
+        self.state.params = jax.tree_util.tree_map(
+            lambda x: x + 0.001, self.state.params)
+
+
+def make_stack(model, n_replicas, *, clock, plan=None, lease_ttl_s=30.0,
+               holder="learner-0", state_path=None):
+    """Fleet of local engines + gateway handler + loopback learner."""
+    fleet = ServingFleet([make_engine(model) for _ in range(n_replicas)],
+                         clock=clock, probe_interval_s=0.0,
+                         retry_base_delay_s=0.0)
+    handler = FleetRpcHandler(fleet, lease_ttl_s=lease_ttl_s, clock=clock)
+    transport = LoopbackTransport(handler, target="fleet-gw",
+                                  fault_plan=plan)
+    client = FleetPublishClient(transport, name=holder, policy=FAST,
+                                clock=clock, sleep=lambda s: None)
+    return fleet, handler, client
+
+
+def make_learner(client, trainer, *, clock, holder="learner-0",
+                 state_path=None):
+    return LearnerService(
+        trainer, client, clock=clock, sleep=lambda s: None,
+        config=LearnerConfig(holder=holder, state_path=state_path))
+
+
+def live_versions(fleet):
+    return sorted(r.weight_version for r in fleet.replicas
+                  if r.state != DEAD)
+
+
+# ---- lease store fencing units (fake clock) ------------------------------
+
+def test_lease_store_epochs_monotonic_across_contention_and_expiry():
+    clock = FakeClock()
+    store = LeaseStore(ttl_s=10.0)
+    a = store.acquire("a", now=clock())
+    assert a.epoch == 1
+    # Unexpired foreign holder: contention, not fencing.
+    with pytest.raises(LeaseUnavailable):
+        store.acquire("b", now=clock())
+    # Same holder re-acquires ABOVE its own epoch (the restart path).
+    a2 = store.acquire("a", now=clock())
+    assert a2.epoch == 2
+    # Expiry frees the lease; the epoch keeps climbing.
+    clock.advance(11.0)
+    b = store.acquire("b", now=clock())
+    assert b.epoch == 3
+    # Strict renew: the superseded epoch is LOST, not recoverable.
+    with pytest.raises(LeaseLost):
+        store.renew("a", a2.epoch, now=clock())
+    # An expired lease cannot be renewed even when unclaimed.
+    clock.advance(11.0)
+    with pytest.raises(LeaseLost):
+        store.renew("b", b.epoch, now=clock())
+    # Steal preempts an unexpired holder at a higher epoch.
+    c = store.acquire("c", now=clock())
+    d = store.acquire("d", now=clock(), steal=True)
+    assert d.epoch == c.epoch + 1
+    with pytest.raises(LeaseLost):
+        store.validate(c.epoch, now=clock())
+    store.validate(d.epoch, now=clock())
+
+
+def test_publisher_fencing_rejects_stale_epoch_and_version(model):
+    clock = FakeClock()
+    fleet = ServingFleet([make_engine(model), make_engine(model)],
+                         clock=clock, probe_interval_s=0.0)
+    params = model[0]
+    assert fleet.update_params(params) == 1
+    # Same epoch, non-advancing version: fenced, counted, untouched.
+    with pytest.raises(StalePublishError):
+        fleet.update_params(params, epoch=0, version=1)
+    # Lower epoch than the high-water mark: fenced regardless of version.
+    assert fleet.update_params(params, epoch=3, version=7) == 7
+    with pytest.raises(StalePublishError):
+        fleet.update_params(params, epoch=2, version=100)
+    reg = obs.get_registry()
+    assert reg.get("senweaver_serve_stale_publish_total") \
+        .samples()[()] == 2
+    # A HIGHER epoch may carry a lower version — that is the
+    # crash-resume republish, rolling back to durable weights.
+    assert fleet.update_params(params, epoch=4, version=2) == 2
+    assert live_versions(fleet) == [2, 2]
+    assert fleet.publisher.skew() == 0
+
+
+# ---- learner rounds over loopback ----------------------------------------
+
+def test_learner_rounds_publish_and_converge_over_loopback(model):
+    clock = FakeClock()
+    fleet, handler, client = make_stack(model, 2, clock=clock)
+    learner = make_learner(client, FakeTrainer(model[0]), clock=clock)
+    assert learner.start() == 1
+    for expect in (1, 2, 3):
+        assert learner.run_round() == expect
+    assert fleet.publisher.version == 3
+    assert fleet.publisher.epoch == 1
+    assert live_versions(fleet) == [3, 3]
+    assert learner.trainer.rounds == 3
+    reg = obs.get_registry()
+    assert reg.get("senweaver_learner_publishes_total").samples()[()] == 3
+    assert reg.get("senweaver_learner_rounds_total").samples()[()] == 3
+    assert reg.get("senweaver_learner_weight_version").samples()[()] == 3
+    learner.stop()
+    # Released: the next incarnation still gets a HIGHER epoch.
+    assert handler.lease_store.current() is None
+
+
+# ---- chaos: kill mid-publish, restart, reconverge ------------------------
+
+def test_mid_publish_kill_restart_republishes_without_version_mixing(
+        model, tmp_path):
+    state_path = str(tmp_path / "learner_state.json")
+    clock = FakeClock()
+    fleet, handler, client = make_stack(model, 3, clock=clock,
+                                        state_path=state_path)
+    a = make_learner(client, FakeTrainer(model[0]), clock=clock,
+                     state_path=state_path)
+    a.start()
+    a.run_round()
+    a.run_round()                       # durable state: v2, converged
+    assert live_versions(fleet) == [2, 2, 2]
+
+    # Learner A stages v3 then DIES before the roll finishes: one pump
+    # step swaps exactly one replica — the fleet is mid-roll, mixed.
+    client.publish(a.trainer.state.params, epoch=a.epoch, version=3)
+    fleet.step()
+    assert fleet.publisher.in_progress
+    assert set(live_versions(fleet)) == {2, 3}, "test wants a torn roll"
+
+    # Restart: same holder, same durable state file. The lease comes
+    # back at a strictly higher epoch; the last DURABLE version (v2)
+    # is republished, superseding the torn v3 roll.
+    client_b = FleetPublishClient(
+        LoopbackTransport(handler, target="fleet-gw"), name="learner-0b",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+    b = make_learner(client_b, FakeTrainer(model[0]), clock=clock,
+                     state_path=state_path)
+    assert b.start() == 2
+    assert b.version == 2
+    assert not fleet.publisher.in_progress
+    assert live_versions(fleet) == [2, 2, 2], "no version mixing"
+    assert fleet.publisher.version == 2
+    assert fleet.publisher.epoch == 2
+    assert fleet.publisher.skew() == 0
+    reg = obs.get_registry()
+    assert reg.get("senweaver_learner_resume_republishes_total") \
+        .samples()[()] == 1
+    # Training continues above the durable version.
+    assert b.run_round() == 3
+    assert live_versions(fleet) == [3, 3, 3]
+
+
+def test_duplicate_learner_split_brain_is_fenced_fleet_wide(model):
+    clock = FakeClock()
+    fleet, handler, client_a = make_stack(model, 2, clock=clock,
+                                          lease_ttl_s=10.0)
+    a = make_learner(client_a, FakeTrainer(model[0]), clock=clock,
+                     holder="learner-a")
+    a.start()
+    a.run_round()                       # fleet at (e1, v1)
+
+    # A pauses past its TTL (GC / preemption); B takes over.
+    clock.advance(11.0)
+    client_b = FleetPublishClient(
+        LoopbackTransport(handler, target="fleet-gw"), name="learner-b",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+    b = make_learner(client_b, FakeTrainer(model[0]), clock=clock,
+                     holder="learner-b")
+    assert b.start() == 2
+    assert b.version == 1               # adopted the fleet's version
+    assert b.run_round() == 2           # fleet at (e2, v2)
+
+    # Zombie A wakes up: its renew is LOST (across the wire, typed)...
+    with pytest.raises(LeaseLost):
+        a.run_round()
+    # ...a direct publish at its stale epoch is fenced by the lease...
+    with pytest.raises(LeaseLost):
+        client_a.publish(model[0], epoch=1, version=99)
+    # ...and even the LIVE epoch cannot roll the version backward.
+    with pytest.raises(StalePublishError):
+        client_b.publish(model[0], epoch=2, version=1)
+    assert fleet.publisher.version == 2
+    assert fleet.publisher.epoch == 2
+    assert live_versions(fleet) == [2, 2]
+    reg = obs.get_registry()
+    assert reg.get("senweaver_learner_lease_lost_total") \
+        .samples()[()] >= 1
+    assert reg.get("senweaver_serve_stale_publish_total") \
+        .samples()[()] == 1
+
+    # B keeps publishing unharmed after the zombie's attempts.
+    assert b.run_round() == 3
+    assert live_versions(fleet) == [3, 3]
+
+
+def test_publish_with_lost_response_replays_not_double_stages(model):
+    clock = FakeClock()
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop_response", method="publish", times=1),
+    ])
+    fleet, handler, client = make_stack(model, 2, clock=clock, plan=plan)
+    learner = make_learner(client, FakeTrainer(model[0]), clock=clock)
+    learner.start()
+    assert learner.run_round() == 1
+    # The server EXECUTED the first attempt (response lost); the retry
+    # carried the same (epoch, version)-keyed request id and REPLAYED.
+    assert handler.executed["publish"] == 1
+    assert handler.replays >= 1
+    assert fleet.publisher.version == 1
+    assert live_versions(fleet) == [1, 1]
+
+
+# ---- autoscaler hysteresis under overload --------------------------------
+
+def test_autoscaler_adds_once_under_overload_then_drains_once(model):
+    clock = FakeClock()
+    fleet = ServingFleet(
+        [make_engine(model)], clock=clock, probe_interval_s=0.0,
+        admission=AdmissionConfig(
+            train_rollout=ClassPolicy(max_queue=512)))
+    controller = fleet.attach_autoscaler(
+        lambda: make_engine(model),
+        config=AutoscaleConfig(
+            min_replicas=1, max_replicas=2, queue_depth_high=4,
+            shed_rate_high=1e9, sustain_s=1.0, idle_sustain_s=3.0,
+            cooldown_s=2.0, evaluate_interval_s=0.0))
+    for _ in range(24):
+        fleet.submit([1, 2, 3], max_new_tokens=4)
+    # Overload phase: queue depth stays above the threshold long past
+    # the sustain window → exactly one add (bounded by max_replicas).
+    while fleet.pending():
+        clock.advance(0.5)
+        fleet.step()
+    assert [a for _, a in controller.actions] == [ACTION_ADD]
+    assert sum(r.state != DEAD for r in fleet.replicas) == 2
+    # Idle phase: sustained idleness retires the extra replica through
+    # drain → zero outstanding → the fleet's normal death path.
+    for _ in range(20):
+        clock.advance(0.5)
+        fleet.step()
+    assert [a for _, a in controller.actions] == [ACTION_ADD, ACTION_DRAIN]
+    assert sum(r.state != DEAD for r in fleet.replicas) == 1
+    # No flapping: continued idleness never adds the replica back and
+    # never drains below min_replicas.
+    for _ in range(20):
+        clock.advance(0.5)
+        fleet.step()
+    assert [a for _, a in controller.actions] == [ACTION_ADD, ACTION_DRAIN]
+    assert sum(r.state != DEAD for r in fleet.replicas) == 1
+    reg = obs.get_registry()
+    assert reg.get("senweaver_serve_autoscale_actions_total") \
+        .samples() == {("add",): 1, ("drain",): 1}
+    assert reg.get("senweaver_serve_autoscale_shed_rate") \
+        .samples()[()] == 0.0
+
+
+def test_autoscaler_never_drains_during_a_publish_roll(model):
+    clock = FakeClock()
+    fleet = ServingFleet([make_engine(model), make_engine(model)],
+                         clock=clock, probe_interval_s=0.0)
+    controller = fleet.attach_autoscaler(
+        lambda: make_engine(model),
+        config=AutoscaleConfig(
+            min_replicas=1, max_replicas=2, queue_depth_high=4,
+            shed_rate_high=1e9, sustain_s=1.0, idle_sustain_s=0.5,
+            cooldown_s=0.0, evaluate_interval_s=0.0))
+    # Stage a publish; while the roll is in progress the idle path must
+    # not begin a retirement (a retiring replica mid-roll would resume
+    # under the publisher).
+    fleet.begin_publish(model[0])
+    clock.advance(1.0)
+    fleet.step()                # roll in progress on this pump
+    assert controller.actions == []
+    # Once the roll lands, sustained idleness drains as usual.
+    while fleet.publisher.in_progress:
+        clock.advance(0.5)
+        fleet.step()
+    for _ in range(10):
+        clock.advance(0.5)
+        fleet.step()
+    assert [a for _, a in controller.actions] == [ACTION_DRAIN]
+
+
+# ---- online-loop resume stamps the restored version ----------------------
+
+def test_resume_republish_stamps_saved_version_onto_fleet(model):
+    from senweaver_ide_tpu.training.online import _republish
+    clock = FakeClock()
+    params = model[0]
+    fleet = ServingFleet([make_engine(model), make_engine(model)],
+                         clock=clock, probe_interval_s=0.0)
+    # Fresh fleet after a restart: the checkpointed version (5) is
+    # stamped, so the skew gauge and round↔version trail stay truthful.
+    assert _republish(fleet, params, 5) == 5
+    assert fleet.publisher.version == 5
+    assert live_versions(fleet) == [5, 5]
+    # A fleet that SURVIVED the trainer restart is already at or above
+    # the checkpoint: re-stamping would be stale, so the plain
+    # next-version path runs instead.
+    assert _republish(fleet, params, 3) == 6
+    # No saved version (pre-versioning checkpoint): plain path too.
+    assert _republish(fleet, params, None) == 7
+    # Bare engines without a publisher take the unversioned call.
+    engine = make_engine(model)
+    assert _republish(engine, params, 5) is None
+
+
+# ---- end-to-end across a real HTTP socket --------------------------------
+
+def test_learner_over_real_http_socket(model):
+    fleet = ServingFleet([make_engine(model)], probe_interval_s=0.0)
+    handler = FleetRpcHandler(fleet, clock=time.monotonic)
+    server, port = serve_fleet_http(handler)
+    try:
+        client = FleetPublishClient(
+            HttpTransport(f"http://127.0.0.1:{port}", timeout_s=10.0),
+            name="learner-http", policy=FAST)
+        learner = LearnerService(
+            FakeTrainer(model[0]), client,
+            config=LearnerConfig(holder="learner-http",
+                                 publish_timeout_s=30.0,
+                                 publish_poll_interval_s=0.001))
+        assert learner.start() == 1
+        assert learner.run_round() == 1
+        assert live_versions(fleet) == [1]
+        status = client.publish_status()
+        assert status["converged"] and status["version"] == 1
+        learner.stop()
+    finally:
+        server.shutdown()
