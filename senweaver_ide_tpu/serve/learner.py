@@ -38,6 +38,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, Optional
 
 from ..resilience.lease import LeaseLost
@@ -58,10 +59,14 @@ class FleetPublishClient:
 
     The retry story mirrors ``RemoteEngineClient._call``: transient wire
     errors retry under a shared :class:`RetryPolicy` (the learner-side
-    RetryBudget that bounds retry storms), mutating calls carry stable
-    request ids so a retried publish REPLAYS server-side, and remote
-    application errors re-raise locally as their original types
-    (``LeaseLost`` stays ``LeaseLost`` across the wire)."""
+    RetryBudget that bounds retry storms), publishes carry stable
+    ``(epoch, version)``-keyed request ids so a retried stage REPLAYS
+    server-side, and remote application errors re-raise locally as
+    their original types (``LeaseLost`` stays ``LeaseLost`` across the
+    wire). Lease calls are NOT idempotency-cached server-side —
+    re-executing them on retry is safe — so request ids never need to
+    survive a client restart; the per-instance nonce in the default
+    ``name`` keeps incarnations from sharing an id space regardless."""
 
     def __init__(self, transport, *, name: Optional[str] = None,
                  policy: RetryPolicy = RetryPolicy(max_retries=3,
@@ -70,8 +75,15 @@ class FleetPublishClient:
                  clock=time.monotonic, sleep=None, rng=None,
                  registry=None):
         self.transport = transport
-        self.name = name or getattr(transport, "target",
-                                    f"learner-{next(_client_counter)}")
+        if name is None:
+            # Unique per INSTANCE, not per target: request ids prefixed
+            # by a shared target would collide across restarts (seq
+            # restarts at 0), and a colliding id must never be able to
+            # replay a previous incarnation's cached response.
+            target = getattr(transport, "target",
+                             f"learner-{next(_client_counter)}")
+            name = f"{target}#{uuid.uuid4().hex[:8]}"
+        self.name = name
         self.policy = policy
         self.clock = clock
         self.sleep = sleep or time.sleep
@@ -294,9 +306,14 @@ class LearnerService:
         state = getattr(t, "state", None)
         if state is not None and hasattr(state, "params"):
             return state.params
+        if callable(t):
+            # Bare-callable trainers expose no "current params" — the
+            # crash/resume republish invokes the callable once so a
+            # restart with durable state has weights to publish.
+            return t()
         raise ValueError(
-            "trainer has no state.params; callable trainers return "
-            "params from run_round — call run_round() instead")
+            "trainer has neither state.params nor __call__; the "
+            "learner cannot obtain params to publish")
 
     def _train(self):
         t = self.trainer

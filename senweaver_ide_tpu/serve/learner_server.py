@@ -46,10 +46,15 @@ from ..resilience.lease import LeaseStore
 from .remote_server import RpcHandlerBase, serve_rpc_http
 from .replica import DEAD
 
-# Lease mutations and publish staging consult the idempotency cache;
-# status/signals are reads and must see fresh state.
-LEARNER_MUTATING_METHODS = frozenset({
-    "acquire_lease", "renew_lease", "release_lease", "publish"})
+# Only publish staging consults the idempotency cache: a staged
+# publish whose response was lost must REPLAY, never double-stage.
+# Lease mutations are deliberately NOT cached — re-executing them on a
+# retry is safe (acquire grants a fresh higher epoch, renew/release
+# are idempotent on live state), whereas caching them lets a restarted
+# client whose request ids collide with a previous incarnation replay
+# that incarnation's lease grant and run at a zombie epoch, defeating
+# the fencing. Status/signals are reads and must see fresh state.
+LEARNER_MUTATING_METHODS = frozenset({"publish"})
 
 
 class FleetRpcHandler(RpcHandlerBase):

@@ -213,6 +213,13 @@ class RemoteEngineClient:
 
     def update_params(self, params, *, version: Optional[int] = None,
                       epoch: Optional[int] = None) -> None:
+        if epoch is not None and version is None:
+            # The host-side high-water mark is (epoch, version); an
+            # epoch alone cannot be fenced and silently dropping it
+            # would hand a caller unfenced writes it thinks are fenced.
+            raise ValueError(
+                "update_params: epoch requires version — the remote "
+                "fencing mark is (epoch, version)")
         call_params: Dict[str, Any] = {"params": params}
         if version is not None:
             call_params["version"] = int(version)

@@ -308,6 +308,74 @@ def test_publish_with_lost_response_replays_not_double_stages(model):
     assert live_versions(fleet) == [1, 1]
 
 
+def test_restarted_default_name_client_never_replays_lease_grant(model):
+    """Regression: two client incarnations with DEFAULT names share the
+    transport target, and their request-id sequences both start at 0.
+    Lease rpcs must never be served from the idempotency cache — a
+    replayed grant would hand the restart its zombie's old epoch (same-
+    epoch split brain) — and the default name carries a per-instance
+    nonce so the incarnations never share an id space at all."""
+    clock = FakeClock()
+    fleet, handler, _ = make_stack(model, 1, clock=clock)
+    c1 = FleetPublishClient(LoopbackTransport(handler, target="fleet-gw"),
+                            policy=FAST, clock=clock, sleep=lambda s: None)
+    g1 = c1.acquire_lease("learner-0")
+    # "Restart": a fresh client instance, same target, seq back at 0.
+    c2 = FleetPublishClient(LoopbackTransport(handler, target="fleet-gw"),
+                            policy=FAST, clock=clock, sleep=lambda s: None)
+    g2 = c2.acquire_lease("learner-0")
+    assert g2["epoch"] == g1["epoch"] + 1, "fresh grant, not a replay"
+    assert c1.name != c2.name
+    # The restart holds the LIVE lease; the zombie epoch is fenced.
+    handler.lease_store.validate(g2["epoch"], now=clock())
+    with pytest.raises(LeaseLost):
+        handler.lease_store.validate(g1["epoch"], now=clock())
+
+
+def test_lease_acquire_with_lost_response_reexecutes_safely(model):
+    """Lease rpcs are deliberately NOT idempotency-cached: a retried
+    acquire whose response was lost RE-EXECUTES, burning an epoch, and
+    the client ends up holding the live (higher) grant."""
+    clock = FakeClock()
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop_response", method="acquire_lease",
+                     times=1)])
+    fleet, handler, client = make_stack(model, 1, clock=clock, plan=plan)
+    grant = client.acquire_lease("learner-0")
+    assert handler.executed["acquire_lease"] == 2   # executed twice
+    assert grant["epoch"] == 2                      # client holds the live one
+    handler.lease_store.validate(grant["epoch"], now=clock())
+
+
+def test_callable_trainer_with_state_path_resumes_and_republishes(
+        model, tmp_path):
+    """Regression: a bare-callable trainer configured with state_path
+    used to crash in start() on restart (no state.params to republish);
+    the republish now invokes the callable once for params."""
+    state_path = str(tmp_path / "learner_state.json")
+    clock = FakeClock()
+    fleet, handler, client = make_stack(model, 2, clock=clock)
+
+    def trainer():
+        return model[0]
+
+    a = make_learner(client, trainer, clock=clock, state_path=state_path)
+    assert a.start() == 1
+    assert a.run_round() == 1           # durable state: v1
+    # Restart with the same durable state file: the crash/resume
+    # republish must obtain params from the callable, not raise.
+    client_b = FleetPublishClient(
+        LoopbackTransport(handler, target="fleet-gw"), name="learner-0b",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+    b = make_learner(client_b, trainer, clock=clock,
+                     state_path=state_path)
+    assert b.start() == 2
+    assert b.version == 1
+    assert live_versions(fleet) == [1, 1]
+    assert fleet.publisher.epoch == 2
+    assert b.run_round() == 2           # training continues above it
+
+
 # ---- autoscaler hysteresis under overload --------------------------------
 
 def test_autoscaler_adds_once_under_overload_then_drains_once(model):

@@ -471,6 +471,21 @@ def test_add_replica_resurrects_dead_id_cleanly(model):
     assert isinstance(fleet.outcome(t2), Completed)
 
 
+def test_update_params_epoch_without_version_is_rejected(model):
+    """The host-side fencing mark is (epoch, version); an epoch alone
+    cannot be validated, so the client refuses it loudly instead of
+    silently handing the caller an unfenced write."""
+    h = EngineRpcHandler(make_engine(model))
+    rep = RemoteReplica("replica-0",
+                        LoopbackTransport(h, target="replica-0"),
+                        policy=FAST, sleep=lambda s: None)
+    with pytest.raises(ValueError, match="epoch requires version"):
+        rep.client.update_params(model[0], epoch=3)
+    assert h.executed.get("update_params", 0) == 0  # never hit the wire
+    rep.client.update_params(model[0], version=1, epoch=3)
+    assert h.executed["update_params"] == 1
+
+
 # ---- real HTTP end-to-end ------------------------------------------------
 
 def test_http_transport_end_to_end(model):
