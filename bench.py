@@ -89,6 +89,18 @@ def _save_cache(value: float, metric: str, extra: dict) -> None:
         pass    # caching is best-effort; never fail the live line for it
 
 
+def _cache_age_s(measured_at):
+    """Age of a cached measurement in seconds (None when the stamp is
+    missing/unparsable — an unknown age must read as unknown, not 0)."""
+    try:
+        import calendar
+        ts = calendar.timegm(time.strptime(str(measured_at),
+                                           "%Y-%m-%dT%H:%M:%SZ"))
+        return max(0.0, round(time.time() - ts, 1))
+    except Exception:
+        return None
+
+
 def _artifact_summaries() -> dict:
     """Headline numbers from the committed eval artifacts (best-effort —
     a missing/unparsable file contributes nothing)."""
@@ -926,6 +938,11 @@ def _error_line(msg: str, *, env_failure: bool = False) -> None:
                 "provenance": ("last-known-good cache (BENCH_CACHE.json) "
                                f"measured_at={cache.get('measured_at')} "
                                f"method={cache.get('method')}"),
+                # Machine-readable staleness: readers must not have to
+                # parse the provenance string to notice the number is
+                # replayed, or how old it is.
+                "cached": True,
+                "cache_age_s": _cache_age_s(cache.get("measured_at")),
                 "live_error": msg,
                 **{k: v for k, v in (cache.get("extra") or {}).items()
                    if k != "artifacts"},

@@ -10,6 +10,12 @@ Columns: count, total ms, mean, p50, p95, max — the quick answer to
 "where did the round go?" without loading the Chrome trace into
 Perfetto. Reads the same JSONL that ``obs.enable(span_jsonl=...)``
 streams live, so it works mid-run on a partially written file.
+
+When the file contains cross-process rpc spans (``rpc.client.*`` /
+``rpc.server.*`` — see ``obs/propagation.py``), a span-stitching
+section follows the table: how many server spans attached under their
+client parent, traces spanning both sides of the wire, idempotent
+replays, and the worst observed clock skew.
 """
 
 from __future__ import annotations
@@ -23,7 +29,7 @@ from typing import Dict, List
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from senweaver_ide_tpu.obs import load_span_jsonl  # noqa: E402
+from senweaver_ide_tpu.obs import load_span_jsonl, stitch_summary  # noqa: E402
 
 SORT_KEYS = ("total", "count", "mean", "max", "name")
 
@@ -38,8 +44,12 @@ def percentile(sorted_vals: List[float], q: float) -> float:
 
 
 def summarize(path: str) -> List[Dict[str, float]]:
+    return summarize_spans(load_span_jsonl(path))
+
+
+def summarize_spans(spans) -> List[Dict[str, float]]:
     by_name: Dict[str, List[float]] = {}
-    for span in load_span_jsonl(path):
+    for span in spans:
         by_name.setdefault(span.name, []).append(span.duration_ms)
     rows = []
     for name, durs in by_name.items():
@@ -86,7 +96,8 @@ def main(argv=None) -> int:
     if not os.path.exists(args.path):
         print(f"obs_report: no such file: {args.path}", file=sys.stderr)
         return 2
-    rows = summarize(args.path)
+    spans = load_span_jsonl(args.path)
+    rows = summarize_spans(spans)
     if not rows:
         print("obs_report: no spans found (empty or torn file)")
         return 0
@@ -99,6 +110,15 @@ def main(argv=None) -> int:
     total_spans = sum(r["count"] for r in rows)
     print(f"\n{total_spans} spans, {total_ms:.1f} ms total "
           f"(sorted by {args.sort})")
+    stitch = stitch_summary(spans)
+    if stitch["client_spans"] or stitch["server_spans"]:
+        print(
+            f"\nstitching: {stitch['stitched_server_spans']}/"
+            f"{stitch['server_spans']} server spans under a client "
+            f"parent, {stitch['cross_process_traces']}/"
+            f"{stitch['traces']} traces cross the rpc boundary, "
+            f"{stitch['replayed_server_spans']} idempotent replays, "
+            f"max clock skew {stitch['clock_skew_s_max'] * 1000:.3f} ms")
     return 0
 
 

@@ -32,14 +32,23 @@ from typing import Optional
 
 from .metrics import (Counter, DEFAULT_MS_BUCKETS, Gauge, Histogram,
                       MetricsRegistry)
-from .telemetry import StepTelemetry, estimate_mfu
-from .tracing import SpanRecord, Tracer, load_span_jsonl
+from .propagation import (TraceContext, clock_skew_s, extract,
+                          format_traceparent, inject, parse_traceparent,
+                          server_span)
+from .slo import (SECONDS_BUCKETS, SLOConfig, SLOTarget, SLOTracker)
+from .telemetry import StepTelemetry, advantage_stats, estimate_mfu
+from .timeline import RequestTimeline, TimelineRecorder
+from .tracing import SpanRecord, Tracer, load_span_jsonl, stitch_summary
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
-    "DEFAULT_MS_BUCKETS",
-    "SpanRecord", "Tracer", "load_span_jsonl",
-    "StepTelemetry", "estimate_mfu",
+    "DEFAULT_MS_BUCKETS", "SECONDS_BUCKETS",
+    "SpanRecord", "Tracer", "load_span_jsonl", "stitch_summary",
+    "TraceContext", "format_traceparent", "parse_traceparent",
+    "inject", "extract", "clock_skew_s", "server_span",
+    "RequestTimeline", "TimelineRecorder",
+    "SLOConfig", "SLOTarget", "SLOTracker",
+    "StepTelemetry", "advantage_stats", "estimate_mfu",
     "get_tracer", "get_registry", "enable", "disable", "is_enabled",
     "traced",
 ]

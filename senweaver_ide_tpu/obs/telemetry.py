@@ -44,6 +44,38 @@ def estimate_mfu(param_count: int, tokens: int, step_s: float,
     return (6.0 * param_count * tokens) / (step_s * peak_flops)
 
 
+def advantage_stats(rewards, group_ids) -> Dict[str, float]:
+    """GRPO advantage diagnostics from HOST-side reward/group arrays.
+
+    A group whose rewards are all identical contributes zero advantage
+    — no learning signal for any of its trajectories; when most groups
+    degenerate this way (reward saturation or collapse), the update is
+    noise. ``zero_advantage_group_fraction`` is that early-warning
+    signal (ROADMAP item 4); ``advantage_std`` is the spread of the
+    group-relative advantages actually fed to the loss.
+
+    Call BEFORE ``place_batch_for_mesh`` — sharded arrays would force a
+    device sync here, and this is pure bookkeeping."""
+    import numpy as np
+    r = np.asarray(rewards, dtype=np.float64).reshape(-1)
+    g = np.asarray(group_ids).reshape(-1)
+    if r.size == 0 or g.size != r.size:
+        return {"zero_advantage_group_fraction": 0.0,
+                "advantage_std": 0.0, "groups": 0}
+    adv = np.empty_like(r)
+    zero_groups = 0
+    uniq = np.unique(g)
+    for gid in uniq:
+        sel = g == gid
+        centered = r[sel] - r[sel].mean()
+        adv[sel] = centered
+        if np.all(centered == 0.0):
+            zero_groups += 1
+    return {"zero_advantage_group_fraction": zero_groups / len(uniq),
+            "advantage_std": float(adv.std()),
+            "groups": int(len(uniq))}
+
+
 class StepTelemetry:
     """Per-round throughput/MFU publisher over a metrics registry.
 
@@ -95,12 +127,22 @@ class StepTelemetry:
             "senweaver_mfu",
             "Model-FLOPs utilization of the last train step "
             "(vs. peak_flops).")
+        self._zero_adv_frac = r.gauge(
+            "senweaver_grpo_zero_advantage_group_fraction",
+            "Fraction of last round's GRPO groups with identical "
+            "rewards (zero advantage — no learning signal).")
+        self._adv_std = r.gauge(
+            "senweaver_grpo_advantage_std",
+            "Std of the group-relative advantages in the last round's "
+            "batch.")
 
     def record_round(self, *, collect_s: float, batch_build_s: float,
                      train_s: float, batch_tokens: int,
                      completion_tokens: int = 0, episodes: int = 0,
                      trajectories: int = 0,
-                     ppo_epochs: int = 1) -> Dict[str, Any]:
+                     ppo_epochs: int = 1,
+                     advantage_stats: Optional[Dict[str, float]] = None
+                     ) -> Dict[str, Any]:
         """Publish one round's telemetry; returns the derived values so
         the caller can also feed them to MetricsService captures."""
         train_tokens = batch_tokens * max(1, ppo_epochs)
@@ -120,6 +162,15 @@ class StepTelemetry:
             self._episodes.inc(episodes)
         if trajectories:
             self._trajectories.inc(trajectories)
+        if advantage_stats:
+            frac = advantage_stats.get("zero_advantage_group_fraction")
+            if frac is not None:
+                out["zero_advantage_group_fraction"] = float(frac)
+                self._zero_adv_frac.set(float(frac))
+            std = advantage_stats.get("advantage_std")
+            if std is not None:
+                out["advantage_std"] = float(std)
+                self._adv_std.set(float(std))
         if self.param_count and train_s > 0:
             flops_per_sec = 6.0 * self.param_count * train_tokens / train_s
             out["step_flops_per_sec"] = flops_per_sec

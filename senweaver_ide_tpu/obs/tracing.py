@@ -162,6 +162,20 @@ class Tracer:
             with self._lock:
                 if len(self._spans) == self._spans.maxlen:
                     self._dropped += 1
+                    # Surface the eviction on /metrics — a silently
+                    # truncated trace looks identical to a short one.
+                    # Resolved per drop (rare path) so tests swapping
+                    # the global registry see their own counter.
+                    try:
+                        from . import get_registry
+                        get_registry().counter(
+                            "senweaver_obs_spans_dropped_total",
+                            "Spans evicted from the tracer's bounded "
+                            "in-memory buffer (max_spans reached; the "
+                            "JSONL stream, when enabled, still has "
+                            "them).").inc()
+                    except Exception:
+                        pass
                 self._spans.append(rec)
                 if self._jsonl_path is not None:
                     if self._fh is None:
@@ -292,6 +306,51 @@ class Tracer:
             for s in self.spans():
                 f.write(json.dumps(s.to_dict()) + "\n")
         return path
+
+
+def stitch_summary(spans: List[SpanRecord]) -> Dict[str, Any]:
+    """Cross-process stitching health of a span set.
+
+    ``rpc.client.*`` spans are the caller side, ``rpc.server.*`` the
+    receiver side (possibly another process — see ``propagation.py``).
+    A server span is *stitched* when its ``parent_id`` is a client
+    span's id, i.e. the traceparent survived the wire; replay-annotated
+    spans are idempotency-cache hits (retried RPCs that did NOT
+    re-execute). ``clock_skew_s_max`` is the largest wall-clock skew a
+    receiver observed against its sender's anchor."""
+    client_ids = set()
+    server: List[SpanRecord] = []
+    traces: Dict[str, List[str]] = {}
+    replays = 0
+    skews: List[float] = []
+    for s in spans:
+        traces.setdefault(s.trace_id, []).append(s.name)
+        if s.name.startswith("rpc.client."):
+            client_ids.add(s.span_id)
+        elif s.name.startswith("rpc.server."):
+            server.append(s)
+            if s.attrs.get("replay"):
+                replays += 1
+            skew = s.attrs.get("clock_skew_s")
+            if isinstance(skew, (int, float)):
+                skews.append(float(skew))
+    stitched = sum(1 for s in server if s.parent_id in client_ids)
+    cross = sum(
+        1 for names in traces.values()
+        if any(n.startswith("rpc.client.") for n in names)
+        and any(n.startswith("rpc.server.") for n in names))
+    return {
+        "spans": len(spans),
+        "traces": len(traces),
+        "client_spans": len(client_ids),
+        "server_spans": len(server),
+        "stitched_server_spans": stitched,
+        "unstitched_server_spans": len(server) - stitched,
+        "cross_process_traces": cross,
+        "replayed_server_spans": replays,
+        "clock_skew_s_max": (round(max(abs(x) for x in skews), 6)
+                             if skews else 0.0),
+    }
 
 
 def load_span_jsonl(path: str) -> List[SpanRecord]:

@@ -113,6 +113,16 @@ class FleetRequest:
     version_at_finish: Optional[int] = None
     first_token_at: Optional[float] = None
     dispatched_at: Optional[float] = None
+    # -- timeline provenance (read by the fleet's TimelineRecorder) ----------
+    # Stamped by AdmissionQueue.pop_ready at the instant the queue
+    # hands the request over — the queue-ownership boundary, measured
+    # where it happens rather than inferred at dispatch.
+    queue_exit_at: Optional[float] = None
+    # Router.pick's reason for its choice ("affinity" | "load").
+    routed_by: Optional[str] = None
+    # Wall time replica.submit spent inside engine.submit — for a
+    # remote replica this is the RPC + remote prefill cost.
+    submit_ms: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,6 +279,7 @@ class AdmissionQueue:
                     best_i = len(keep) - 1
             if best_i >= 0:
                 picked = keep.pop(best_i)
+                picked.queue_exit_at = now
             if len(keep) != len(q):
                 q.clear()
                 q.extend(keep)

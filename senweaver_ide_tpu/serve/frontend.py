@@ -42,6 +42,8 @@ import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
 
+from ..obs.slo import SLOConfig, SLOTracker
+from ..obs.timeline import TimelineRecorder
 from ..resilience.faults import REASON_ERROR, REASON_TIMEOUT
 from .admission import (AdmissionConfig, AdmissionQueue, FleetRequest,
                         REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
@@ -83,7 +85,8 @@ class ServingFleet:
                  max_consecutive_faults: int = 3,
                  metrics_service=None,
                  shared_prefix_broadcast: bool = True,
-                 probe_interval_s: float = 1.0):
+                 probe_interval_s: float = 1.0,
+                 slo: Optional[SLOConfig] = None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if registry is None:
@@ -155,6 +158,23 @@ class ServingFleet:
         # Optional admission-driven autoscaler (attach_autoscaler);
         # evaluated once per pump, inside the fleet lock.
         self.autoscaler = None                       # guarded-by: _lock
+        # Request-level SLO layer: milestone timelines feeding the
+        # per-priority seconds histograms, violation counters, and the
+        # K-worst exemplar ring (always on — dict writes per request).
+        self.slo = SLOTracker(slo, registry=registry)
+        self.timelines = TimelineRecorder(clock=clock, slo=self.slo,
+                                          registry=registry)
+        # Open publish-pause window (begin seen, roll not converged) —
+        # closed windows are pushed onto the timeline recorder so a
+        # finished request knows how much of its e2e was publish pause.
+        self._publish_started_at: Optional[float] = None  # guarded-by: _lock
+        # Exact window edges: the publisher fires these on the very
+        # begin/land transitions (the pump's polling calls below are a
+        # no-op backstop once these have run).
+        self.publisher.subscribe_begin(
+            lambda _v: self._track_publish_window(self.clock()))
+        self.publisher.subscribe_end(
+            lambda _v: self._track_publish_window(self.clock()))
 
     # -- single-engine API superset ------------------------------------------
     @property
@@ -212,9 +232,12 @@ class ServingFleet:
                 deadline=None if deadline_s is None else now + deadline_s,
                 submitted_at=now)
             self._requests[ticket] = req
+            self.timelines.begin(ticket, priority, now)
             rejected = self.admission.offer(req, now)
             if rejected is not None:
                 self._outcomes[ticket] = rejected
+                self.timelines.finish_rejected(ticket, now,
+                                               reason=rejected.reason)
             return ticket
 
     def _submit_continuation(self, ticket: int, prompt: List[int], *,
@@ -261,6 +284,10 @@ class ServingFleet:
                 self._requests[ticket] = req
                 replica.adopt(rid, req)
                 req.dispatched_at = now
+                self.timelines.begin(ticket, priority, now)
+                self.timelines.mark(ticket, "dispatched", now,
+                                    replica=replica.replica_id,
+                                    continuation=True)
                 return ticket
         # Survivor replay: full re-prefill of the recorded transcript,
         # slot re-held on whichever live replica the router picks.
@@ -279,6 +306,13 @@ class ServingFleet:
         survivor.adopt(rid, req)
         req.dispatched_at = now
         self._continuation_replays.inc()
+        self.timelines.begin(ticket, priority, now)
+        self.timelines.mark(ticket, "dispatched", now,
+                            replica=survivor.replica_id,
+                            continuation=True)
+        self.timelines.event(ticket, "continuation_replay", now,
+                             source=prev.replica_id,
+                             replica=survivor.replica_id)
         return ticket
 
     def register_prefix(self, tokens: List[int]) -> int:
@@ -352,6 +386,7 @@ class ServingFleet:
         with self._lock:
             now = self.clock()
             self.publisher.advance()
+            self._track_publish_window(now)
             self._reap_quarantined(now)
             self._probe_replicas(now)
             for rej in self.admission.shed_expired(now):
@@ -429,8 +464,10 @@ class ServingFleet:
         or the dispatcher thread) rolls it forward while the learner
         polls convergence over rpc."""
         with self._lock:
-            return self.publisher.begin(params, epoch=epoch,
-                                        version=version)
+            v = self.publisher.begin(params, epoch=epoch,
+                                     version=version)
+            self._track_publish_window(self.clock())
+            return v
 
     @property
     def threaded(self) -> bool:
@@ -520,6 +557,7 @@ class ServingFleet:
                 with self._lock:
                     now = self.clock()
                     self.publisher.advance()
+                    self._track_publish_window(now)
                     self._reap_quarantined(now)
                     self._probe_replicas(now)
                     for rej in self.admission.shed_expired(now):
@@ -571,6 +609,8 @@ class ServingFleet:
                 "weight_version_skew": self.publisher.skew(),
                 "publish_in_progress": self.publisher.in_progress,
                 **self.prefix_store.stats(),
+                **self.timelines.stats(),
+                "slo": self.slo.summary(),
             }
             return out
 
@@ -659,6 +699,11 @@ class ServingFleet:
                 "learner_publishes": ctotal(
                     "senweaver_learner_publishes_total"),
                 "ttft_by_priority": ttft_buckets(),
+                "slo_requests": ctotal(
+                    "senweaver_serve_slo_requests_total"),
+                "slo_violations": ctotal(
+                    "senweaver_serve_slo_violations_total"),
+                "slo": self.slo.summary(),
             }
 
     def record_snapshot(self) -> None:
@@ -693,16 +738,52 @@ class ServingFleet:
             if replica is None:
                 self.admission.requeue(req)     # nothing accepting now
                 return
+            self.timelines.mark(
+                req.ticket, "queue_exit",
+                req.queue_exit_at if req.queue_exit_at is not None
+                else now,
+                **({"routed_by": req.routed_by} if req.routed_by
+                   else {}))
+            prefill_mode = None
             if req.prefix_tokens:
                 # Warm the picked replica BEFORE dispatch: donor prefill
                 # + fleet broadcast on first touch, backfill install for
                 # late joiners — never raises; on failure the replica's
                 # own lazy register_prefix path inside submit() covers.
-                self.prefix_store.ensure(replica, req.prefix_tokens)
+                prefill_mode = self.prefix_store.ensure(
+                    replica, req.prefix_tokens) or "lazy"
+            from ..obs import get_tracer
+            tracer = get_tracer()
             try:
-                replica.submit(req)
+                # The dispatch span is the trace ROOT the remote side
+                # stitches under: the client-attempt spans open inside
+                # it (same thread), transports inject its context, and
+                # the server spans attach to it across the wire.
+                with tracer.span("fleet.dispatch", ticket=req.ticket,
+                                 replica=replica.replica_id,
+                                 priority=req.priority,
+                                 attempt=req.attempts):
+                    ctx = tracer.capture()
+                    if ctx is not None:
+                        self.timelines.set_trace(req.ticket, ctx[0])
+                    self.timelines.mark(
+                        req.ticket, "prefill_start", now,
+                        **({"mode": prefill_mode} if prefill_mode
+                           else {}))
+                    replica.submit(req)
                 req.dispatched_at = now
+                self.timelines.mark(req.ticket, "prefill_done",
+                                    self.clock())
+                dispatch_attrs: Dict[str, Any] = {
+                    "replica": replica.replica_id}
+                if req.submit_ms is not None:
+                    dispatch_attrs["submit_ms"] = round(req.submit_ms, 3)
+                self.timelines.mark(req.ticket, "dispatched", now,
+                                    **dispatch_attrs)
             except Exception:
+                self.timelines.event(req.ticket, "retry", now,
+                                     reason="submit_failed",
+                                     replica=replica.replica_id)
                 # Submit blew up (chaos engine, OOM, wedged pool):
                 # fault the replica; the request goes back through the
                 # router's retry/shed triage like an orphan.
@@ -739,6 +820,11 @@ class ServingFleet:
                 self._ttft_ms.observe(
                     (now - req.submitted_at) * 1000.0,
                     priority=req.priority)
+                # First-wins: after a mid-decode failover the engine
+                # re-emits, but the timeline keeps the FIRST time any
+                # token reached the caller.
+                self.timelines.mark(req.ticket, "first_token", now,
+                                    replica=replica.replica_id)
         for req in done:
             self._complete(replica, req, now)
 
@@ -755,6 +841,9 @@ class ServingFleet:
             # route the request through the SAME retry/shed triage as a
             # death orphan instead of losing an admitted ticket.
             self._record_fault(replica, now)
+            self.timelines.event(req.ticket, "retry", now,
+                                 reason="result_lost",
+                                 replica=replica.replica_id)
             req.attempts += 1
             req.replica_id = None
             req.engine_rid = None
@@ -796,6 +885,11 @@ class ServingFleet:
             e2e_ms=e2e_ms)
         self._completed_total.inc(priority=req.priority)
         self._e2e_ms.observe(e2e_ms, priority=req.priority)
+        # Exactly-once by construction: finishing pops the live
+        # timeline, so a chaos-retried path cannot produce a second one.
+        self.timelines.finish_completed(
+            req.ticket, now, tokens=len(tokens),
+            replica_id=replica.replica_id, attempts=req.attempts)
 
     def _record_rejection(self, rej: Rejected) -> None:
         # Admission already counted its own sheds; router/fleet-origin
@@ -806,6 +900,8 @@ class ServingFleet:
             self._shed_total.inc(priority=rej.priority,
                                  reason=rej.reason)
         self._outcomes[rej.ticket] = rej
+        self.timelines.finish_rejected(rej.ticket, self.clock(),
+                                       reason=rej.reason)
 
     def _record_fault(self, replica: EngineReplica, now: float) -> None:
         if replica.record_fault(REASON_ERROR):
@@ -818,11 +914,26 @@ class ServingFleet:
         for rej in shed:
             self._record_rejection(rej)
         for req in requeue:
+            self.timelines.event(req.ticket, "failover", now,
+                                 replica=replica.replica_id,
+                                 attempt=req.attempts)
             self.admission.requeue(req)
         if not self.router.live_replicas():
             for rej in self.admission.shed_all(
                     REJECT_NO_REPLICAS, "no live replicas"):
                 self._record_rejection(rej)
+
+    def _track_publish_window(self, now: float) -> None:
+        """Turn publisher in_progress transitions into publish-pause
+        windows on the timeline recorder, so a request completed during
+        (or across) a rolling publish can account for the stall."""
+        # guarded-by: caller
+        in_progress = self.publisher.in_progress
+        if in_progress and self._publish_started_at is None:
+            self._publish_started_at = now
+        elif not in_progress and self._publish_started_at is not None:
+            self.timelines.publish_window(self._publish_started_at, now)
+            self._publish_started_at = None
 
     def _reap_quarantined(self, now: float) -> None:
         """Turn publish-quarantined replicas (install unreachable mid-

@@ -61,6 +61,9 @@ class FleetRpcHandler(RpcHandlerBase):
     """Lease + fenced-publish dispatch table over one ServingFleet."""
 
     mutating_methods = LEARNER_MUTATING_METHODS
+    # Stitched-trace role: spans from this handler belong to the
+    # fleet/learner gateway process (see obs/propagation.py).
+    span_service = "fleet"
 
     def __init__(self, fleet, *, lease_store: Optional[LeaseStore] = None,
                  lease_ttl_s: float = 30.0, clock=None,

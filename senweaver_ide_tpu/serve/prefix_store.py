@@ -140,29 +140,35 @@ class SharedPrefixStore:
 
     # -- broadcast protocol --------------------------------------------------
     def ensure(self, replica: EngineReplica,
-               tokens: List[int]) -> None:
+               tokens: List[int]) -> Optional[str]:
         """Dispatch-path hook: make ``replica`` warm for ``tokens``
         before the request lands on it. Never raises — every failure
         path degrades to the replica's own lazy prefill in
-        ``EngineReplica.submit``."""
+        ``EngineReplica.submit``. Returns how the replica got (or will
+        get) warm — ``"donor"`` (paid the one prefill), ``"import"``
+        (broadcast/backfill install), ``"warm"`` (already held it),
+        ``"lazy"`` (degraded to the per-replica path) or None (not a
+        fleet prefix) — the request timeline records it as the
+        prefill-mode attribute."""
         if not self.enabled or not tokens:
-            return
+            return None
         key = (tuple(tokens), self.publisher.version)
         pid = self._by_key.get(key)
         if pid is None:
-            return                       # not a fleet-registered prefix
+            return None                  # not a fleet-registered prefix
         entry = self._entries[pid]
         if entry.failed:
-            return                       # degraded: lazy per-replica
+            return "lazy"                # degraded: lazy per-replica
         if replica.holds_prefix(tuple(tokens)):
             entry.installed.add(replica.replica_id)
-            return
+            return "warm"
         if entry.kv is None:
             self._donate(entry, replica)
-        else:
-            # Late joiner / resurrected replica / was DRAINING during
-            # the broadcast: backfill from the stored buffer.
-            self._install(entry, replica)
+            return ("donor" if entry.donor_id == replica.replica_id
+                    else "lazy")
+        # Late joiner / resurrected replica / was DRAINING during
+        # the broadcast: backfill from the stored buffer.
+        return "import" if self._install(entry, replica) else "lazy"
 
     def _donate(self, entry: _SharedPrefix,
                 replica: EngineReplica) -> None:

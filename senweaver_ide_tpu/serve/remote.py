@@ -130,12 +130,24 @@ class RemoteEngineClient:
                 f"{self.name}: circuit open, refusing {method}")
         request_id = idempotency_key or self._request_id()
         budget = RetryBudget(self.policy, now=now, rng=self._rng)
+        from ..obs import get_tracer
+        tracer = get_tracer()
+        attempt = 0
         while True:
             self._rpcs_total.inc(replica=self.name, method=method)
             try:
-                result = self.transport.call(
-                    method, params, request_id=request_id,
-                    timeout_s=timeout_s)
+                # One client span per ATTEMPT (retries are annotated,
+                # not hidden); the transport injects this span's context
+                # into the frame, so the server span stitches under it.
+                with tracer.span(f"rpc.client.{method}",
+                                 replica=self.name, method=method,
+                                 request_id=request_id,
+                                 attempt=attempt) as sp:
+                    if sp is not None and attempt > 0:
+                        sp.set_attr("retry", True)
+                    result = self.transport.call(
+                        method, params, request_id=request_id,
+                        timeout_s=timeout_s)
             except RpcApplicationError as e:
                 # The SERVER answered — the peer is healthy; only the
                 # request is bad. Never retried, never a breaker strike.
@@ -159,6 +171,7 @@ class RemoteEngineClient:
                                            kind=type(e).__name__)
                     raise
                 self._retries_total.inc(replica=self.name)
+                attempt += 1
                 if delay > 0:
                     self.sleep(delay)
                 continue
@@ -237,9 +250,16 @@ class RemoteEngineClient:
         if (not hedged and self.breaker is not None
                 and not self.breaker.allow(now)):
             raise RpcCircuitOpen(f"{self.name}: circuit open")
+        from ..obs import get_tracer
         try:
-            out = self.transport.call("health", request_id=None,
-                                      timeout_s=timeout_s)
+            # Probes skip _call, so they get their client span here —
+            # otherwise the server-side health span has no parent and
+            # shows up as an orphan root in stitched traces.
+            with get_tracer().span("rpc.client.health",
+                                   replica=self.name, method="health",
+                                   hedged=hedged):
+                out = self.transport.call("health", request_id=None,
+                                          timeout_s=timeout_s)
         except RpcError:
             if self.breaker is not None:
                 self.breaker.record_failure(self.clock())

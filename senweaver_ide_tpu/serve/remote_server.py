@@ -46,6 +46,9 @@ class RpcHandlerBase:
     application ERRORS — instead of executing twice."""
 
     mutating_methods: frozenset = frozenset()
+    # Span attribute naming the process role in a stitched trace
+    # ("engine" host, "fleet" learner gateway, ...).
+    span_service: str = "rpc"
 
     def __init__(self, *, idempotency_cache_size: int = 4096):
         self._cache_size = max(1, int(idempotency_cache_size))
@@ -58,10 +61,41 @@ class RpcHandlerBase:
 
     # -- dispatch ------------------------------------------------------------
     def handle(self, method: str, params: Dict[str, Any], *,
-               request_id: Optional[str] = None) -> Any:
+               request_id: Optional[str] = None,
+               trace: Optional[Dict[str, Any]] = None) -> Any:
+        """Dispatch one rpc. ``trace`` is the frame's propagated span
+        context (see ``obs/propagation.py``): when tracing is enabled
+        the call runs under a ``rpc.server.<method>`` span stitched
+        into the caller's trace. An idempotency-cache hit ANNOTATES
+        that span (``replay=True``) — the replayed work itself recorded
+        its span on first execution, so retried RPCs never duplicate
+        spans, they just show up as annotated replays."""
         fn = getattr(self, f"_m_{method}", None)
         if fn is None:
             raise RpcProtocolError(f"unknown rpc method {method!r}")
+        tracer = _maybe_tracer()
+        if tracer is None or not tracer.enabled:
+            outcome, _ = self._dispatch(fn, method, params, request_id)
+            return self._replay(outcome)
+        from ..obs.propagation import server_span
+        with server_span(tracer, trace, f"rpc.server.{method}",
+                         service=self.span_service,
+                         method=method) as span:
+            outcome, replayed = self._dispatch(fn, method, params,
+                                               request_id)
+            if span is not None:
+                if request_id is not None:
+                    span.set_attr("request_id", request_id)
+                if replayed:
+                    span.set_attr("replay", True)
+                if outcome[0] == "err":
+                    span.set_attr("app_error", outcome[1][0])
+            return self._replay(outcome)
+
+    def _dispatch(self, fn, method: str, params: Dict[str, Any],
+                  request_id: Optional[str]
+                  ) -> Tuple[Tuple[str, Any], bool]:
+        """(outcome, replayed): the cache-or-execute core of handle."""
         cacheable = (request_id is not None
                      and method in self.mutating_methods)
         if cacheable:
@@ -70,7 +104,7 @@ class RpcHandlerBase:
                 if hit is not None:
                     self._cache.move_to_end(request_id)
                     self.replays += 1
-                    return self._replay(hit)
+                    return hit, True
         try:
             result = fn(**params)
             outcome = ("ok", result)
@@ -85,7 +119,7 @@ class RpcHandlerBase:
                     self._cache.popitem(last=False)
         with self._lock:
             self.executed[method] = self.executed.get(method, 0) + 1
-        return self._replay(outcome)
+        return outcome, False
 
     @staticmethod
     def _replay(outcome: Tuple[str, Any]) -> Any:
@@ -95,11 +129,22 @@ class RpcHandlerBase:
         raise RpcApplicationError(payload[0], payload[1])
 
 
+def _maybe_tracer():
+    """The global tracer, or None if obs is unimportable — the server
+    must handle rpcs even when observability is broken."""
+    try:
+        from ..obs import get_tracer
+        return get_tracer()
+    except Exception:
+        return None
+
+
 class EngineRpcHandler(RpcHandlerBase):
     """The whole remote side of the cross-host fleet: a dispatch table
     over one local engine (plus the idempotency cache from the base)."""
 
     mutating_methods = MUTATING_METHODS
+    span_service = "engine"
 
     def __init__(self, engine, *, idempotency_cache_size: int = 4096,
                  registry=None):
@@ -220,12 +265,14 @@ def serve_rpc_http(handler: RpcHandlerBase, *, host: str = "127.0.0.1",
                 method = frame["method"]
                 params = decode(frame.get("params") or {})
                 request_id = frame.get("request_id")
+                trace = frame.get("trace")
             except (ValueError, KeyError, TypeError):
                 self.send_error(400, "malformed rpc frame")
                 return
             try:
                 result = handler.handle(method, params,
-                                        request_id=request_id)
+                                        request_id=request_id,
+                                        trace=trace)
                 body = {"ok": True, "result": encode(result)}
             except RpcApplicationError as e:
                 body = {"ok": False, "error_type": e.error_type,

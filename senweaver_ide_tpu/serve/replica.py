@@ -230,7 +230,12 @@ class EngineReplica:
                 # shadow a later genuine try).
                 kwargs["idempotency_key"] = \
                     f"ticket-{req.ticket}-a{req.attempts}"
+            t0 = time.perf_counter()
             rid = self.engine.submit(req.prompt, **kwargs)
+            # Engine-side submit cost (for a remote replica: RPC +
+            # remote prefill) — the timeline's dispatched milestone
+            # carries it as an attribute.
+            req.submit_ms = (time.perf_counter() - t0) * 1000.0
             self.inflight[rid] = req
             req.replica_id = self.replica_id
             req.engine_rid = rid

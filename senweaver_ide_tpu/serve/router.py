@@ -81,7 +81,9 @@ class Router:
             warm = [r for r in accepting if r.holds_prefix(key)]
             if warm:
                 self._affinity_hits.inc()
+                req.routed_by = "affinity"
                 return min(warm, key=load)
+        req.routed_by = "load"
         return min(accepting, key=load)
 
     # -- failure handling ----------------------------------------------------

@@ -192,6 +192,18 @@ def decode(obj: Any) -> Any:
 
 
 # -- transports --------------------------------------------------------------
+def _inject_trace() -> Optional[Dict[str, Any]]:
+    """The frame's ``trace`` field (W3C-traceparent style + clock
+    anchors) for the ACTIVE span context — None when tracing is off or
+    no span is open, so the common untraced path costs one branch and
+    sends nothing. Never raises into a transport."""
+    try:
+        from ..obs.propagation import inject
+        return inject()
+    except Exception:
+        return None
+
+
 class HttpTransport:
     """urllib POST of one JSON frame per call to ``{base_url}/rpc``.
 
@@ -219,6 +231,9 @@ class HttpTransport:
         frame = {"method": method, "params": encode(params or {})}
         if request_id is not None:
             frame["request_id"] = request_id
+        trace = _inject_trace()
+        if trace is not None:
+            frame["trace"] = trace
         body = json.dumps(frame).encode("utf-8")
         req = urllib.request.Request(
             self.base_url + RPC_PATH, data=body, method="POST",
@@ -312,9 +327,15 @@ class LoopbackTransport:
                     f"{method}: connection reset by chaos")
             if fault.kind == "http_500":
                 raise RpcServerError(f"{method}: injected HTTP 500")
+        trace = _inject_trace()
         try:
-            result = self.handler.handle(method, dict(params or {}),
-                                         request_id=request_id)
+            if trace is not None:
+                result = self.handler.handle(method, dict(params or {}),
+                                             request_id=request_id,
+                                             trace=trace)
+            else:
+                result = self.handler.handle(method, dict(params or {}),
+                                             request_id=request_id)
         except RpcError:
             raise
         except Exception as e:     # handler bug = server crash mid-call

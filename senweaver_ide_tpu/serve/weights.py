@@ -85,11 +85,26 @@ class WeightPublisher:
         # prefix store invalidates here: its KV belongs to the old
         # policy from the instant a roll starts.
         self._on_begin: List = []               # guarded-by: _lock
+        # end observers, called the pump step the roll fully lands —
+        # the fleet closes its publish-pause timeline window here, so
+        # the window edge is exact rather than poll-quantized.
+        self._on_end: List = []                 # guarded-by: _lock
 
     def subscribe_begin(self, fn) -> None:
         """Register ``fn(version)`` to run at every :meth:`begin`."""
         with self._lock:
             self._on_begin.append(fn)
+
+    def subscribe_end(self, fn) -> None:
+        """Register ``fn(version)`` to run when a publish fully lands
+        (every :meth:`advance` that transitions to not-in-progress)."""
+        with self._lock:
+            self._on_end.append(fn)
+
+    def _fire_end(self) -> None:
+        # guarded-by: _lock
+        for fn in self._on_end:
+            fn(self.version)
 
     @property
     def in_progress(self) -> bool:
@@ -166,6 +181,7 @@ class WeightPublisher:
                 if self._current is None:       # queue exhausted
                     self._pending_params = None
                     self._update_skew()
+                    self._fire_end()
                     return True
                 if self._current.state == LIVE:
                     self._current.drain()
@@ -193,6 +209,7 @@ class WeightPublisher:
                     if not self._roll_queue:
                         self._pending_params = None
                         self._update_skew()
+                        self._fire_end()
                         return True
                     self._update_skew()
                     return False
@@ -202,6 +219,7 @@ class WeightPublisher:
                 if not self._roll_queue:
                     self._pending_params = None
                     self._update_skew()
+                    self._fire_end()
                     return True
             self._update_skew()
             return False

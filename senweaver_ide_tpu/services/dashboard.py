@@ -61,7 +61,7 @@ class DashboardService:
                  control=None, metrics_path: Optional[str] = None,
                  onboarding=None, title: str = "senweaver-tpu trainer",
                  control_socket: Optional[str] = None,
-                 tracer=None, registry=None):
+                 tracer=None, registry=None, slo=None):
         self.collector = collector
         self.apo = apo
         self.engine = engine
@@ -69,6 +69,10 @@ class DashboardService:
         self.metrics_path = metrics_path
         self.onboarding = onboarding
         self.title = title
+        # Optional SLOTracker (obs/slo.py): the registry carries the
+        # histograms/counters either way, but exemplar timelines live
+        # only on the tracker object — pass the fleet's to see them.
+        self.slo = slo
         # Observability plane: defaults to the process-global tracer +
         # registry (obs/), so an instrumented trainer's spans and
         # telemetry show up with zero wiring; tests pass their own.
@@ -145,6 +149,7 @@ class DashboardService:
         out["obs"] = self._obs_summary()
         out["resilience"] = self._resilience_summary()
         out["serving"] = self._serving_summary()
+        out["slo"] = self._slo_summary()
         return out
 
     def _resilience_summary(self) -> Dict[str, Any]:
@@ -275,6 +280,69 @@ class DashboardService:
                 "autoscale_shed_rate": total(
                     "senweaver_serve_autoscale_shed_rate"),
             }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _slo_summary(self) -> Dict[str, Any]:
+        """SLO tile: request/violation totals, burn ratio, and the
+        running means of the per-priority seconds histograms — all read
+        off the registry (zero wiring). Exemplar timelines are only
+        reachable through a live SLOTracker, so the worst-request rows
+        appear when the fleet's tracker was passed at construction."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        def hist_mean_s(name: str) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            s = c = 0.0
+            for cell in m.samples().values():
+                s += cell[-2]
+                c += cell[-1]
+            return (s / c) if c else None
+
+        try:
+            burn = self.registry.get("senweaver_serve_slo_burn_ratio")
+            # Per-priority gauge; the tile shows the WORST class's burn.
+            burn_cells = ([float(v) for v in burn.samples().values()]
+                          if burn is not None else [])
+            out: Dict[str, Any] = {
+                "requests": total("senweaver_serve_slo_requests_total"),
+                "violations": total(
+                    "senweaver_serve_slo_violations_total"),
+                "burn_ratio": max(burn_cells) if burn_cells else None,
+                "ttft_s_mean":
+                    hist_mean_s("senweaver_serve_ttft_seconds"),
+                "tpot_s_mean":
+                    hist_mean_s("senweaver_serve_tpot_seconds"),
+                "queue_wait_s_mean":
+                    hist_mean_s("senweaver_serve_queue_wait_seconds"),
+                "e2e_s_mean":
+                    hist_mean_s("senweaver_serve_e2e_seconds"),
+                "timelines_finished":
+                    total("senweaver_serve_timelines_total"),
+                "timelines_evicted":
+                    total("senweaver_serve_timelines_evicted_total"),
+                "publish_windows":
+                    total("senweaver_serve_publish_windows_total"),
+                "spans_dropped":
+                    total("senweaver_obs_spans_dropped_total"),
+            }
+            if self.slo is not None:
+                out["exemplars"] = [
+                    {"ticket": e.get("ticket"),
+                     "priority": e.get("priority"),
+                     "violations": ",".join(e.get("violations") or [])
+                                   or None,
+                     "e2e_s": (e.get("derived") or {}).get("e2e_s"),
+                     "ttft_s": (e.get("derived") or {}).get("ttft_s"),
+                     "trace_id": e.get("trace_id")}
+                    for e in self.slo.exemplars()[:5]]
+            return out
         except Exception as e:
             return {"error": str(e)}
 
@@ -473,6 +541,9 @@ input[type=text], input[type=password], textarea {
 <section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
 </section>
 <section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
+<section><h2>SLO</h2>
+<div id="slo" class="tiles"></div>
+<div id="slo-exemplars"></div></section>
 <section><h2>Learner &amp; autoscaler</h2>
 <div id="learner" class="tiles"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
@@ -706,6 +777,24 @@ async function refresh() {
     ["probes dead", sv.probes_dead],
     ["continuation replays", sv.continuation_replays],
     ["publish quarantined", sv.publish_quarantined]]);
+  const slo = s.slo || {};
+  tiles(document.getElementById("slo"), [
+    ["slo requests", slo.requests],
+    ["slo violations", slo.violations],
+    ["burn ratio", slo.burn_ratio],
+    ["ttft s (mean)", slo.ttft_s_mean],
+    ["tpot s (mean)", slo.tpot_s_mean],
+    ["queue wait s (mean)", slo.queue_wait_s_mean],
+    ["e2e s (mean)", slo.e2e_s_mean],
+    ["timelines", slo.timelines_finished],
+    ["timelines evicted", slo.timelines_evicted],
+    ["publish windows", slo.publish_windows],
+    ["spans dropped", slo.spans_dropped]]);
+  document.getElementById("slo-exemplars").innerHTML = table(
+    (slo.exemplars || []).map(x => [x.ticket, x.priority, x.violations,
+                                    x.ttft_s, x.e2e_s, x.trace_id]),
+    ["worst request", "priority", "violated", "ttft_s", "e2e_s",
+     "trace"]);
   tiles(document.getElementById("learner"), [
     ["lease epoch", sv.lease_epoch],
     ["learner rounds", sv.learner_rounds],

@@ -399,6 +399,10 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         # Recorded behavior logps align on the UNPADDED batch (padding
         # appends rows/columns, leaving existing positions fixed).
         old_logp = make_batch_logps(trajectories, tokens, mask)
+        # Advantage diagnostics from the HOST arrays — after placement
+        # the same read would be a device sync inside the build span.
+        from ..obs.telemetry import advantage_stats as _advantage_stats
+        adv_stats = _advantage_stats(rewards, group_ids)
         tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
             mesh, tokens, mask, rewards, group_ids, old_logp,
             pad_id=pad_id, accum_steps=accum_steps)
@@ -480,7 +484,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         completion_tokens=sum(len(t.completion_ids)
                               for t in trajectories),
         episodes=len(episodes), trajectories=len(trajectories),
-        ppo_epochs=ppo_epochs)
+        ppo_epochs=ppo_epochs, advantage_stats=adv_stats)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
         # Engine serving counters (reuse efficiency) belong in the round
