@@ -143,6 +143,8 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
 
     curve = []
     per_task = []
+    health_series = []
+    health_trigger_counts: dict = {}
     t0 = time.monotonic()
     for r in range(rounds):
         out = grpo_round(state, config, None, make_session, tasks,
@@ -168,6 +170,20 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
         means = [sum(v) / max(len(v), 1) for v in by_task]
         curve.append(round(sum(means) / len(means), 4))
         per_task.append([round(m, 4) for m in means])
+        # Per-round training-health snapshot (training/diagnostics.py):
+        # the learning proof doubles as a health trace — a passing curve
+        # with a collapsing rank spectrum is worth knowing about.
+        if out.health:
+            health_series.append({
+                "round": r,
+                "health": {k: round(v, 6)
+                           for k, v in out.health.items()},
+                "triggers": list(out.health_triggers),
+                "events": list(out.health_events),
+            })
+            for t in out.health_triggers:
+                health_trigger_counts[t] = \
+                    health_trigger_counts.get(t, 0) + 1
 
     if capture is not None:
         # Downstream evals (e.g. eval_moe_int8's trained-router int8
@@ -194,6 +210,10 @@ def run_learning_eval(*, rounds: int = 12, lr: float = 0.02,
                    "short_prompt": short_prompt,
                    "anchor_kl": anchor_kl, "anchor_every": anchor_every},
         "wall_s": round(time.monotonic() - t0, 1),
+        "training_health": {
+            "rounds": health_series,
+            "trigger_counts": health_trigger_counts,
+        },
     }
     if contextual:
         report["per_task_curve"] = per_task

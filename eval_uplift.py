@@ -79,6 +79,14 @@ def main() -> None:
         report["policy"] = {"model_dir": args.model_dir,
                             "config": args.config,
                             "max_new_tokens": args.max_new_tokens}
+    # Per-round training-health trace (obs/training_health.py): APO is
+    # prompt-space only, so the ring is empty unless an in-process
+    # weight-training phase ran this process — but when one did, the
+    # uplift artifact carries its health alongside the scores.
+    from senweaver_ide_tpu.obs import get_health_monitor
+    monitor = get_health_monitor()
+    report["training_health"] = {"rounds": monitor.history(),
+                                 "summary": monitor.summary()}
     print(json.dumps(report))
 
 

@@ -5,11 +5,17 @@ latency table.
 Usage::
 
     python scripts/obs_report.py spans.jsonl [--top 20] [--sort total]
+    python scripts/obs_report.py spans.jsonl --health health.jsonl
 
 Columns: count, total ms, mean, p50, p95, max — the quick answer to
 "where did the round go?" without loading the Chrome trace into
 Perfetto. Reads the same JSONL that ``obs.enable(span_jsonl=...)``
 streams live, so it works mid-run on a partially written file.
+
+``--health`` appends a training-health block from a per-round ring
+JSONL (``TrainingHealthMonitor.export_jsonl``): signal last/min/max
+and detector trigger counts — the latency table's companion question,
+"and was the learning signal any good while it ran?".
 
 When the file contains cross-process rpc spans (``rpc.client.*`` /
 ``rpc.server.*`` — see ``obs/propagation.py``), a span-stitching
@@ -82,6 +88,18 @@ def render(rows: List[Dict[str, float]]) -> str:
     return "\n".join(lines)
 
 
+def render_health(summary: Dict) -> str:
+    """Compact text block from a summarize_ring() result."""
+    lines = [f"training health: {summary['rounds']} round(s)"]
+    for key, s in sorted(summary["signals"].items()):
+        lines.append(f"  {key}: last {s.get('last', 0.0):.4f} "
+                     f"(min {s['min']:.4f}, max {s['max']:.4f})")
+    trig = summary["trigger_counts"]
+    lines.append("  triggers: " + (", ".join(
+        f"{k}={v}" for k, v in sorted(trig.items())) if trig else "none"))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-stage latency summary of an obs span JSONL.")
@@ -91,6 +109,10 @@ def main(argv=None) -> int:
                         help="show only the first N stages (0 = all)")
     parser.add_argument("--sort", choices=SORT_KEYS, default="total",
                         help="sort column (default: total)")
+    parser.add_argument("--health", default=None,
+                        help="training-health ring JSONL "
+                             "(TrainingHealthMonitor.export_jsonl) to "
+                             "summarize after the latency table")
     args = parser.parse_args(argv)
 
     if not os.path.exists(args.path):
@@ -119,6 +141,14 @@ def main(argv=None) -> int:
             f"{stitch['traces']} traces cross the rpc boundary, "
             f"{stitch['replayed_server_spans']} idempotent replays, "
             f"max clock skew {stitch['clock_skew_s_max'] * 1000:.3f} ms")
+    if args.health:
+        if not os.path.exists(args.health):
+            print(f"obs_report: no such file: {args.health}",
+                  file=sys.stderr)
+            return 2
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from training_health_report import summarize_ring
+        print("\n" + render_health(summarize_ring(args.health)))
     return 0
 
 

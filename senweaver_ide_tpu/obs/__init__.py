@@ -37,6 +37,9 @@ from .propagation import (TraceContext, clock_skew_s, extract,
                           server_span)
 from .slo import (SECONDS_BUCKETS, SLOConfig, SLOTarget, SLOTracker)
 from .telemetry import StepTelemetry, advantage_stats, estimate_mfu
+from .training_health import (TrainingHealthConfig, TrainingHealthMonitor,
+                              evaluate_health, get_health_monitor,
+                              set_health_monitor)
 from .timeline import RequestTimeline, TimelineRecorder
 from .tracing import SpanRecord, Tracer, load_span_jsonl, stitch_summary
 
@@ -49,6 +52,8 @@ __all__ = [
     "RequestTimeline", "TimelineRecorder",
     "SLOConfig", "SLOTarget", "SLOTracker",
     "StepTelemetry", "advantage_stats", "estimate_mfu",
+    "TrainingHealthConfig", "TrainingHealthMonitor", "evaluate_health",
+    "get_health_monitor", "set_health_monitor",
     "get_tracer", "get_registry", "enable", "disable", "is_enabled",
     "traced",
 ]
@@ -117,4 +122,5 @@ def _reset_for_tests() -> None:
         old = _tracer
         _tracer = Tracer(enabled=False)
         _registry = MetricsRegistry()
+    set_health_monitor(None)   # next get_health_monitor() rebuilds
     old.close()

@@ -55,25 +55,15 @@ def advantage_stats(rewards, group_ids) -> Dict[str, float]:
     group-relative advantages actually fed to the loss.
 
     Call BEFORE ``place_batch_for_mesh`` — sharded arrays would force a
-    device sync here, and this is pure bookkeeping."""
-    import numpy as np
-    r = np.asarray(rewards, dtype=np.float64).reshape(-1)
-    g = np.asarray(group_ids).reshape(-1)
-    if r.size == 0 or g.size != r.size:
-        return {"zero_advantage_group_fraction": 0.0,
-                "advantage_std": 0.0, "groups": 0}
-    adv = np.empty_like(r)
-    zero_groups = 0
-    uniq = np.unique(g)
-    for gid in uniq:
-        sel = g == gid
-        centered = r[sel] - r[sel].mean()
-        adv[sel] = centered
-        if np.all(centered == 0.0):
-            zero_groups += 1
-    return {"zero_advantage_group_fraction": zero_groups / len(uniq),
-            "advantage_std": float(adv.std()),
-            "groups": int(len(uniq))}
+    device sync here, and this is pure bookkeeping.
+
+    Since PR 9 this delegates to ``training.diagnostics.advantage_stats``
+    (lazy import — obs stays below training in the layering): one
+    NaN-safe code path shared with the jitted diagnostics head, instead
+    of a second numpy implementation that a single non-finite reward
+    silently poisoned."""
+    from ..training.diagnostics import advantage_stats as _impl
+    return _impl(rewards, group_ids)
 
 
 class StepTelemetry:
@@ -141,10 +131,20 @@ class StepTelemetry:
                      completion_tokens: int = 0, episodes: int = 0,
                      trajectories: int = 0,
                      ppo_epochs: int = 1,
-                     advantage_stats: Optional[Dict[str, float]] = None
+                     advantage_stats: Optional[Dict[str, float]] = None,
+                     health: Optional[Dict[str, float]] = None,
+                     health_triggers: Optional[list] = None,
+                     health_events: Optional[list] = None,
+                     round_index: Optional[int] = None
                      ) -> Dict[str, Any]:
         """Publish one round's telemetry; returns the derived values so
-        the caller can also feed them to MetricsService captures."""
+        the caller can also feed them to MetricsService captures.
+
+        ``health`` is the round's flat training-health dict (from
+        ``training.diagnostics`` + step metrics); it is routed to the
+        global :class:`~.training_health.TrainingHealthMonitor`
+        (gauges, ring, worst-K) with the precomputed ``health_triggers``
+        and any mitigation ``health_events``."""
         train_tokens = batch_tokens * max(1, ppo_epochs)
         out: Dict[str, Any] = {}
         if train_s > 0:
@@ -170,6 +170,18 @@ class StepTelemetry:
             std = advantage_stats.get("advantage_std")
             if std is not None:
                 out["advantage_std"] = float(std)
+                self._adv_std.set(float(std))
+        if health:
+            from .training_health import get_health_monitor
+            out["health_triggers"] = get_health_monitor().observe(
+                health, round_index=round_index,
+                triggers=health_triggers, events=health_events)
+            # Keep the PR-8 gauges live from the richer dict too.
+            frac = health.get("zero_advantage_group_fraction")
+            if frac is not None:
+                self._zero_adv_frac.set(float(frac))
+            std = health.get("advantage_std")
+            if std is not None:
                 self._adv_std.set(float(std))
         if self.param_count and train_s > 0:
             flops_per_sec = 6.0 * self.param_count * train_tokens / train_s

@@ -436,7 +436,8 @@ def pp_train_step(state, config: ModelConfig, mesh: Mesh,
     adv = group_relative_advantages(
         rewards, group_ids, n_groups,
         normalize_std=grpo_config.normalize_std,
-        min_std=grpo_config.min_group_std)
+        min_std=grpo_config.min_group_std,
+        leave_one_out=grpo_config.leave_one_out)
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     tgt_mask = completion_mask[:, 1:]
 

@@ -25,7 +25,9 @@ from .chaos import (ChaosEngine, ChaosError, ChaosSession, EngineFault,
                     NETWORK_FAULT_KINDS, NetworkFault, NetworkFaultPlan)
 from .faults import (FailedEpisode, REASON_ERROR, REASON_TIMEOUT,
                      ResilienceConfig, episode_retry_delay_s)
-from .guard import (REASON_LOSS_SPIKE, REASON_NONFINITE_GRAD,
+from .guard import (HealthMitigator, MITIGATION_GROUP_SIZE,
+                    MITIGATION_LEAVE_ONE_OUT, MITIGATION_TOKEN_LEVEL,
+                    REASON_LOSS_SPIKE, REASON_NONFINITE_GRAD,
                     REASON_NONFINITE_LOSS, UpdateGuard)
 from .lease import Lease, LeaseLost, LeaseStore, LeaseUnavailable
 from .retry import (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
@@ -40,7 +42,8 @@ __all__ = [
     "ResilienceConfig", "episode_retry_delay_s",
     "Lease", "LeaseLost", "LeaseStore", "LeaseUnavailable",
     "REASON_LOSS_SPIKE", "REASON_NONFINITE_GRAD", "REASON_NONFINITE_LOSS",
-    "UpdateGuard",
+    "UpdateGuard", "HealthMitigator", "MITIGATION_GROUP_SIZE",
+    "MITIGATION_LEAVE_ONE_OUT", "MITIGATION_TOKEN_LEVEL",
     "BREAKER_CLOSED", "BREAKER_HALF_OPEN", "BREAKER_OPEN",
     "CircuitBreaker", "RetryBudget", "RetryPolicy", "parse_retry_after",
 ]

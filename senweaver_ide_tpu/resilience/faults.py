@@ -79,6 +79,22 @@ class ResilienceConfig:
     spike_min_history: int = 5          # don't judge before this many
     spike_min_std: float = 1e-3         # floor: constant history ≠ spike
 
+    # -- health-guarded mitigations (obs/training_health.py triggers) ------
+    # Master switch: when False every trigger is recorded but every
+    # mitigation is VETOED (observed, counted, not applied). The
+    # sub-gates pick which mitigations MAY fire once the master is on.
+    health_mitigations: bool = False
+    mitigate_leave_one_out: bool = True     # RLOO on rank_collapse/zero_groups
+    mitigate_token_level: bool = True       # token credit on credit_collapse
+    mitigate_group_size: bool = False       # scheduler hook (rl_loop/online)
+    # Hysteresis: a trigger must fire this many CONSECUTIVE rounds to
+    # enable its mitigation, and stay quiet this many to disable it —
+    # one noisy round shouldn't flip the objective back and forth.
+    health_trigger_rounds: int = 2
+    # Group-size scheduler clamp (only used when mitigate_group_size).
+    group_size_min: int = 2
+    group_size_max: int = 16
+
 
 def episode_retry_delay_s(attempt: int, *, base_s: float,
                           max_s: float) -> float:

@@ -147,6 +147,7 @@ class DashboardService:
                 out["onboarding"] = {"error": str(e)}
         out["training"] = _training_curves(self.metrics_path)
         out["obs"] = self._obs_summary()
+        out["training_health"] = self._training_health_summary()
         out["resilience"] = self._resilience_summary()
         out["serving"] = self._serving_summary()
         out["slo"] = self._slo_summary()
@@ -179,6 +180,54 @@ class DashboardService:
                     total("senweaver_uploader_retries_total"),
                 "chaos_injected":
                     total("senweaver_chaos_faults_injected_total"),
+                # Per-reason guard skips (PR 9): which tripwire fired —
+                # nonfinite_loss vs nonfinite_grad_norm vs loss_spike.
+                "guard_skip_reasons": self._label_totals(
+                    "senweaver_guard_skips_total"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _label_totals(self, name: str) -> Dict[str, float]:
+        """A single-label counter's cells as ``{label_value: total}``."""
+        m = self.registry.get(name)
+        if m is None:
+            return {}
+        return {k[0]: float(v) for k, v in m.samples().items() if k}
+
+    def _training_health_summary(self) -> Dict[str, Any]:
+        """GRPO training-health tile row, read straight off the
+        registry's ``senweaver_grpo_health_*`` series (zero wiring —
+        any loop publishing through StepTelemetry.record_round shows
+        up; all None/zero without one)."""
+        def gauge(name: str) -> Optional[float]:
+            m = self.registry.get(f"senweaver_grpo_health_{name}")
+            return float(m.value()) if m is not None else None
+
+        try:
+            rounds = self.registry.get("senweaver_grpo_health_rounds_total")
+            group_size = self.registry.get("senweaver_grpo_group_size")
+            mit = self.registry.get(
+                "senweaver_grpo_health_mitigations_total")
+            return {
+                "rounds": float(rounds.value()) if rounds else 0,
+                "score": gauge("score"),
+                "rank_fraction": gauge("rank_fraction"),
+                "effective_rank": gauge("effective_rank"),
+                "zero_group_fraction":
+                    gauge("zero_advantage_group_fraction"),
+                "credit_entropy": gauge("credit_entropy"),
+                "grad_sparsity": gauge("grad_sparsity"),
+                "policy_entropy": gauge("policy_entropy"),
+                "kl_to_anchor": gauge("kl_to_anchor"),
+                "nonfinite_fraction": gauge("nonfinite_reward_fraction"),
+                "group_size": (float(group_size.value())
+                               if group_size else None),
+                "triggers": self._label_totals(
+                    "senweaver_grpo_health_triggers_total"),
+                "mitigations": ({"/".join(k): float(v)
+                                 for k, v in mit.samples().items()}
+                                if mit is not None else {}),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -538,8 +587,11 @@ input[type=text], input[type=password], textarea {
 <section><h2>Observability</h2>
 <div id="obs" class="tiles"></div>
 <div id="obs-spans"></div></section>
+<section><h2>Training health</h2>
+<div id="training-health" class="tiles"></div>
+<div id="health-triggers"></div></section>
 <section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
-</section>
+<div id="guard-skips"></div></section>
 <section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
 <section><h2>SLO</h2>
 <div id="slo" class="tiles"></div>
@@ -751,6 +803,24 @@ async function refresh() {
   document.getElementById("obs-spans").innerHTML = table(
     (ob_.slowest || []).map(x => [x.name, x.duration_ms]),
     ["slowest span", "ms"]);
+  const th = s.training_health || {};
+  tiles(document.getElementById("training-health"), [
+    ["health rounds", th.rounds],
+    ["health score", th.score],
+    ["rank fraction", th.rank_fraction],
+    ["effective rank", th.effective_rank],
+    ["zero-adv groups", th.zero_group_fraction],
+    ["credit entropy", th.credit_entropy],
+    ["grad sparsity", th.grad_sparsity],
+    ["policy entropy", th.policy_entropy],
+    ["kl to anchor", th.kl_to_anchor],
+    ["nonfinite frac", th.nonfinite_fraction],
+    ["group size", th.group_size]]);
+  document.getElementById("health-triggers").innerHTML = table(
+    Object.entries(th.triggers || {}).map(([k, v]) => [k, v])
+      .concat(Object.entries(th.mitigations || {})
+        .map(([k, v]) => ["mitigation " + k, v])),
+    ["trigger / mitigation", "count"]);
   const res = s.resilience || {};
   tiles(document.getElementById("resilience"), [
     ["failed episodes", res.episodes_failed],
@@ -760,6 +830,9 @@ async function refresh() {
     ["updates skipped", res.updates_skipped],
     ["uploader retries", res.uploader_retries],
     ["chaos injected", res.chaos_injected]]);
+  document.getElementById("guard-skips").innerHTML = table(
+    Object.entries(res.guard_skip_reasons || {}).map(([k, v]) => [k, v]),
+    ["guard skip reason", "count"]);
   const sv = s.serving || {};
   tiles(document.getElementById("serving"), [
     ["replicas live", sv.replicas_live],

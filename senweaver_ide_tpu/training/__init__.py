@@ -1,5 +1,7 @@
 from .grpo import (GRPOConfig, group_relative_advantages, grpo_objective,
-                   token_logprobs)
+                   token_credit_weights, token_logprobs)
+from .diagnostics import (DiagnosticsConfig, advantage_stats,
+                          dispatch_round_health, finalize_round_health)
 from .trainer import (TrainState, make_lora_train_state, make_optimizer,
                       make_train_state, train_step, train_step_guarded)
 from .lora import (export_peft_adapter, init_lora, load_peft_adapter,
@@ -9,6 +11,6 @@ from .checkpoint import CheckpointManager
 from .data import (Trajectory, TrajectoryDataset, make_batch,
                    make_batch_logps)
 from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
-from .rl_loop import (CollectResult, EpisodeRecord, RoundResult,
-                      collect_group_trajectories, grpo_round)
+from .rl_loop import (CollectResult, EpisodeRecord, GroupSizeScheduler,
+                      RoundResult, collect_group_trajectories, grpo_round)
 from .online import OnlineImprovementLoop, OnlineRoundResult

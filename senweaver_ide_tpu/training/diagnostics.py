@@ -1,0 +1,236 @@
+"""On-device GRPO training-health diagnostics (the jitted head).
+
+The GRPO foundations paper (PAPERS.md, 2606.29238) shows group-relative
+advantages degenerate in exactly the regime the disaggregated fleet
+makes cheap — large groups, long horizons: gradients go sparse, the
+advantage matrix collapses in rank, and per-token credit concentrates
+on a few positions. This module computes those statistics PER ROUND,
+entirely on device, from the same host batch ``rl_loop`` is about to
+place on the mesh:
+
+- **advantage rank spectrum** — singular values of the group-by-position
+  advantage matrix ``M[g, s] = mean over group g of adv_b * mask[b, s]``;
+  reported as effective rank ``exp(H(sigma/sum sigma))``, its fraction of
+  the attainable rank, and the participation ratio
+  ``(sum s^2)^2 / sum s^4``;
+- **per-token credit entropy** — normalized entropy of the |per-token
+  advantage| mass over the response mask (1 = credit spread evenly,
+  0 = all credit on one token);
+- **zero/degenerate-group fraction** — groups whose finite rewards all
+  tie (no learning signal), counted over groups actually PRESENT in the
+  batch (group ids are task indices and survive group drops
+  non-contiguously);
+- **NaN safety** — non-finite rewards are excluded from every statistic
+  and surfaced as ``nonfinite_reward_fraction`` instead of silently
+  poisoning the std (the pre-PR-9 ``obs.advantage_stats`` failure mode).
+
+Host-sync contract (analysis/jit_lint.py): :func:`dispatch_round_health`
+only DISPATCHES the jitted head (async, overlaps with batch placement);
+:func:`finalize_round_health` performs the round's single batched
+``jax.device_get`` of the whole stats dict. Nothing in the traced path
+reads device values back.
+
+Gradient sparsity and the policy-entropy / KL-to-anchor drift signals
+ride in the train step's own metrics (training/grpo.py
+``grad_sparsity``; ``entropy`` / ``kl``) — ``rl_loop`` merges them into
+the same health dict after the update, so the telemetry consumer
+(obs/training_health.py) sees one flat record per round.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .grpo import GRPOConfig
+
+
+class DiagnosticsConfig(NamedTuple):
+    """Static (hashable) knobs of the jitted head. Mirror of the
+    advantage transform actually fed to the loss, so the spectrum the
+    detectors see is the spectrum the optimizer sees."""
+
+    normalize_std: bool = True
+    min_group_std: float = 1e-4
+    leave_one_out: bool = False
+    # A group is "zero-advantage" when its centered rewards all fall
+    # within this RELATIVE tolerance of zero (scaled by 1 + |group
+    # mean|, so reward magnitude doesn't change what counts as a tie).
+    zero_adv_rtol: float = 1e-8
+
+    @classmethod
+    def from_grpo(cls, config: GRPOConfig) -> "DiagnosticsConfig":
+        return cls(normalize_std=config.normalize_std,
+                   min_group_std=config.min_group_std,
+                   leave_one_out=config.leave_one_out)
+
+
+@functools.partial(jax.jit, static_argnames=("num_groups", "config"))
+def _diagnostics_head(rewards: jax.Array, group_ids: jax.Array,
+                      mask: jax.Array, num_groups: int,
+                      config: DiagnosticsConfig
+                      ) -> Dict[str, jax.Array]:
+    """All-device round health: NaN-safe advantage stats + rank
+    spectrum + credit entropy. Returns a dict of f32 scalars; the
+    caller performs the one batched device_get (finalize_round_health).
+
+    ``num_groups`` and ``config`` are static — one recompile per
+    distinct (group count, config) pair, same trade the train step
+    already makes with its own static args."""
+    eps = jnp.float32(1e-30)
+    rewards = rewards.astype(jnp.float32)
+    finite = jnp.isfinite(rewards)
+    fin = finite.astype(jnp.float32)
+    r = jnp.where(finite, rewards, 0.0)
+    n = jnp.maximum(jnp.float32(rewards.shape[0]), 1.0)
+    # Summing the 0/1 indicator directly keeps the fraction exactly 0.0
+    # on clean batches (1 - sum(fin)/n rounds to -3e-8 in f32, which a
+    # `> 0` nonfinite detector would trip on).
+    nonfinite_fraction = jnp.sum(1.0 - fin) / n
+
+    ones = jnp.ones_like(r)
+    counts_all = jax.ops.segment_sum(ones, group_ids,
+                                     num_segments=num_groups)
+    counts_fin = jax.ops.segment_sum(fin, group_ids,
+                                     num_segments=num_groups)
+    present = counts_all > 0.0
+    n_present = jnp.maximum(jnp.sum(present.astype(jnp.float32)), 1.0)
+
+    sums = jax.ops.segment_sum(r * fin, group_ids,
+                               num_segments=num_groups)
+    means = sums / jnp.maximum(counts_fin, 1.0)
+    centered = (r - means[group_ids]) * fin
+
+    # Zero-advantage groups: every FINITE member ties (relative tol).
+    absmax = jax.ops.segment_max(jnp.abs(centered), group_ids,
+                                 num_segments=num_groups)
+    absmax = jnp.where(present, absmax, 0.0)   # empty segments are -inf
+    tie_tol = config.zero_adv_rtol * (1.0 + jnp.abs(means))
+    zero_group = present & (absmax <= tie_tol)
+    zero_group_fraction = (jnp.sum(zero_group.astype(jnp.float32))
+                           / n_present)
+
+    # The advantages actually fed to the loss (same transform chain as
+    # training/grpo.py group_relative_advantages, over finite members).
+    if config.leave_one_out:
+        factor = counts_fin / jnp.maximum(counts_fin - 1.0, 1.0)
+        adv = centered * factor[group_ids]
+    elif config.normalize_std:
+        sq = jax.ops.segment_sum(centered * centered, group_ids,
+                                 num_segments=num_groups)
+        std = jnp.sqrt(sq / jnp.maximum(counts_fin, 1.0))
+        adv = centered / jnp.maximum(std[group_ids],
+                                     config.min_group_std)
+    else:
+        adv = centered
+    n_fin = jnp.maximum(jnp.sum(fin), 1.0)
+    adv_mean = jnp.sum(adv) / n_fin
+    adv_std = jnp.sqrt(jnp.sum(fin * (adv - adv_mean) ** 2) / n_fin)
+
+    # Group-by-position advantage matrix -> singular spectrum.
+    m = mask.astype(jnp.float32)
+    tok_adv = adv[:, None] * m                          # (B, S)
+    gsum = jax.ops.segment_sum(tok_adv, group_ids,
+                               num_segments=num_groups)  # (G, S)
+    mat = gsum / jnp.maximum(counts_all, 1.0)[:, None]
+    sv = jnp.linalg.svd(mat, compute_uv=False)
+    ssum = jnp.sum(sv)
+    p = sv / jnp.maximum(ssum, eps)
+    spec_entropy = -jnp.sum(p * jnp.log(jnp.maximum(p, eps)))
+    # An all-zero matrix (no advantage signal at all) is maximally
+    # collapsed: pin it to the 1-direction floor rather than NaN.
+    effective_rank = jnp.where(ssum > eps, jnp.exp(spec_entropy), 1.0)
+    sv2 = jnp.sum(sv * sv)
+    participation = jnp.where(sv2 > eps,
+                              (sv2 * sv2) / jnp.maximum(
+                                  jnp.sum(sv ** 4), eps),
+                              1.0)
+    # Attainable rank: present groups x positions any trajectory masks.
+    s_active = jnp.maximum(jnp.sum(jnp.any(m > 0.0, axis=0)
+                                   .astype(jnp.float32)), 1.0)
+    rank_fraction = effective_rank / jnp.maximum(
+        jnp.minimum(n_present, s_active), 1.0)
+
+    # Credit entropy: where does |advantage| mass sit across the
+    # batch's masked tokens? Normalized by log(n_masked) to [0, 1].
+    w = jnp.abs(tok_adv)
+    wsum = jnp.sum(w)
+    pw = w / jnp.maximum(wsum, eps)
+    credit_h = -jnp.sum(pw * jnp.log(jnp.maximum(pw, eps)))
+    n_masked = jnp.sum(m)
+    credit_entropy = jnp.where(
+        (wsum > eps) & (n_masked > 1.0),
+        credit_h / jnp.log(jnp.maximum(n_masked, 2.0)), 0.0)
+
+    return {
+        "nonfinite_reward_fraction": nonfinite_fraction,
+        "zero_advantage_group_fraction": zero_group_fraction,
+        "groups_present": n_present,
+        "advantage_mean": adv_mean,
+        "advantage_std": adv_std,
+        "effective_rank": effective_rank,
+        "rank_fraction": rank_fraction,
+        "participation_ratio": participation,
+        "top_singular_value": jnp.max(sv),
+        "credit_entropy": credit_entropy,
+    }
+
+
+def dispatch_round_health(rewards, group_ids, mask, *,
+                          num_groups: Optional[int] = None,
+                          config: DiagnosticsConfig = DiagnosticsConfig()
+                          ) -> Dict[str, jax.Array]:
+    """Dispatch the jitted head on HOST batch arrays (call before
+    ``place_batch_for_mesh``; the result computes asynchronously while
+    placement and the forward pass proceed). Returns the device dict —
+    hand it to :func:`finalize_round_health` for the round's single
+    batched sync."""
+    import numpy as np
+    g = np.asarray(group_ids)
+    if num_groups is None:
+        num_groups = int(g.max()) + 1 if g.size else 1
+    return _diagnostics_head(
+        jnp.asarray(rewards, jnp.float32), jnp.asarray(g, jnp.int32),
+        jnp.asarray(mask), num_groups=int(num_groups), config=config)
+
+
+def finalize_round_health(device_stats: Dict[str, jax.Array]
+                          ) -> Dict[str, float]:
+    """The round's ONE batched device→host sync: fetch the whole stats
+    dict in a single ``jax.device_get`` and return plain floats."""
+    host = jax.device_get(device_stats)
+    return {k: float(v) for k, v in host.items()}
+
+
+def advantage_stats(rewards, group_ids) -> Dict[str, float]:
+    """NaN-safe GRPO advantage diagnostics from host reward/group
+    arrays — the single implementation behind ``obs.advantage_stats``
+    (kept shape-compatible: same three historical keys, plus the
+    non-finite fraction the old numpy path silently swallowed).
+
+    Group ids may be arbitrary hashables-as-ints (non-contiguous after
+    group drops); they are densified before hitting the jitted head.
+    ``advantage_std`` is the spread of the plain centered advantages,
+    matching the historical contract."""
+    import numpy as np
+    r = np.asarray(rewards, dtype=np.float64).reshape(-1)
+    g = np.asarray(group_ids).reshape(-1)
+    if r.size == 0 or g.size != r.size:
+        return {"zero_advantage_group_fraction": 0.0,
+                "advantage_std": 0.0, "groups": 0,
+                "nonfinite_reward_fraction": 0.0}
+    uniq, codes = np.unique(g, return_inverse=True)
+    out = finalize_round_health(dispatch_round_health(
+        r, codes, np.ones((r.size, 1), dtype=bool),
+        num_groups=len(uniq),
+        config=DiagnosticsConfig(normalize_std=False)))
+    return {
+        "zero_advantage_group_fraction":
+            out["zero_advantage_group_fraction"],
+        "advantage_std": out["advantage_std"],
+        "groups": int(len(uniq)),
+        "nonfinite_reward_fraction": out["nonfinite_reward_fraction"],
+    }

@@ -30,6 +30,19 @@ class GRPOConfig(NamedTuple):
     normalize_std: bool = True
     min_group_std: float = 1e-4
     moe_aux_coef: float = 0.01   # MoE load-balance weight (num_experts > 0)
+    # Health-guarded mitigations (training/diagnostics.py detectors,
+    # resilience/guard.py HealthMitigator triggers) — default OFF so
+    # every existing caller keeps the exact historical objective:
+    # RLOO leave-one-out baseline (unnormalized by construction —
+    # dividing by the same group's std would reintroduce the rank
+    # coupling the mitigation exists to remove).
+    leave_one_out: bool = False
+    # Per-token credit: weight each completion token's advantage by a
+    # gamma-decay toward the reward (later tokens closer to the episode
+    # outcome carry more credit), normalized to mean 1 per sequence so
+    # the loss scale is unchanged. gamma=1.0 is exactly uniform credit.
+    token_level_advantages: bool = False
+    token_adv_gamma: float = 0.98
 
 
 def group_relative_advantages(
@@ -40,20 +53,50 @@ def group_relative_advantages(
     *,
     normalize_std: bool = True,
     min_std: float = 1e-4,
+    leave_one_out: bool = False,
 ) -> jax.Array:
-    """Center (and optionally scale) rewards within each prompt group."""
+    """Center (and optionally scale) rewards within each prompt group.
+
+    ``leave_one_out=True`` is the RLOO baseline: each trajectory is
+    compared against the mean of the OTHER group members,
+    ``adv_i = r_i - mean(group \\ i) = (n/(n-1)) * (r_i - mean)``.
+    RLOO advantages are returned UNNORMALIZED (``normalize_std`` is
+    ignored): the point of the mitigation is to decouple a trajectory's
+    scale from its own group's spread."""
     ones = jnp.ones_like(rewards)
     counts = jax.ops.segment_sum(ones, group_ids, num_segments=num_groups)
     counts = jnp.maximum(counts, 1.0)
     sums = jax.ops.segment_sum(rewards, group_ids, num_segments=num_groups)
     means = sums / counts
     centered = rewards - means[group_ids]
+    if leave_one_out:
+        # n=1 groups mean-center to zero either way; clamp keeps the
+        # scale factor finite there.
+        factor = counts / jnp.maximum(counts - 1.0, 1.0)
+        return centered * factor[group_ids]
     if not normalize_std:
         return centered
     sq = jax.ops.segment_sum(centered * centered, group_ids,
                              num_segments=num_groups)
     std = jnp.sqrt(sq / counts)
     return centered / jnp.maximum(std[group_ids], min_std)
+
+
+def token_credit_weights(mask: jax.Array, gamma: float) -> jax.Array:
+    """(B, S) per-token credit weights: ``gamma``-decay from the LAST
+    masked token backward (tokens nearer the reward carry more credit),
+    normalized to mean 1 over each row's masked tokens so multiplying a
+    sequence-level advantage by the weights preserves the loss scale.
+    Rows with no masked tokens return zeros; ``gamma=1`` returns the
+    mask itself (uniform credit)."""
+    m = mask.astype(jnp.float32)
+    n_tok = jnp.sum(m, axis=-1, keepdims=True)            # (B, 1)
+    # 0-based position among the row's MASKED tokens.
+    pos = jnp.cumsum(m, axis=-1) - 1.0
+    w = jnp.power(jnp.float32(gamma), jnp.maximum(n_tok - 1.0 - pos,
+                                                  0.0)) * m
+    norm = jnp.sum(w, axis=-1, keepdims=True)
+    return w * n_tok / jnp.maximum(norm, 1e-30)
 
 
 def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -66,15 +109,26 @@ def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
 def grpo_objective(
     logp: jax.Array,             # (B, S) current-policy completion logprobs
     old_logp: jax.Array,         # (B, S) behavior-policy logprobs (sampled)
-    advantages: jax.Array,       # (B,)
+    advantages: jax.Array,       # (B,) per-trajectory, or (B, S) per-token
     mask: jax.Array,             # (B, S) True on completion tokens
     config: GRPOConfig = GRPOConfig(),
     ref_logp: Optional[jax.Array] = None,  # (B, S) frozen reference policy
 ) -> tuple:
-    """Clipped surrogate + KL penalty. Returns (loss, metrics dict)."""
+    """Clipped surrogate + KL penalty. Returns (loss, metrics dict).
+
+    ``advantages`` may be per-trajectory (B,) — the historical shape —
+    or already per-token (B, S). With ``config.token_level_advantages``
+    a (B,) advantage is spread over the response mask with
+    :func:`token_credit_weights` (gamma-decay toward the reward) instead
+    of broadcast uniformly."""
     mask = mask.astype(jnp.float32)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
-    adv = advantages[:, None]
+    if advantages.ndim == 2:
+        adv = advantages
+    else:
+        adv = advantages[:, None]
+        if config.token_level_advantages:
+            adv = adv * token_credit_weights(mask, config.token_adv_gamma)
 
     ratio = jnp.exp(logp - old_logp)
     unclipped = ratio * adv
@@ -100,6 +154,26 @@ def grpo_objective(
 
     loss = (pg_loss + config.kl_coef * kl
             - config.entropy_coef * entropy)
+
+    # Gradient-sparsity diagnostic (2606.29238's sparse-gradient failure
+    # mode): the surrogate's per-token gradient wrt logp is
+    # ratio*adv where the clip isn't binding against the advantage's
+    # direction, and exactly 0 where it is — so a per-example RMS norm
+    # of that closed form is the cheap stand-in for a per-example
+    # parameter-gradient norm. The fraction of examples whose norm is
+    # ~0 (zero-advantage groups, fully-clipped rows) is the share of
+    # the batch contributing NO learning signal this step.
+    clip_active = jnp.where(adv >= 0.0,
+                            ratio <= 1.0 + config.clip_eps,
+                            ratio >= 1.0 - config.clip_eps)
+    g_tok = ratio * adv * clip_active.astype(jnp.float32) * mask
+    tok_counts = jnp.sum(mask, axis=-1)
+    ex_norm = jnp.sqrt(jnp.sum(g_tok * g_tok, axis=-1)
+                       / jnp.maximum(tok_counts, 1.0))
+    has_tok = (tok_counts > 0.0).astype(jnp.float32)
+    near_zero = (ex_norm < 1e-6).astype(jnp.float32) * has_tok
+    grad_sparsity = jnp.sum(near_zero) / jnp.maximum(jnp.sum(has_tok), 1.0)
+
     metrics = {
         "pg_loss": pg_loss,
         "kl": kl,
@@ -107,5 +181,6 @@ def grpo_objective(
         "ratio_mean": jnp.sum(ratio * mask) / denom,
         "clip_frac": jnp.sum((jnp.abs(ratio - 1.0) > config.clip_eps) * mask)
         / denom,
+        "grad_sparsity": grad_sparsity,
     }
     return loss, metrics

@@ -39,10 +39,10 @@ from ..apo.eval import outcome_feedback
 from ..apo.service import APOService
 from ..obs import get_tracer
 from ..resilience.faults import ResilienceConfig
-from ..resilience.guard import UpdateGuard
+from ..resilience.guard import HealthMitigator, UpdateGuard
 from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
-from .rl_loop import grpo_round
+from .rl_loop import GroupSizeScheduler, grpo_round
 
 # Loop-id source (see OnlineImprovementLoop._loop_id): a process-unique
 # tag + counter. The tag matters for WAL-persisted collectors — feedback
@@ -92,6 +92,11 @@ class OnlineRoundResult:
     failed_episodes: int = 0    # episodes quarantined this round
     update_skipped: Optional[str] = None  # guard veto reason, if any
     checkpointed: bool = False  # a checkpoint landed after this round
+    # Training-health surface (empty for rounds with no batch):
+    health: Dict[str, float] = dataclasses.field(default_factory=dict)
+    health_triggers: List[str] = dataclasses.field(default_factory=list)
+    health_events: List[str] = dataclasses.field(default_factory=list)
+    group_size: int = 0         # group size the NEXT round will collect
 
 
 class OnlineImprovementLoop:
@@ -155,6 +160,17 @@ class OnlineImprovementLoop:
         self.resilience = resilience
         self._update_guard = (UpdateGuard.from_config(resilience)
                               if resilience is not None else None)
+        # Training-health mitigations: ONE mitigator spans the loop
+        # (streak hysteresis is cross-round state, like the guard's
+        # spike baseline). Even with health_mitigations=False it runs —
+        # triggers are then counted as vetoes instead of applied. The
+        # group-size scheduler only engages when its sub-gate is on.
+        self._health_mitigator = (HealthMitigator.from_config(resilience)
+                                  if resilience is not None else None)
+        self._group_scheduler = (
+            GroupSizeScheduler.from_config(resilience, group_size)
+            if resilience is not None and resilience.mitigate_group_size
+            else None)
         # Preemption safety: with a CheckpointManager, the loop persists
         # its full resume surface (train state + round index + session
         # cursor + optimized rules + KL anchor) every
@@ -257,8 +273,23 @@ class OnlineImprovementLoop:
             reward_override=reward,
             metrics_service=self.metrics_service, engine=self.engine,
             ref_params=self._anchor, resilience=self.resilience,
-            update_guard=self._update_guard, round_idx=self._round)
+            update_guard=self._update_guard,
+            health_mitigator=self._health_mitigator,
+            round_idx=self._round)
         self.state = out.state
+        # Group-size mitigation tick: resize for the NEXT round while
+        # its trigger streak is active; changes become round events.
+        health_events = list(out.health_events)
+        if (self._group_scheduler is not None
+                and self._health_mitigator is not None):
+            self.group_size, gs_events = self._group_scheduler.update(
+                self._health_mitigator.group_size_active())
+            health_events.extend(gs_events)
+            if gs_events and self.metrics_service is not None:
+                self.metrics_service.capture("Group Size Rescheduled", {
+                    "round": self._round, "group_size": self.group_size,
+                    "events": ",".join(gs_events),
+                })
         if (self._anchor is not None and self.anchor_every > 0
                 and (self._round + 1) % self.anchor_every == 0):
             self._anchor = self.state.params
@@ -305,7 +336,11 @@ class OnlineImprovementLoop:
             beam_ran=beam_ran,
             train_metrics=dict(out.metrics),
             failed_episodes=len(out.failures),
-            update_skipped=out.update_skipped)
+            update_skipped=out.update_skipped,
+            health=dict(out.health),
+            health_triggers=list(out.health_triggers),
+            health_events=health_events,
+            group_size=self.group_size)
         self._round += 1
         if (self.checkpoint_manager is not None and self.checkpoint_every
                 and self._round % self.checkpoint_every == 0):

@@ -53,6 +53,12 @@ class RoundResult:
     failures: List[FailedEpisode] = dataclasses.field(default_factory=list)
     dropped_groups: List[int] = dataclasses.field(default_factory=list)
     update_skipped: Optional[str] = None
+    # Training-health surface (empty for skipped/empty rounds): the
+    # round's flat health dict (training/diagnostics + step metrics),
+    # the detector triggers that fired, and any mitigation/veto events.
+    health: Dict[str, float] = dataclasses.field(default_factory=dict)
+    health_triggers: List[str] = dataclasses.field(default_factory=list)
+    health_events: List[str] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -71,6 +77,51 @@ class CollectResult:
 
     def __iter__(self):
         return iter((self.trajectories, self.episodes))
+
+
+class GroupSizeScheduler:
+    """Health-triggered group-size hook (the third PR-9 mitigation).
+
+    A high zero-advantage-group fraction usually means the group is too
+    SMALL to separate rewards — more samples per prompt restore a
+    spread. While the ``group_size`` mitigation is active
+    (resilience.HealthMitigator streak logic), :meth:`update` doubles
+    the group size toward ``max_size``; once the mitigation clears it
+    halves back toward the caller's baseline. The current size
+    publishes as the ``senweaver_grpo_group_size`` gauge and every
+    change is returned as a round event — the loop (training/online.py)
+    feeds the returned size into its NEXT round's collection."""
+
+    def __init__(self, group_size: int, *, min_size: int = 2,
+                 max_size: int = 16, registry=None):
+        if registry is None:
+            registry = get_registry()
+        self.base = max(1, int(group_size))
+        self.min_size = max(1, int(min_size))
+        self.max_size = max(self.min_size, int(max_size))
+        self.current = min(max(self.base, self.min_size), self.max_size)
+        self._gauge = registry.gauge(
+            "senweaver_grpo_group_size",
+            "Current GRPO group size (health scheduler may raise it).")
+        self._gauge.set(float(self.current))
+
+    @classmethod
+    def from_config(cls, config: ResilienceConfig, group_size: int,
+                    registry=None) -> "GroupSizeScheduler":
+        return cls(group_size, min_size=config.group_size_min,
+                   max_size=config.group_size_max, registry=registry)
+
+    def update(self, mitigation_active: bool) -> Tuple[int, List[str]]:
+        """One post-round tick; returns (next_group_size, events)."""
+        events: List[str] = []
+        if mitigation_active and self.current < self.max_size:
+            self.current = min(self.current * 2, self.max_size)
+            events.append(f"group_size_increased:{self.current}")
+        elif not mitigation_active and self.current > self.base:
+            self.current = max(self.base, self.current // 2)
+            events.append(f"group_size_decreased:{self.current}")
+        self._gauge.set(float(self.current))
+        return self.current, events
 
 
 class EpisodeTimeout(RuntimeError):
@@ -306,6 +357,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                ref_params=None,
                resilience: Optional[ResilienceConfig] = None,
                update_guard=None,
+               health_mitigator=None,
                round_idx: int = 0,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
@@ -326,7 +378,13 @@ def grpo_round(state: TrainState, model_config, mesh,
     resilience.UpdateGuard (UpdateGuard.from_config) and pass it in, so
     the loss-spike baseline accumulates across rounds. ``round_idx``
     tags FailedEpisode records and the chaos harness's injection
-    coordinates."""
+    coordinates.
+
+    ``health_mitigator`` (resilience.HealthMitigator, one per run like
+    the guard) lets persistent training-health triggers reshape the
+    round's EFFECTIVE GRPOConfig (leave-one-out / token-level credit)
+    under streak hysteresis; without one the diagnostics still run and
+    publish, they just never change the objective."""
     import time as _time
 
     if ppo_epochs < 1:
@@ -347,7 +405,8 @@ def grpo_round(state: TrainState, model_config, mesh,
             max_parallel=max_parallel, metrics_service=metrics_service,
             perf_monitor=perf_monitor, engine=engine, lora_base=lora_base,
             ref_params=ref_params, resilience=resilience,
-            update_guard=update_guard, round_idx=round_idx)
+            update_guard=update_guard, health_mitigator=health_mitigator,
+            round_idx=round_idx)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
@@ -356,7 +415,8 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      ppo_epochs=1, metrics_service=None,
                      perf_monitor=None, engine=None,
                      lora_base=None, ref_params=None, resilience=None,
-                     update_guard=None, round_idx=0) -> RoundResult:
+                     update_guard=None, health_mitigator=None,
+                     round_idx=0) -> RoundResult:
     import time as _time
     tracer = get_tracer()
     t0 = _time.monotonic()
@@ -399,14 +459,39 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         # Recorded behavior logps align on the UNPADDED batch (padding
         # appends rows/columns, leaving existing positions fixed).
         old_logp = make_batch_logps(trajectories, tokens, mask)
-        # Advantage diagnostics from the HOST arrays — after placement
-        # the same read would be a device sync inside the build span.
-        from ..obs.telemetry import advantage_stats as _advantage_stats
-        adv_stats = _advantage_stats(rewards, group_ids)
+        # Training-health diagnostics: DISPATCH the jitted head on the
+        # HOST arrays before placement (it computes asynchronously while
+        # the batch is placed); the single device_get happens below,
+        # outside the build span. Group ids are task indices and may be
+        # non-contiguous after group drops — densify for segment ops.
+        import numpy as _np
+        from .diagnostics import (DiagnosticsConfig, dispatch_round_health,
+                                  finalize_round_health)
+        diag_cfg = DiagnosticsConfig.from_grpo(
+            health_mitigator.effective(grpo_config)
+            if health_mitigator is not None else grpo_config)
+        _uniq, _codes = _np.unique(_np.asarray(group_ids),
+                                   return_inverse=True)
+        health_dev = dispatch_round_health(
+            rewards, _codes, mask, num_groups=max(len(_uniq), 1),
+            config=diag_cfg)
         tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
             mesh, tokens, mask, rewards, group_ids, old_logp,
             pad_id=pad_id, accum_steps=accum_steps)
     batch_build_s = _time.monotonic() - t_b
+    # The round's ONE health sync, then the pre-step detector pass; a
+    # persistent trigger streak may reshape this round's objective
+    # (leave-one-out / token-level credit) — every transition or veto
+    # becomes a round event and a labeled counter.
+    from ..obs.training_health import evaluate_health, get_health_monitor
+    health = finalize_round_health(health_dev)
+    health["groups"] = float(len(_uniq))
+    monitor = get_health_monitor()
+    pre_triggers = evaluate_health(health, monitor.config)
+    health_events: List[str] = []
+    if health_mitigator is not None:
+        grpo_config, health_events = health_mitigator.apply(
+            grpo_config, pre_triggers)
     # Multi-epoch (PPO-style) updates need the BEHAVIOR policy's logps
     # frozen across epochs — the clipped ratio is what bounds the drift.
     # Recorded sample-time logps are already exactly that; without them,
@@ -472,6 +557,30 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     if perf_monitor is not None:
         perf_monitor.record_ms("train_step", train_s * 1000.0,
                                epochs=ppo_epochs)
+    # Fold the step's own health signals into the round's dict (finite
+    # values only — a vetoed NaN step is already represented by the
+    # guard veto event and the nonfinite trigger), then run the FULL
+    # detector pass. Post-step-only triggers can't gate this round's
+    # objective — they seed the mitigator's next-round streaks.
+    import math as _math
+    for src, dst in (("grad_sparsity", "grad_sparsity"),
+                     ("entropy", "policy_entropy"),
+                     ("kl", "kl_to_anchor")):
+        v = out_metrics.get(src)
+        if v is not None and _math.isfinite(v):
+            health[dst] = float(v)
+    health_triggers = evaluate_health(health, monitor.config)
+    if health_mitigator is not None:
+        health_mitigator.note_post_step(
+            [t for t in health_triggers if t not in pre_triggers])
+    if update_skipped is not None:
+        health_events.append(f"update_skipped:{update_skipped}")
+    adv_stats = {
+        "zero_advantage_group_fraction":
+            health.get("zero_advantage_group_fraction", 0.0),
+        "advantage_std": health.get("advantage_std", 0.0),
+        "groups": int(health.get("groups", 0.0)),
+    }
     # Round telemetry (tokens/sec, step-time breakdown, analytic MFU):
     # always-on — a handful of registry writes per round keeps the
     # dashboard's obs tile and /metrics live without span tracing.
@@ -484,7 +593,9 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         completion_tokens=sum(len(t.completion_ids)
                               for t in trajectories),
         episodes=len(episodes), trajectories=len(trajectories),
-        ppo_epochs=ppo_epochs, advantage_stats=adv_stats)
+        ppo_epochs=ppo_epochs, advantage_stats=adv_stats,
+        health=health, health_triggers=health_triggers,
+        health_events=health_events, round_index=round_idx)
     if metrics_service is not None:
         ep_rewards = [e.reward for e in episodes]
         # Engine serving counters (reuse efficiency) belong in the round
@@ -506,11 +617,15 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             "reward_min": min(ep_rewards), "reward_max": max(ep_rewards),
             "collect_s": round(collect_s, 3),
             "train_s": round(train_s, 3),
-            **{k: round(float(v), 3) for k, v in telemetry_out.items()},
+            "health_triggers": ",".join(health_triggers),
+            "health_events": ",".join(health_events),
+            **{k: round(float(v), 3) for k, v in telemetry_out.items()
+               if isinstance(v, (int, float))},
             **{k: round(v, 6) for k, v in out_metrics.items()},
         })
     return RoundResult(
         state=state, metrics=out_metrics,
         episodes=episodes, trajectories=trajectories,
         failures=failures, dropped_groups=dropped_groups,
-        update_skipped=update_skipped)
+        update_skipped=update_skipped, health=health,
+        health_triggers=health_triggers, health_events=health_events)

@@ -178,7 +178,8 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     adv = group_relative_advantages(
         rewards, group_ids, num_groups,
         normalize_std=grpo_config.normalize_std,
-        min_std=grpo_config.min_group_std)
+        min_std=grpo_config.min_group_std,
+        leave_one_out=grpo_config.leave_one_out)
 
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     tgt_mask = completion_mask[:, 1:]
@@ -222,7 +223,8 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     # Same metrics schema as the monolithic step: every per-token-
     # normalized metric weight-sums across microbatches exactly like the
     # loss does.
-    acc_keys = ("pg_loss", "kl", "entropy", "ratio_mean", "clip_frac")
+    acc_keys = ("pg_loss", "kl", "entropy", "ratio_mean", "clip_frac",
+                "grad_sparsity")
 
     def body(carry, m):
         grads_acc, loss_acc, metr_acc = carry
