@@ -567,6 +567,63 @@ def _measure_prefix_fleet(*, n_replicas: int = 4, prefix_len: int = 48,
     }
 
 
+def _measure_paged_vs_slots(*, num_slots: int = 4, prompt_len: int = 16,
+                            decode_tokens: int = 48) -> dict:
+    """Paged (block-table) decode vs the contiguous slot cache at equal
+    batch (EngineConfig.kv_layout). Greedy, identical prompts; both
+    layouts warm their jit caches first, then one timed run() each. The
+    acceptance signal is paged_over_slots >= 1.0 — the indirection must
+    not tax steady-state decode — plus the allocator counters proving
+    the paged run stayed graft/alloc-exact."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompts = [[(i * 7 + j) % 200 + 2 for j in range(prompt_len)]
+               for i in range(num_slots)]
+
+    def run(layout: str) -> dict:
+        obs._reset_for_tests()
+        eng = RolloutEngine(
+            params, config, num_slots=num_slots, max_len=128,
+            sample=greedy, engine_config=EngineConfig(kv_layout=layout))
+        rids = [eng.submit(p, max_new_tokens=decode_tokens)
+                for p in prompts]
+        t0 = _time.perf_counter()
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        return {"tok_s": sum(len(out[r]) for r in rids) / dt,
+                "tokens": [out[r] for r in rids],
+                "stats": eng.stats()}
+
+    run("slots")            # compile warmup, both layouts
+    run("paged")
+    slots = run("slots")
+    paged = run("paged")
+    obs._reset_for_tests()
+    exact = paged["tokens"] == slots["tokens"]
+    return {
+        "num_slots": num_slots,
+        "decode_tokens": decode_tokens,
+        "slots_tok_s": round(slots["tok_s"], 1),
+        "paged_tok_s": round(paged["tok_s"], 1),
+        "paged_over_slots": round(
+            paged["tok_s"] / max(1e-9, slots["tok_s"]), 3),
+        "outputs_exact": exact,
+        "kv_preemptions": paged["stats"].get("kv_preemptions", 0),
+        "kv_blocks_total": paged["stats"].get("kv_blocks_total", 0),
+    }
+
+
 def _measure_fleet_remote(*, n_replicas: int = 4,
                           n_requests: int = 8) -> dict:
     """Cross-host dispatch economics: a loopback remote fleet
@@ -876,6 +933,15 @@ def main() -> None:
         extra["prefix_fleet"] = _measure_prefix_fleet()
     except Exception as e:
         extra["prefix_fleet"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Paged KV layout vs the contiguous slot cache at equal batch
+    # (rollout/paged_kv.py). Layout-level, so tiny-test covers it on
+    # every backend.
+    try:
+        _log("paged layout measure: paged_vs_slots")
+        extra["paged_vs_slots"] = _measure_paged_vs_slots()
+    except Exception as e:
+        extra["paged_vs_slots"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Cross-host dispatch economics (loopback remote fleet vs the same
     # engines in-process) plus held-slot continuation replay latency.

@@ -77,6 +77,7 @@ RULES: Dict[str, str] = {
 # Host modules whose decode/step drivers get the JIT110 sync budget.
 HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/rollout/engine.py",
+    "senweaver_ide_tpu/rollout/paged_kv.py",
     "senweaver_ide_tpu/rollout/sampler.py",
     "senweaver_ide_tpu/rollout/speculative.py",
     "senweaver_ide_tpu/serve/replica.py",

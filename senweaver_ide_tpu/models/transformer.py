@@ -385,7 +385,14 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
         kv_out = (k, v)
 
     x = x + _dense(out.reshape(b, s, c.q_dim), lp, "wo", "bse,ed->bsd")
+    x, aux = _mlp(c, lp, x)
+    return x, kv_out, aux
 
+
+def _mlp(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array):
+    """Post-attention FFN block (dense silu-gate or MoE), shared by the
+    contiguous-cache and paged layer bodies. Returns
+    (x + ffn(norm(x)), moe aux loss — 0 for dense layers)."""
     h = rms_norm(x, lp["mlp_norm"], c.rms_norm_eps)
     if c.num_experts > 0:
         from ..parallel.expert import MoEConfig, moe_ffn
@@ -401,11 +408,11 @@ def _layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
             if _n in lp:       # int8 expert banks (models/quantize.py)
                 moe_params[_n] = lp[_n]
         ffn_out, aux = moe_ffn(moe_params, moe_cfg, h)
-        return x + ffn_out, kv_out, aux
+        return x + ffn_out, aux
     gate = _dense(h, lp, "w_gate", "bsd,df->bsf")
     up = _dense(h, lp, "w_up", "bsd,df->bsf")
     act = jax.nn.silu(gate.astype(jnp.float32)).astype(x.dtype) * up
-    return (x + _dense(act, lp, "w_down", "bsf,fd->bsd"), kv_out,
+    return (x + _dense(act, lp, "w_down", "bsf,fd->bsd"),
             jnp.zeros((), jnp.float32))
 
 
@@ -628,6 +635,127 @@ def _forward_impl(params, c, tokens, *, cache, positions, attn_mask,
     else:
         logits = _dense(x, params, "lm_head", "bsd,dv->bsv")
     return logits.astype(jnp.float32), new_cache, aux_total
+
+
+def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
+                 cos: jax.Array, sin: jax.Array,
+                 k_pool: jax.Array, v_pool: jax.Array,
+                 tables: jax.Array, seq_row: jax.Array,
+                 positions: jax.Array, write_block: jax.Array,
+                 write_off: jax.Array, use_kernel: bool = False):
+    """One transformer block over a paged KV pool (rollout/paged_kv.py).
+
+    ``x`` is a flat token batch ``(T, 1, D)`` — T independent
+    (sequence, position) pairs, decode steps and chunked-prefill
+    segments mixed freely. This layer's pool is
+    ``k_pool``/``v_pool`` ``(num_blocks, block_size, Hkv, Dh)``; each
+    token first scatters its new k/v at
+    ``(write_block[t], write_off[t])`` (``write_block == num_blocks``
+    drops the write — padding and rescore entries), then attends over
+    its own sequence through the block-table indirection
+    ``tables[seq_row[t]]``. The scatter lands before the gather, so a
+    chunk's later tokens see its earlier ones at the same layer —
+    flat-batch chunked prefill is exactly block prefill.
+
+    The gathered view is a contiguous ``(T, MB*BS, Hkv, Dh)`` cache
+    per token, attended with the SAME mask and attention call as the
+    slot path (`kv_pos < pos+1`, causal with per-row ``q_offset``), so
+    paged and slot decode agree to numerical identity of the masking
+    and matmul shapes' element-wise dot products.
+    """
+    t = x.shape[0]
+    h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
+    q, k, v = _qkv(c, lp, h, cos, sin)   # q (T,1,Hq,Dh), k/v (T,1,Hkv,Dh)
+    k_pool = k_pool.at[write_block, write_off].set(
+        k[:, 0].astype(k_pool.dtype), mode="drop")
+    v_pool = v_pool.at[write_block, write_off].set(
+        v[:, 0].astype(v_pool.dtype), mode="drop")
+    if use_kernel:
+        from ..ops.paged_attention import paged_flash_decode
+        out = paged_flash_decode(q[:, 0], k_pool, v_pool,
+                                 tables[seq_row], positions + 1)[:, None]
+    else:
+        nb, bs, hkv, dh = k_pool.shape
+        tbl = tables[seq_row]                              # (T, MB)
+        mb = tbl.shape[1]
+        k_seq = k_pool[tbl].reshape(t, mb * bs, hkv, dh)
+        v_seq = v_pool[tbl].reshape(t, mb * bs, hkv, dh)
+        kv_pos = jnp.arange(mb * bs)[None, :]
+        valid = kv_pos < positions[:, None] + 1
+        out = attention(q, k_seq.astype(x.dtype), v_seq.astype(x.dtype),
+                        q_offset=positions, kv_mask=valid, causal=True)
+    x = x + _dense(out.reshape(t, 1, c.q_dim), lp, "wo", "bse,ed->bsd")
+    x, aux = _mlp(c, lp, x)
+    return x, (k_pool, v_pool), aux
+
+
+def forward_paged(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,            # (T,) int32 — flat token batch
+    *,
+    pool_k: jax.Array,            # (L, num_blocks, block_size, Hkv, Dh)
+    pool_v: jax.Array,
+    tables: jax.Array,            # (R, MB) int32 — physical block per
+                                  # (row, logical block)
+    seq_row: jax.Array,           # (T,) int32 — table row per token
+    positions: jax.Array,         # (T,) int32 — absolute position
+    write_block: jax.Array,       # (T,) int32 — pool block to write
+                                  # (num_blocks = drop)
+    write_off: jax.Array,         # (T,) int32 — offset within block
+    use_kernel: bool = False,     # static: Pallas paged-decode kernel
+):
+    """Run the model over a paged KV pool: every entry of the flat
+    ``(T,)`` token batch is one (sequence, position) pair — a decode
+    step or one token of a chunked-prefill segment — reading KV through
+    the ``(row, logical_block) -> physical_block`` table. Returns
+    ``(logits (T, V) fp32, pool_k', pool_v')``. Token t's logits
+    predict its next token, so the engine samples from the rows it
+    flagged (decode entries and final prompt tokens) and ignores the
+    rest."""
+    c = config
+    if c.matmul_precision is not None:
+        with jax.default_matmul_precision(c.matmul_precision):
+            return _forward_paged_impl(
+                params, c, tokens, pool_k=pool_k, pool_v=pool_v,
+                tables=tables, seq_row=seq_row, positions=positions,
+                write_block=write_block, write_off=write_off,
+                use_kernel=use_kernel)
+    return _forward_paged_impl(
+        params, c, tokens, pool_k=pool_k, pool_v=pool_v, tables=tables,
+        seq_row=seq_row, positions=positions, write_block=write_block,
+        write_off=write_off, use_kernel=use_kernel)
+
+
+def _forward_paged_impl(params, c, tokens, *, pool_k, pool_v, tables,
+                        seq_row, positions, write_block, write_off,
+                        use_kernel):
+    x = params["embed"][tokens][:, None, :]            # (T, 1, D)
+    cos, sin = rope_cos_sin(positions[:, None], c.head_dim, c.rope_theta,
+                            scaling=c.rope_scaling)
+
+    def body(carry, inputs):
+        x, aux = carry
+        lp, k_l, v_l = inputs
+        x, (k_l, v_l), layer_aux = _paged_layer(
+            c, lp, x, cos, sin, k_l, v_l, tables, seq_row, positions,
+            write_block, write_off, use_kernel=use_kernel)
+        return (x, aux + layer_aux), (k_l, v_l)
+
+    (x, _aux), (k_upd, v_upd) = jax.lax.scan(
+        body, (x, jnp.zeros((), jnp.float32)),
+        (params["layers"], pool_k, pool_v), unroll=c.scan_unroll)
+
+    x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:  # tied embeddings
+        if "tied_head_q8" in params:
+            logits = _dense(x, params, "tied_head_q8", "bsd,vd->bsv")
+        else:
+            logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
+    else:
+        logits = _dense(x, params, "lm_head", "bsd,dv->bsv")
+    return logits[:, 0].astype(jnp.float32), k_upd, v_upd
 
 
 def count_params(params: Params) -> int:

@@ -1,0 +1,174 @@
+"""Paged flash-decode: Pallas TPU kernel reading KV through block tables.
+
+The paged engine (rollout/paged_kv.py) stores KV in a fixed pool of
+``(block_size, Hkv, D)`` blocks; each token's sequence is a list of
+physical block ids. The XLA gather path
+(``models.transformer._paged_layer``) materializes a contiguous
+``(T, MB*BS, Hkv, D)`` copy of every token's blocks in HBM each step;
+this kernel instead DMAs each block straight from the pool into VMEM
+using the **scalar-prefetched block table in the BlockSpec index maps**
+— the `(token, logical_block) -> physical_block` translation happens at
+DMA-issue time, so per-step HBM traffic is one streamed read of the
+referenced blocks and no gathered intermediate.
+
+Everything else is ``ops/flash_decode.py``: online-softmax scratch
+(acc/m/l in VMEM), the GQA ``(kv_head, group)`` sublane layout, block
+skipping past each token's fill level, interpret mode off-TPU.
+
+``lengths[t]`` counts valid positions including the freshly-written
+current token (write-then-attend, same contract as flash_decode).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .attention import MASKED_THRESHOLD as _MASKED
+from .attention import NEG_INF
+
+# Version shim shared with the other Pallas kernels: JAX 0.4.37 spells
+# the compiler params ``TPUCompilerParams``; later ``CompilerParams``.
+_TPUCompilerParams = getattr(pltpu, "TPUCompilerParams", None) \
+    or getattr(pltpu, "CompilerParams")
+
+
+def _pfd_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, out_ref,
+                acc_ref, m_ref, l_ref, *, scale: float, block_size: int,
+                hkv: int, rep_pad: int):
+    """One (token, logical block) program. The K/V refs already hold the
+    PHYSICAL block — the index maps resolved ``tables_ref`` before the
+    DMA — so the body only needs the logical position ``bi * block_size``
+    for masking. KV heads loop inside (Mosaic tiling: the head axis must
+    stay whole in the block specs for Hkv < 8)."""
+    ti = pl.program_id(0)
+    bi = pl.program_id(1)
+    n_blk = pl.num_programs(1)
+
+    @pl.when(bi == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[ti]
+    k_start = bi * block_size
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale   # (hkv*rep_pad, D)
+        s_heads = []
+        for h in range(hkv):
+            qh = q[h * rep_pad:(h + 1) * rep_pad]            # (rep_pad, D)
+            kh = k_ref[0, :, h, :].astype(jnp.float32)       # (BS, D)
+            s_heads.append(jax.lax.dot_general(
+                qh, kh, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))         # (rep_pad, BS)
+        s = jnp.concatenate(s_heads, axis=0)       # (hkv*rep_pad, BS)
+        rows = s.shape[0]
+        pos = k_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                 (rows, block_size), 1)
+        s = jnp.where(pos < length, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.where(s > _MASKED, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:] = corr * l_ref[:] + jnp.sum(p, axis=-1, keepdims=True)
+        pv_heads = []
+        for h in range(hkv):
+            ph = p[h * rep_pad:(h + 1) * rep_pad]
+            vh = v_ref[0, :, h, :].astype(jnp.float32)       # (BS, D)
+            pv_heads.append(jax.lax.dot_general(
+                ph, vh, dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))         # (rep_pad, D)
+        acc_ref[:] = corr * acc_ref[:] + jnp.concatenate(pv_heads, axis=0)
+        m_ref[:] = m_new
+
+    # Logical blocks wholly past this token's fill level are dead table
+    # padding — skip the matmuls entirely.
+    pl.when(k_start < length)(_compute)
+
+    @pl.when(bi == n_blk - 1)
+    def _finalize():
+        l = l_ref[:]
+        safe_l = jnp.where(l > 0.0, l, 1.0)
+        out_ref[0] = (acc_ref[:] / safe_l).astype(out_ref.dtype)
+
+
+def paged_flash_decode(
+    q: jax.Array,              # (T, Hq, D) — one query per token entry
+    k_pool: jax.Array,         # (NB, BS, Hkv, D) — one layer's block pool
+    v_pool: jax.Array,         # (NB, BS, Hkv, D)
+    tables: jax.Array,         # (T, MB) int32 — physical block per
+                               # (token, logical block); dead entries
+                               # may hold any in-range id
+    lengths: jax.Array,        # (T,) int32 — valid positions incl. new
+    *,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Block-table cache attention for the flat paged token batch.
+    Returns (T, Hq, D). The KV block size IS the kernel block size —
+    the pool was allocated block-aligned, so there is never a pad-copy
+    path here (the flash_decode ``Smax % block_kv`` failure mode cannot
+    arise by construction)."""
+    t, hq, d = q.shape
+    nb, bs, hkv, _ = k_pool.shape
+    mb = tables.shape[1]
+    rep = hq // hkv
+    rep_pad = max(8, -(-rep // 8) * 8)
+    lengths = jnp.broadcast_to(jnp.asarray(lengths, jnp.int32), (t,))
+    tables = jnp.asarray(tables, jnp.int32)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    # (T, Hq, D) → (T, Hkv*rep_pad, D): flattened (kv-head, group) pairs
+    # on the sublane axis, same layout as flash_decode.
+    qg = q.reshape(t, hkv, rep, d)
+    if rep_pad != rep:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, rep_pad - rep), (0, 0)))
+    qg = qg.reshape(t, hkv * rep_pad, d)
+
+    kernel = functools.partial(_pfd_kernel, scale=1.0 / (d ** 0.5),
+                               block_size=bs, hkv=hkv, rep_pad=rep_pad)
+    rows = hkv * rep_pad
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # tables, lengths
+        grid=(t, mb),
+        in_specs=[
+            pl.BlockSpec((1, rows, d),
+                         lambda ti, bi, tbl, lens: (ti, 0, 0)),
+            # The paged trick: the physical block id comes from the
+            # scalar-prefetched table at DMA-issue time. Full head axis
+            # per block (Mosaic last-two-dims tiling rule).
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda ti, bi, tbl, lens: (tbl[ti, bi], 0, 0, 0)),
+            pl.BlockSpec((1, bs, hkv, d),
+                         lambda ti, bi, tbl, lens: (tbl[ti, bi], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rows, d),
+                               lambda ti, bi, tbl, lens: (ti, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rows, d), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+            pltpu.VMEM((rows, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t, rows, d), q.dtype),
+        compiler_params=_TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * t * hq * mb * bs * d,
+            bytes_accessed=2 * t * mb * bs * hkv * d * k_pool.dtype.itemsize,
+            transcendentals=t * hq * mb * bs),
+        interpret=interpret,
+    )(tables, lengths, qg, k_pool, v_pool)
+
+    return out.reshape(t, hkv, rep_pad, d)[:, :, :rep, :].reshape(t, hq, d)

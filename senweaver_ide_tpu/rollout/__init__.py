@@ -1,6 +1,8 @@
 from .checkpoints import (CheckpointEntry, ConversationCheckpoints,
                           FileSnapshotter)
-from .engine import QueueFull, RolloutEngine
+from .engine import EngineConfig, PrefixImportError, QueueFull, RolloutEngine
+from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
+                       PagedSeqKV, init_paged_pool)
 from .policy_client import EnginePolicyClient, render_chat_template
 from .sampler import (SampleParams, decode_step, generate, generate_scan,
                       prefill_chunked,

@@ -34,9 +34,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
-from ..models.transformer import KVCache, Params, forward, init_kv_cache
+from ..models.transformer import (KVCache, Params, forward, forward_paged,
+                                  init_kv_cache)
 from ..obs import get_registry, get_tracer
 from ..ops.sampling import sample_token, sampled_logprob
+from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
+                       copy_blocks, gather_blocks, init_paged_pool,
+                       install_blocks)
 from .sampler import SampleParams
 
 
@@ -225,6 +229,92 @@ def _pool_decode_step(params: Params, config: ModelConfig, cur_tok: jax.Array,
                                    v_scale=new_cache.v_scale)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("config", "sample", "use_kernel"),
+                   donate_argnames=("pool_k", "pool_v"))
+def _paged_fused_step(params: Params, config: ModelConfig,
+                      tokens: jax.Array, tables: jax.Array,
+                      seq_row: jax.Array, positions: jax.Array,
+                      write_block: jax.Array, write_off: jax.Array,
+                      pool_k: jax.Array, pool_v: jax.Array,
+                      key: jax.Array, sample: SampleParams,
+                      use_kernel: bool):
+    """One fused paged step over a flat token batch: decode rows and
+    exact-size chunked-prefill segments share the same forward under a
+    static token budget (``tokens.shape[0]``). Each entry writes its
+    k/v through ``(write_block, write_off)`` — padding/rescore entries
+    address the out-of-range sentinel block and are dropped by the
+    scatter. Sampling happens in-jit for EVERY row; the host keeps only
+    the rows it marked as samplers (decode rows, the final token of a
+    completing prefill), so ONE batched device_get per step covers
+    first tokens and decode tokens alike."""
+    logits, pool_k, pool_v = forward_paged(
+        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+        tables=tables, seq_row=seq_row, positions=positions,
+        write_block=write_block, write_off=write_off,
+        use_kernel=use_kernel)
+    next_tok = sample_token(logits, key, temperature=sample.temperature,
+                            top_k=sample.top_k, top_p=sample.top_p)
+    logp = sampled_logprob(logits, next_tok)
+    return next_tok, logp, pool_k, pool_v
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Engine KV-layout knobs, separate from the model's ModelConfig.
+
+    ``kv_layout="paged"`` (default) serves from a fixed block pool
+    (rollout/paged_kv.py): block-table attention, graft-based shared
+    prefixes (refcount bump instead of HBM copy), and token-level
+    chunked prefill interleaved with decode in one fused step.
+    ``"slots"`` is the legacy contiguous per-slot cache. Paged silently
+    falls back to slots where the block pool has no equivalent yet —
+    int8 KV (``kv_quant``), sliding-window ring caches, TP-sharded
+    meshes — ``engine.kv_layout`` reports the effective layout and
+    ``engine.kv_layout_fallback`` the reason."""
+
+    kv_layout: str = "paged"
+    # tokens per KV block; the partial last block of each sequence is
+    # the only internal fragmentation (senweaver_kv_fragmentation)
+    block_size: int = 16
+    # pool capacity in blocks; None = (num_slots + 4) rows' worth —
+    # slot-cache parity plus headroom for shared prefixes, which live
+    # in the same pool here instead of separate slot-shaped buffers
+    num_blocks: Optional[int] = None
+    # per-step token budget for the fused decode+prefill batch; None =
+    # max(4 * num_slots, 64). Decode rows are always admitted (the
+    # budget cannot starve resident requests); the remainder fills
+    # with exact-size prefill segments.
+    step_tokens: Optional[int] = None
+    # None = auto: use the Pallas paged-attention kernel on TPU when
+    # the model already opted into flash decode; True/False forces.
+    paged_kernel: Optional[bool] = None
+
+
+@dataclasses.dataclass
+class _PrefillJob:
+    """Host cursor for one request's token-level chunked prefill. The
+    step assembler feeds ``toks`` into fused steps in exact-size
+    segments; ``pos`` is the absolute position of ``toks[0]``."""
+
+    toks: List[int]
+    pos: int
+    # sample the request's first output from the LAST fed token's row
+    sample_last: bool
+    # rescore-only job: the positions already hold this k/v (imported
+    # prefix without donor logits) — writes are dropped so a SHARED
+    # boundary block is not COW-split just to recompute logits
+    drop_writes: bool = False
+    # when not sampling (preemption resume), restore this token as the
+    # row's decode cursor instead of emitting anything
+    after_tok: Optional[int] = None
+
+
+class _RowPreempted(Exception):
+    """Internal: the row being assembled lost its blocks to
+    reclamation and was requeued — skip it for this step."""
+
+
 @dataclasses.dataclass
 class _Request:
     rid: int
@@ -255,7 +345,8 @@ class RolloutEngine:
                  sample: SampleParams = SampleParams(),
                  eos_id: Optional[int] = None, seed: int = 0,
                  mesh=None, max_prefixes: int = 8,
-                 max_queue: Optional[int] = None):
+                 max_queue: Optional[int] = None,
+                 engine_config: Optional[EngineConfig] = None):
         self.config = config
         self.num_slots = num_slots
         # Sliding-window configs serve from a ring cache: the pool holds
@@ -283,34 +374,75 @@ class RolloutEngine:
         self.mesh = mesh
         self.params = self._place_params(params)
         self._key = jax.random.PRNGKey(seed)
-        shape = (config.num_layers, num_slots, max_len, config.num_kv_heads,
-                 config.head_dim)
-        quantized = config.kv_quant
-        k0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
-        v0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
-        ks0 = vs0 = None
-        if quantized:
-            ks0 = jnp.zeros(shape[:-1], jnp.float32)
-            vs0 = jnp.zeros(shape[:-1], jnp.float32)
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-            from ..parallel.sharding import KV_CACHE_SPEC, restrict_spec
-            cache_sharding = NamedSharding(mesh,
-                                           restrict_spec(KV_CACHE_SPEC,
-                                                         mesh))
-            k0 = jax.device_put(k0, cache_sharding)
-            v0 = jax.device_put(v0, cache_sharding)
+        # KV layout: paged block pool by default; the layouts the pool
+        # has no equivalent for yet fall back to the slot cache.
+        self.engine_config = engine_config or EngineConfig()
+        requested = self.engine_config.kv_layout
+        if requested not in ("paged", "slots"):
+            raise ValueError(f"unknown kv_layout {requested!r}")
+        fallback = None
+        if requested == "paged":
+            if config.kv_quant:
+                fallback = "kv_quant int8 cache"
+            elif self._ring:
+                fallback = "sliding-window ring cache"
+            elif mesh is not None:
+                fallback = "tensor-parallel KV sharding"
+        self.kv_layout = ("slots" if requested == "slots" or fallback
+                          else "paged")
+        self.kv_layout_fallback = fallback
+        if self.kv_layout == "slots":
+            shape = (config.num_layers, num_slots, max_len,
+                     config.num_kv_heads, config.head_dim)
+            quantized = config.kv_quant
+            k0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
+            v0 = jnp.zeros(shape, jnp.int8 if quantized else config.dtype)
+            ks0 = vs0 = None
             if quantized:
-                # scales lack the head_dim axis; same layout otherwise
-                scale_spec = PartitionSpec(*KV_CACHE_SPEC[:-1])
-                scale_sharding = NamedSharding(
-                    mesh, restrict_spec(scale_spec, mesh))
-                ks0 = jax.device_put(ks0, scale_sharding)
-                vs0 = jax.device_put(vs0, scale_sharding)
-        self.cache = KVCache(k=k0, v=v0,
-                             length=jnp.zeros((num_slots,), jnp.int32),
-                             k_scale=ks0, v_scale=vs0)
-        self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
+                ks0 = jnp.zeros(shape[:-1], jnp.float32)
+                vs0 = jnp.zeros(shape[:-1], jnp.float32)
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                from ..parallel.sharding import KV_CACHE_SPEC, restrict_spec
+                cache_sharding = NamedSharding(mesh,
+                                               restrict_spec(KV_CACHE_SPEC,
+                                                             mesh))
+                k0 = jax.device_put(k0, cache_sharding)
+                v0 = jax.device_put(v0, cache_sharding)
+                if quantized:
+                    # scales lack the head_dim axis; same layout otherwise
+                    scale_spec = PartitionSpec(*KV_CACHE_SPEC[:-1])
+                    scale_sharding = NamedSharding(
+                        mesh, restrict_spec(scale_spec, mesh))
+                    ks0 = jax.device_put(ks0, scale_sharding)
+                    vs0 = jax.device_put(vs0, scale_sharding)
+            self.cache = KVCache(k=k0, v=v0,
+                                 length=jnp.zeros((num_slots,), jnp.int32),
+                                 k_scale=ks0, v_scale=vs0)
+            self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
+        else:
+            bs = max(1, int(self.engine_config.block_size))
+            self._blocks_per_row = -(-max_len // bs)
+            nb = self.engine_config.num_blocks
+            if nb is None:
+                nb = (num_slots + 4) * self._blocks_per_row
+            self._alloc = BlockAllocator(nb, bs, registry=get_registry())
+            self.pool = init_paged_pool(config, nb, bs)
+            self.cache = None
+            self.cur_tok = None
+            # host-side block table + fill level + decode cursor per row
+            self._tables: List[List[int]] = [[] for _ in range(num_slots)]  # guarded-by: _lock
+            self._row_len: List[int] = [0] * num_slots  # guarded-by: _lock
+            self._cur_tok_host: List[int] = [0] * num_slots  # guarded-by: _lock
+            self._prefill_jobs: Dict[int, _PrefillJob] = {}  # guarded-by: _lock
+            st = self.engine_config.step_tokens
+            self._step_tokens = max(
+                num_slots, int(st) if st else max(4 * num_slots, 64))
+            pk = self.engine_config.paged_kernel
+            if pk is None:
+                pk = (config.decode_attn_impl == "flash"
+                      and jax.devices()[0].platform == "tpu")
+            self._use_paged_kernel = bool(pk)
         self._slot_req: List[Optional[_Request]] = [None] * num_slots  # guarded-by: _lock
         # rid holding each slot's KV across turns (hold_slot), or None
         self._slot_held: List[Optional[int]] = [None] * num_slots  # guarded-by: _lock
@@ -328,7 +460,7 @@ class RolloutEngine:
                        "prefix_cache_hits": 0, "prefix_cache_misses": 0,
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
-                       "hold_evictions": 0}
+                       "hold_evictions": 0, "kv_preemptions": 0}
         # Bounded admission (None = legacy unbounded): submit() raises
         # QueueFull past this many QUEUED requests — in-flight slots and
         # continuations (which bypass the queue) don't count.
@@ -382,9 +514,10 @@ class RolloutEngine:
             params = quantize_weights_int8(params)
         with self._lock:
             self.params = self._place_params(params)
-            self._prefixes.clear()
-            self._prefix_by_tokens.clear()
-            self._prefix_last_use.clear()
+            # release_prefix (not .clear()) so the paged layout also
+            # drops the prefixes' block refcounts back to the pool.
+            for pid in list(self._prefixes):
+                self.release_prefix(pid)
             # Held conversation KV is old-policy state for the same
             # reason: continuations after a sync must re-prefill.
             for slot in range(self.num_slots):
@@ -467,6 +600,8 @@ class RolloutEngine:
 
     def _step(self) -> Dict[int, List[int]]:
         # guarded-by: caller
+        if self.kv_layout == "paged":
+            return self._step_paged()
         self._schedule()
         emitted = self._pending_emits
         self._pending_emits = {}
@@ -529,6 +664,12 @@ class RolloutEngine:
             out["queue_depth"] = len(self._queue)
             out["slots_active"] = sum(r is not None
                                       for r in self._slot_req)
+            out["kv_paged"] = int(self.kv_layout == "paged")
+            if self.kv_layout == "paged":
+                for name, val in self._alloc.counters().items():
+                    out[f"kv_{name}"] = val
+                out["kv_blocks_total"] = self._alloc.num_blocks
+                out["kv_blocks_free"] = self._alloc.free_blocks
             return out
 
     @property
@@ -594,6 +735,17 @@ class RolloutEngine:
         self._requests[rid] = req
         self._slot_held[slot] = None
         self._slot_req[slot] = req
+        if self.kv_layout == "paged":
+            # The held row's blocks stay resident (row_len ==
+            # len(history)); the delta becomes a chunked-prefill job
+            # fed by the next fused steps. A boundary block the
+            # original turn shared with a prefix COW-splits on the
+            # delta's first write, not here.
+            self._prefill_jobs[rid] = _PrefillJob(
+                toks=list(delta), pos=len(history), sample_last=True)
+            self._stats["continuations"] += 1
+            self._stats["continuation_delta_tokens"] += len(delta)
+            return rid
         slot_arr = jnp.asarray(slot, jnp.int32)
         with get_tracer().span("engine.prefill_continuation", slot=slot,
                                delta_tokens=len(delta)):
@@ -663,8 +815,21 @@ class RolloutEngine:
                 pos += size
             pid = self._next_prefix_id
             self._next_prefix_id += 1
-            # the B=1 cache IS the pool's slot layout (L, 1, cap, ...)
-            self._prefixes[pid] = (list(tokens), sub,
+            if self.kv_layout == "paged":
+                # Paged prefixes live in the shared pool: scatter the
+                # freshly-prefilled buffer into dedicated blocks once;
+                # every consumer then grafts the table (refcount bump,
+                # zero bytes) instead of HBM-copying a slot buffer.
+                nblk = self._alloc.blocks_for(len(tokens))
+                blocks = self._alloc_blocks_evicting(nblk)
+                k_buf, v_buf = self._blockify(sub, nblk)
+                self.pool = install_blocks(self.pool, k_buf, v_buf,
+                                           jnp.asarray(blocks, jnp.int32))
+                entry = blocks
+            else:
+                # the B=1 cache IS the pool's slot layout (L, 1, cap, ..)
+                entry = sub
+            self._prefixes[pid] = (list(tokens), entry,
                                    jax.device_get(last[0]))
             self._prefix_by_tokens[key] = pid
             self._touch_prefix(pid)
@@ -684,10 +849,15 @@ class RolloutEngine:
         with self._lock:
             if prefix_id not in self._prefixes:
                 raise KeyError(f"unknown prefix_id {prefix_id}")
-            tokens, sub, last = self._prefixes[prefix_id]
+            tokens, entry, last = self._prefixes[prefix_id]
             self._touch_prefix(prefix_id)
             self._stats["prefix_exports"] += 1
-            return list(tokens), sub, last
+            if self.kv_layout == "paged":
+                # The fleet contract speaks contiguous one-slot buffers
+                # (slot engines import them as-is; paged peers
+                # re-blockify): gather the table into that layout.
+                entry = self._export_blocks(tokens, entry)
+            return list(tokens), entry, last
 
     def import_prefix(self, tokens: List[int], kv: KVCache,
                       last_logits=None) -> int:
@@ -718,20 +888,28 @@ class RolloutEngine:
                 pid = self._prefix_by_tokens[key]
                 self._touch_prefix(pid)
                 return pid
-            L, _, cap, hkv, dh = self.cache.k.shape
-            want = (L, 1, cap, hkv, dh)
+            if self.kv_layout == "paged":
+                L = self.pool.k.shape[0]
+                hkv, dh = self.pool.k.shape[3], self.pool.k.shape[4]
+                pool_dtype = self.pool.k.dtype
+                pool_quant = False
+            else:
+                L, _, _, hkv, dh = self.cache.k.shape
+                pool_dtype = self.cache.k.dtype
+                pool_quant = bool(self.cache.quantized)
+            want = (L, 1, self.max_len, hkv, dh)
             if tuple(kv.k.shape) != want or tuple(kv.v.shape) != want:
                 raise PrefixImportError(
                     f"prefix KV shape {tuple(kv.k.shape)}/"
                     f"{tuple(kv.v.shape)} != pool slot layout {want}")
-            if kv.k.dtype != self.cache.k.dtype:
+            if kv.k.dtype != pool_dtype:
                 raise PrefixImportError(
                     f"prefix KV dtype {kv.k.dtype} != pool dtype "
-                    f"{self.cache.k.dtype}")
-            if bool(kv.quantized) != bool(self.cache.quantized):
+                    f"{pool_dtype}")
+            if bool(kv.quantized) != pool_quant:
                 raise PrefixImportError(
                     f"prefix quantization {kv.quantized} != pool "
-                    f"quantization {self.cache.quantized}")
+                    f"quantization {pool_quant}")
             # One batched admission sync: the declared-length check and
             # the first-token logits come over in a single transfer.
             got = jax.device_get(
@@ -748,7 +926,20 @@ class RolloutEngine:
                           key=self._prefix_last_use.get)
                 self.release_prefix(lru)
                 self._stats["prefix_evictions"] += 1
-            if self.mesh is not None:
+            if self.kv_layout == "paged":
+                # The one unavoidable buffer copy of the paged prefix
+                # plane: foreign KV must be scattered into pool blocks
+                # ONCE per import; every request install after that is
+                # a graft. Counted so the fleet test can assert the
+                # zero-copy-per-request property from the counters.
+                nblk = self._alloc.blocks_for(len(tokens))
+                blocks = self._alloc_blocks_evicting(nblk)
+                k_buf, v_buf = self._blockify(kv, nblk)
+                self.pool = install_blocks(self.pool, k_buf, v_buf,
+                                           jnp.asarray(blocks, jnp.int32))
+                self._alloc.count_install_copy(nblk)
+                placed = blocks
+            elif self.mesh is not None:
                 # TP pool: place like any fresh array; jit resharding
                 # handles the KV-spec layout at first install.
                 placed = jax.device_put(kv)
@@ -769,12 +960,18 @@ class RolloutEngine:
         self._prefix_last_use[pid] = self._prefix_use_seq
 
     def release_prefix(self, prefix_id: int) -> None:
-        """Free a registered prefix's KV buffer."""
+        """Free a registered prefix's KV buffer. In the paged layout
+        this drops the prefix's reference on each of its blocks;
+        consumers that grafted the table keep their own references, so
+        an in-flight request survives its donor's eviction (blocks
+        return to the pool only when the LAST table drops them)."""
         with self._lock:
             entry = self._prefixes.pop(prefix_id, None)
             self._prefix_last_use.pop(prefix_id, None)
             if entry is not None:
                 self._prefix_by_tokens.pop(tuple(entry[0]), None)
+                if self.kv_layout == "paged":
+                    self._alloc.release(entry[1])
 
     # -- internals ----------------------------------------------------------
 
@@ -797,7 +994,10 @@ class RolloutEngine:
         req.logps.append(float(logp0_h))
         self._stats["tokens_emitted"] += 1
         self._pending_emits.setdefault(req.rid, []).append(tok0_i)
-        self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
+        if self.kv_layout == "paged":
+            self._cur_tok_host[slot] = tok0_i
+        else:
+            self.cur_tok = self.cur_tok.at[slot].set(tok0_i)
         if ((req.eos_id is not None and tok0_i == req.eos_id)
                 or req.max_new_tokens <= 1):
             self._finish_request(req, slot)
@@ -807,6 +1007,8 @@ class RolloutEngine:
         """Mark a request done and either hold or free its slot."""
         req.done = True
         self._slot_req[slot] = None
+        if self.kv_layout == "paged":
+            self._prefill_jobs.pop(req.rid, None)
         if req.hold_slot:
             # The LAST sampled token's k/v is not yet written (tokens
             # are fed on the step AFTER they are sampled), so the
@@ -818,6 +1020,8 @@ class RolloutEngine:
             self._slot_hold_seq[slot] = self._hold_seq
         else:
             req.slot = None
+            if self.kv_layout == "paged":
+                self._release_row(slot)
 
     def _drop_hold(self, slot: int) -> None:
         # guarded-by: caller
@@ -828,6 +1032,8 @@ class RolloutEngine:
         self._requests[rid].held_history = None
         self._requests[rid].slot = None
         self._slot_held[slot] = None
+        if self.kv_layout == "paged":
+            self._release_row(slot)
 
     def _prefill_chunks(self, slot_arr, tokens: List[int],
                         fresh_first: bool):
@@ -856,6 +1062,8 @@ class RolloutEngine:
         long-prompt chains, and odd-bucket singles take the single-slot
         paths. FIFO order is preserved — batching only groups a
         CONSECUTIVE run of compatible requests."""
+        if self.kv_layout == "paged":
+            return self._schedule_paged()
         if self._queue and all(self._slot_held[s] is not None
                                for s in range(self.num_slots)):
             # Every slot held (none active) with work queued: nothing
@@ -1007,3 +1215,408 @@ class RolloutEngine:
         self._stats["batched_prefill_slots"] += n
         for i, (req, slot) in enumerate(zip(group, slots)):
             self._emit_first_token(req, slot, last[i])
+
+    # -- paged layout (rollout/paged_kv.py block pool) -----------------------
+
+    def _release_row(self, row: int) -> None:
+        # guarded-by: caller
+        """Drop the row's reference on every block of its table."""
+        if self._tables[row]:
+            self._alloc.release(self._tables[row])
+        self._tables[row] = []
+        self._row_len[row] = 0
+
+    def _preempt_row(self, row: int) -> None:
+        # guarded-by: caller
+        """Preemption-by-recomputation (the BlocksExhausted response):
+        release the row's blocks and requeue its request at the FRONT.
+        Rescheduling re-prefills prompt + already-emitted tokens and
+        resumes decode from the last sampled token — the request loses
+        work, never tokens."""
+        req = self._slot_req[row]
+        self._slot_req[row] = None
+        req.slot = None
+        # prefix reuse was already credited once; a resume re-prefills
+        # the full stream rather than double-counting an install
+        req.prefix_id = None
+        self._prefill_jobs.pop(req.rid, None)
+        self._release_row(row)
+        self._queue.appendleft(req)
+        self._stats["kv_preemptions"] += 1
+
+    def _reclaim_blocks(self, row: int, committed,
+                        allow_preempt: bool = True) -> bool:
+        # guarded-by: caller
+        """Free pool capacity, cheapest casualty first: held
+        conversations (pure cache — the continuation re-prefills), then
+        LRU prefixes (consumers keep their grafted references), then
+        the youngest other active request (recompute preemption).
+        Returns False when nothing further can be reclaimed for
+        ``row`` — including after preempting ``row`` itself."""
+        held = [s for s in range(self.num_slots)
+                if self._slot_held[s] is not None]
+        if held:
+            oldest = min(held, key=lambda s: self._slot_hold_seq[s])
+            self._drop_hold(oldest)
+            self._stats["hold_evictions"] += 1
+            return True
+        if self._prefix_last_use:
+            lru = min(self._prefix_last_use,
+                      key=self._prefix_last_use.get)
+            self.release_prefix(lru)
+            self._stats["prefix_evictions"] += 1
+            return True
+        if not allow_preempt:
+            return False
+        victims = [s for s in range(self.num_slots)
+                   if s != row and s not in committed
+                   and self._slot_req[s] is not None]
+        if victims:
+            youngest = max(victims, key=lambda s: self._slot_req[s].rid)
+            self._preempt_row(youngest)
+            return True
+        if row >= 0 and self._slot_req[row] is not None:
+            req = self._slot_req[row]
+            need = self._alloc.blocks_for(
+                len(req.prompt) + len(req.tokens) + 1)
+            if need > self._alloc.num_blocks:
+                # could never fit even with the pool to itself:
+                # truncate-finish instead of requeue-livelock
+                self._finish_request(req, row)
+            else:
+                self._preempt_row(row)
+        return False
+
+    def _ensure_block(self, row: int, pos: int, committed) -> int:
+        # guarded-by: caller
+        """Make position ``pos`` writable in ``row``'s table: append a
+        fresh block at the table boundary, or COW-split a shared block
+        on the first divergent write into it. Reclaims capacity on
+        exhaustion; raises :class:`_RowPreempted` once ``row`` itself
+        had to yield its blocks."""
+        table = self._tables[row]
+        lb = pos // self._alloc.block_size
+        while True:
+            try:
+                if lb == len(table):
+                    table.append(self._alloc.alloc(1)[0])
+                elif lb < len(table):
+                    tgt = self._alloc.cow_target(table[lb])
+                    if tgt is not None:
+                        # the donor's refcount keeps the source block
+                        # alive; ours moved to `tgt` inside cow_target
+                        self.pool = copy_blocks(
+                            self.pool,
+                            jnp.asarray([table[lb]], jnp.int32),
+                            jnp.asarray([tgt], jnp.int32))
+                        table[lb] = tgt
+                else:
+                    raise AssertionError(
+                        f"non-contiguous write: pos {pos} into table "
+                        f"of {len(table)} block(s)")
+                return table[lb]
+            except BlocksExhausted:
+                if not self._reclaim_blocks(row, committed):
+                    raise _RowPreempted(row)
+
+    def _alloc_blocks_evicting(self, n: int) -> List[int]:
+        # guarded-by: caller
+        """Allocate ``n`` blocks for a prefix install, evicting holds
+        and LRU prefixes (never preempting active requests) until the
+        pool can grant them."""
+        while True:
+            try:
+                return self._alloc.alloc(n)
+            except BlocksExhausted:
+                if not self._reclaim_blocks(-1, frozenset(),
+                                            allow_preempt=False):
+                    raise
+
+    def _blockify(self, kv: KVCache, nblk: int):
+        # guarded-by: caller
+        """Reshape a contiguous one-slot buffer (L, 1, cap, Hkv, Dh)
+        into (L, nblk, block_size, Hkv, Dh) for install_blocks."""
+        bs = self._alloc.block_size
+        need = nblk * bs
+        l, _, cap, hkv, dh = kv.k.shape
+        k, v = kv.k[:, 0], kv.v[:, 0]
+        if need > cap:
+            pad = ((0, 0), (0, need - cap), (0, 0), (0, 0))
+            k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+        return (k[:, :need].reshape(l, nblk, bs, hkv, dh),
+                v[:, :need].reshape(l, nblk, bs, hkv, dh))
+
+    def _export_blocks(self, tokens: List[int],
+                       blocks: List[int]) -> KVCache:
+        # guarded-by: caller
+        """Materialize a prefix's block table as the contiguous
+        one-slot buffer the fleet prefix contract speaks."""
+        k, v = gather_blocks(self.pool, jnp.asarray(blocks, jnp.int32))
+        cap = self.max_len
+        if k.shape[1] < cap:
+            pad = ((0, 0), (0, cap - k.shape[1]), (0, 0), (0, 0))
+            k, v = jnp.pad(k, pad), jnp.pad(v, pad)
+        return KVCache(k=k[:, None, :cap], v=v[:, None, :cap],
+                       length=jnp.full((1,), len(tokens), jnp.int32))
+
+    def _tables_device(self) -> jnp.ndarray:
+        # guarded-by: caller
+        """Dense (num_slots, mb) int32 block-table array for the fused
+        step, trimmed to the widest resident table and bucketed to a
+        power of two (a bounded compile ladder, like _chunk_sizes, so
+        at most log2(blocks_per_row) shapes compile). Attention cost
+        then tracks the LONGEST live sequence instead of always paying
+        the full blocks_per_row width; unused entries hold 0 and are
+        never read past each row's fill level (the validity mask in
+        the gather path, the block skip in the kernel)."""
+        widest = max((len(t) for t in self._tables), default=0)
+        mb = 1
+        while mb < widest:
+            mb *= 2
+        mb = min(self._blocks_per_row, mb)
+        arr = np.zeros((self.num_slots, mb), np.int32)
+        for s, tbl in enumerate(self._tables):
+            if tbl:
+                arr[s, :len(tbl)] = tbl
+        # returned as a HOST array on purpose: pjit ingests numpy
+        # directly (one C++ transfer), where a jnp.asarray here would
+        # pay full op-by-op dispatch before the step even launches
+        return arr
+
+    def _schedule_paged(self) -> None:
+        # guarded-by: caller
+        """Paged admission: assign queued requests to free rows and
+        turn their prompts into chunked-prefill jobs. No device work
+        happens here — prefix installs are table grafts, and all
+        prefill compute is interleaved into the fused steps under the
+        step-token budget."""
+        if self._queue and all(self._slot_held[s] is not None
+                               for s in range(self.num_slots)):
+            # same livelock guard as the slot scheduler: all slots held
+            # and work queued — evict the oldest hold
+            oldest = min(range(self.num_slots),
+                         key=lambda s: self._slot_hold_seq[s])
+            self._drop_hold(oldest)
+            self._stats["hold_evictions"] += 1
+        while self._queue:
+            free = self._free_slots()
+            if not free:
+                return
+            req = self._queue[0]
+            if (req.prefix_id is not None
+                    and req.prefix_id not in self._prefixes):
+                req.prefix_id = None
+                self._stats["prefix_cache_misses"] += 1
+            self._queue.popleft()
+            self._schedule_paged_row(req, free[0])
+
+    def _schedule_paged_row(self, req: "_Request", row: int) -> None:
+        # guarded-by: caller
+        req.slot = row
+        self._slot_req[row] = req
+        self._stats["prefills"] += 1
+        if req.tokens:
+            # preemption resume: recompute prompt + everything emitted
+            # except the last token (whose k/v is written when it is
+            # fed), then decode from that token — no re-emission
+            stream = list(req.prompt) + req.tokens[:-1]
+            self._stats["prefill_tokens"] += len(stream)
+            self._prefill_jobs[req.rid] = _PrefillJob(
+                toks=stream, pos=0, sample_last=False,
+                after_tok=req.tokens[-1])
+            return
+        if req.prefix_id is not None:
+            p_tokens, p_blocks, p_last = self._prefixes[req.prefix_id]
+            self._touch_prefix(req.prefix_id)
+            # THE graft: the install is a refcount bump on the prefix's
+            # blocks — zero KV bytes move (vs the slot layout's
+            # _install_prefix HBM copy). Divergence into the shared
+            # boundary block COW-splits at first write.
+            self._tables[row] = self._alloc.fork(p_blocks)
+            self._row_len[row] = len(p_tokens)
+            self._stats["prefix_installs"] += 1
+            self._stats["prefix_cache_hits"] += 1
+            self._stats["prefix_tokens_reused"] += len(p_tokens)
+            suffix = req.prompt[len(p_tokens):]
+            self._stats["prefill_tokens"] += len(suffix)
+            if suffix:
+                self._prefill_jobs[req.rid] = _PrefillJob(
+                    toks=list(suffix), pos=len(p_tokens),
+                    sample_last=True)
+            elif p_last is not None:
+                self._emit_first_token(req, row, jnp.asarray(p_last))
+            else:
+                # imported prefix without donor logits: rescore the
+                # last prefix token in place with writes DROPPED — the
+                # k/v is already resident, and rewriting it would
+                # COW-split a shared boundary block for nothing
+                self._stats["prefill_tokens"] += 1
+                self._prefill_jobs[req.rid] = _PrefillJob(
+                    toks=[req.prompt[-1]], pos=len(p_tokens) - 1,
+                    sample_last=True, drop_writes=True)
+            return
+        self._stats["prefill_tokens"] += len(req.prompt)
+        self._prefill_jobs[req.rid] = _PrefillJob(
+            toks=list(req.prompt), pos=0, sample_last=True)
+
+    def _assemble_paged_plan(self):
+        # guarded-by: caller
+        """Build the flat token batch for one fused step: one decode
+        entry per active row, then exact-size chunked-prefill segments
+        round-robined in row order under the remaining token budget.
+        Returns None when there is nothing to run."""
+        nb = self._alloc.num_blocks
+        bs = self._alloc.block_size
+        toks_l: List[int] = []
+        rows_l: List[int] = []
+        pos_l: List[int] = []
+        wb_l: List[int] = []
+        wo_l: List[int] = []
+        decode_rows = []           # (entry_idx, row, req)
+        job_rows = []              # (row, req, job, n, last_idx, wrote)
+        committed: set = set()
+        for row in range(self.num_slots):
+            req = self._slot_req[row]
+            if req is None or req.rid in self._prefill_jobs:
+                continue
+            p = self._row_len[row]
+            try:
+                wb = self._ensure_block(row, p, committed)
+            except _RowPreempted:
+                continue
+            decode_rows.append((len(toks_l), row, req))
+            toks_l.append(self._cur_tok_host[row])
+            rows_l.append(row)
+            pos_l.append(p)
+            wb_l.append(wb)
+            wo_l.append(p % bs)
+            committed.add(row)
+        budget = self._step_tokens - len(toks_l)
+        for row in range(self.num_slots):
+            req = self._slot_req[row]
+            if req is None or budget <= 0:
+                continue
+            job = self._prefill_jobs.get(req.rid)
+            if job is None:
+                continue
+            n = min(len(job.toks), budget)
+            staged = []
+            try:
+                for j in range(n):
+                    p = job.pos + j
+                    if job.drop_writes:
+                        wb, wo = nb, 0
+                    else:
+                        wb = self._ensure_block(row, p, committed)
+                        wo = p % bs
+                    staged.append((job.toks[j], p, wb, wo))
+            except _RowPreempted:
+                continue
+            base = len(toks_l)
+            for tok, p, wb, wo in staged:
+                toks_l.append(tok)
+                rows_l.append(row)
+                pos_l.append(p)
+                wb_l.append(wb)
+                wo_l.append(wo)
+            wrote = 0 if job.drop_writes else n
+            job_rows.append((row, req, job, n, base + n - 1, wrote))
+            committed.add(row)
+            budget -= n
+        if not toks_l:
+            return None
+        if len(job_rows) >= 2:
+            # several requests' prefill segments shared one forward —
+            # the token-level analogue of _prefill_slots_batched
+            self._stats["batched_prefills"] += 1
+            self._stats["batched_prefill_slots"] += len(job_rows)
+        t = self.num_slots if not job_rows else self._step_tokens
+        while len(toks_l) < t:
+            toks_l.append(0)
+            rows_l.append(0)
+            pos_l.append(0)
+            wb_l.append(nb)      # sentinel block: write dropped
+            wo_l.append(0)
+        return toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, job_rows
+
+    def _step_paged(self) -> Dict[int, List[int]]:
+        # guarded-by: caller
+        self._schedule()
+        emitted = self._pending_emits
+        self._pending_emits = {}
+        plan = self._assemble_paged_plan()
+        if plan is None:
+            return emitted
+        toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, job_rows = plan
+        tracer = get_tracer()
+        n_active = len(decode_rows) + len(job_rows)
+        with tracer.span("engine.decode_step", active=n_active):
+            self._key, step_key = jax.random.split(self._key)
+            # host numpy in, device out: the five plan vectors enter
+            # the jit as numpy (single C++ ingest each); jnp.asarray
+            # here would cost a full dispatch per vector per step —
+            # profiled at ~half the paged step's host time
+            next_tok, logp, pk, pv = _paged_fused_step(
+                self.params, self.config,
+                np.asarray(toks_l, np.int32), self._tables_device(),
+                np.asarray(rows_l, np.int32),
+                np.asarray(pos_l, np.int32),
+                np.asarray(wb_l, np.int32),
+                np.asarray(wo_l, np.int32),
+                self.pool.k, self.pool.v, step_key, self.sample,
+                self._use_paged_kernel)
+            self.pool = PagedKVPool(k=pk, v=pv)
+            self._stats["decode_steps"] += 1
+            # ONE batched device→host transfer per fused step (the
+            # analysis JIT110 budget), covering decode tokens AND the
+            # first tokens of completing prefills.
+            toks, logps = jax.device_get((next_tok, logp))
+        n_emitted = 0
+        for idx, row, req in decode_rows:
+            tok = int(toks[idx])
+            req.tokens.append(tok)
+            req.logps.append(float(logps[idx]))
+            self._stats["tokens_emitted"] += 1
+            n_emitted += 1
+            emitted.setdefault(req.rid, []).append(tok)
+            self._row_len[row] += 1
+            self._cur_tok_host[row] = tok
+            hit_eos = req.eos_id is not None and tok == req.eos_id
+            out_of_budget = len(req.tokens) >= req.max_new_tokens
+            out_of_cache = self._row_len[row] >= self.context_bound - 1
+            if hit_eos or out_of_budget or out_of_cache:
+                self._finish_request(req, row)
+        for row, req, job, n, last_idx, wrote in job_rows:
+            self._row_len[row] += wrote
+            job.toks = job.toks[n:]
+            job.pos += n
+            if job.toks:
+                continue
+            self._prefill_jobs.pop(req.rid, None)
+            if job.sample_last:
+                tok = int(toks[last_idx])
+                req.tokens.append(tok)
+                req.logps.append(float(logps[last_idx]))
+                self._stats["tokens_emitted"] += 1
+                n_emitted += 1
+                emitted.setdefault(req.rid, []).append(tok)
+                self._cur_tok_host[row] = tok
+                if ((req.eos_id is not None and tok == req.eos_id)
+                        or req.max_new_tokens <= 1):
+                    self._finish_request(req, row)
+            else:
+                self._cur_tok_host[row] = job.after_tok
+        if tracer.enabled:
+            reg = get_registry()
+            reg.counter("senweaver_engine_decode_steps_total",
+                        "Pool decode steps executed.").inc()
+            reg.counter("senweaver_engine_tokens_total",
+                        "Tokens emitted by the rollout engine."
+                        ).inc(n_emitted)
+        used_tokens = sum(self._row_len[s] for s in range(self.num_slots)
+                          if self._tables[s])
+        for _p_tokens, p_blocks, _last in self._prefixes.values():
+            used_tokens += len(_p_tokens)
+        self._alloc.publish_fragmentation(used_tokens)
+        self._schedule()
+        return emitted

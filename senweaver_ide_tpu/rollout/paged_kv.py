@@ -1,0 +1,356 @@
+"""Paged KV cache: block pool, free-list allocator, copy-on-write tables.
+
+The slot engine gives every request a contiguous ``(max_len, Hkv, Dh)``
+stripe per layer, so a 40-token chat turn strands the same HBM as a
+2048-token rollout and a shared prefix is materialized by copying its
+buffer into each consumer's stripe. This module is the vLLM-style
+alternative (PagedAttention economics, see PAPERS.md): KV lives in a
+fixed device pool of fixed-size **blocks**
+
+    pool.k / pool.v : (L, num_blocks, block_size, Hkv, Dh)
+
+and each request owns a host-side **block table** — a list of physical
+block ids, one per ``block_size`` span of its sequence. Attention reads
+through the ``(request, logical_block) -> physical_block`` indirection
+(``models.transformer.forward_paged``); capacity is governed by the
+:class:`BlockAllocator`:
+
+* **free-list allocation** — O(1) alloc/release of whole blocks; any
+  free block serves any request, so there is no external fragmentation
+  (the only waste is the partially-filled last block per sequence,
+  tracked by the ``senweaver_kv_fragmentation`` gauge).
+* **refcounted sharing** — a shared prefix is installed into a request
+  by *grafting*: ``fork`` bumps the refcount of every prefix block and
+  returns a new table that aliases them. Zero bytes move.
+* **copy-on-write** — the first write into a shared block
+  (``cow_target`` returns a fresh destination when refcount > 1)
+  triggers exactly one block copy (:func:`copy_blocks`); full prefix
+  blocks are never copied, only the partial boundary block a consumer
+  diverges into.
+* **typed backpressure** — :class:`BlocksExhausted` when the pool runs
+  dry, so the engine can preempt-by-recomputation and the admission
+  plane can shed instead of OOMing the device.
+
+The allocator is pure host bookkeeping (ints in lists — no device sync
+anywhere) guarded by its own reentrant lock, so the engine lock and the
+allocator lock nest in a fixed order (engine → allocator). Device data
+only moves through the three jitted helpers at the bottom
+(:func:`copy_blocks`, :func:`install_blocks`, :func:`gather_blocks`),
+each a single scatter/gather on the pool.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import ModelConfig
+
+
+class BlocksExhausted(RuntimeError):
+    """The block pool cannot satisfy an allocation. Typed so the engine
+    can preempt/requeue and the admission plane can shed on it, the way
+    ``QueueFull`` sheds queue pressure."""
+
+    def __init__(self, requested: int, free: int, num_blocks: int):
+        super().__init__(
+            f"KV block pool exhausted: requested {requested} block(s), "
+            f"{free} free of {num_blocks}")
+        self.requested = requested
+        self.free = free
+        self.num_blocks = num_blocks
+
+
+class PagedKVPool(NamedTuple):
+    """The device-side block pool. ``k``/``v`` are
+    ``(L, num_blocks, block_size, Hkv, Dh)``; block 0..num_blocks-1 are
+    real, and writers address "drop this write" as block id
+    ``num_blocks`` (out of range → ``mode="drop"`` scatter no-op)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_paged_pool(config: ModelConfig, num_blocks: int,
+                    block_size: int) -> PagedKVPool:
+    """Zeroed pool sized for ``config``. The paged layout does not
+    support the int8 cache (``config.kv_quant``) — the engine falls
+    back to the slot layout there."""
+    head_dim = config.head_dim
+    shape = (config.num_layers, num_blocks, block_size,
+             config.num_kv_heads, head_dim)
+    dtype = config.dtype
+    return PagedKVPool(k=jnp.zeros(shape, dtype=dtype),
+                       v=jnp.zeros(shape, dtype=dtype))
+
+
+class BlockAllocator:
+    """Host-side free-list + refcount bookkeeping for one
+    :class:`PagedKVPool`. All methods are O(blocks touched); none
+    touches the device. Thread-safe behind its own reentrant lock (the
+    engine calls it under the engine lock; lock order is always
+    engine → allocator)."""
+
+    def __init__(self, num_blocks: int, block_size: int, *,
+                 registry=None):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._lock = threading.RLock()
+        # LIFO free list: recently-freed blocks are re-used first (their
+        # pool lines are warmest in HBM/cache).
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))  # guarded-by: _lock
+        self._ref: List[int] = [0] * num_blocks  # guarded-by: _lock
+        self._counters: Dict[str, int] = {  # guarded-by: _lock
+            "allocs": 0, "releases": 0, "grafts": 0, "cow_copies": 0,
+            "exhaustions": 0, "install_copies": 0}
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._blocks_total_gauge = registry.gauge(
+            "senweaver_kv_blocks_total",
+            "KV block-pool capacity of the most recently updated engine.")
+        self._blocks_free_gauge = registry.gauge(
+            "senweaver_kv_blocks_free",
+            "Free KV blocks in the pool.")
+        self._util_gauge = registry.gauge(
+            "senweaver_kv_pool_utilization",
+            "Fraction of KV blocks currently allocated (0..1).")
+        self._frag_gauge = registry.gauge(
+            "senweaver_kv_fragmentation",
+            "Internal fragmentation: fraction of allocated KV-block "
+            "capacity holding no token (partial last blocks).")
+        self._cow_total = registry.counter(
+            "senweaver_kv_cow_copies_total",
+            "Copy-on-write block copies (first divergent write into a "
+            "shared block).")
+        self._graft_total = registry.counter(
+            "senweaver_kv_prefix_grafts_total",
+            "Prefix installs served by block-table graft (refcount bump, "
+            "zero KV bytes copied).")
+        self._install_copy_total = registry.counter(
+            "senweaver_kv_install_copies_total",
+            "Prefix installs that copied KV buffers into place (slot "
+            "layout, or paged cross-engine import scatter).")
+        self._exhaustion_total = registry.counter(
+            "senweaver_kv_exhaustion_rejections_total",
+            "Allocations refused because the block pool was exhausted "
+            "(preemptions + admission rejections).")
+        self._publish_gauges()
+
+    # -- introspection (reads; callers may race, values are advisory) ----
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        """Blocks needed to hold ``num_tokens`` positions."""
+        return -(-num_tokens // self.block_size)
+
+    def check_leaks(self) -> None:
+        """Assert the pool is fully free (every table released). Used
+        by tests as the refcount-leak tripwire."""
+        with self._lock:
+            if len(self._free) != self.num_blocks:
+                held = [i for i, r in enumerate(self._ref) if r > 0]
+                raise AssertionError(
+                    f"KV block leak: {len(held)} block(s) still "
+                    f"referenced: {held[:16]}")
+
+    # -- allocation ------------------------------------------------------
+    def alloc(self, n: int) -> List[int]:
+        """``n`` fresh blocks at refcount 1, or :class:`BlocksExhausted`
+        (all-or-nothing: a partial grant would deadlock two requests
+        each holding half the pool)."""
+        with self._lock:
+            if n > len(self._free):
+                self._counters["exhaustions"] += 1
+                self._exhaustion_total.inc()
+                raise BlocksExhausted(n, len(self._free), self.num_blocks)
+            blocks = [self._free.pop() for _ in range(n)]
+            for b in blocks:
+                self._ref[b] = 1
+            self._counters["allocs"] += n
+            self._publish_gauges()
+            return blocks
+
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Refcount bump for every block (sharing, not ownership
+        transfer)."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"retain of free block {b}")
+                self._ref[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference per block; blocks reaching refcount 0
+        return to the free list."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"release of free block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+                    self._counters["releases"] += 1
+            self._publish_gauges()
+
+    def fork(self, table: Sequence[int]) -> List[int]:
+        """A new table aliasing every block of ``table`` — the
+        **graft**: a shared prefix installs into a consumer with zero
+        device bytes moved. Divergence is handled lazily by
+        :meth:`cow_target` at first write."""
+        with self._lock:
+            self.retain(table)
+            self._counters["grafts"] += 1
+            self._graft_total.inc()
+            return list(table)
+
+    def cow_target(self, block: int) -> Optional[int]:
+        """Copy-on-write check before writing into ``block``: None when
+        the caller owns it exclusively (write in place), else a fresh
+        block the caller must :func:`copy_blocks` into and point its
+        table at (the old reference is released here). May raise
+        :class:`BlocksExhausted` — the shared block is untouched then."""
+        with self._lock:
+            if self._ref[block] <= 0:
+                raise ValueError(f"cow_target of free block {block}")
+            if self._ref[block] == 1:
+                return None
+            fresh = self.alloc(1)[0]
+            # Drop our reference to the shared block only after the
+            # fresh one is granted, so exhaustion leaves state intact.
+            self.release([block])
+            self._counters["cow_copies"] += 1
+            self._cow_total.inc()
+            return fresh
+
+    def count_install_copy(self, n: int = 1) -> None:
+        """Account a buffer-copy prefix install (the non-graft path)."""
+        with self._lock:
+            self._counters["install_copies"] += n
+            self._install_copy_total.inc(n)
+
+    # -- gauges ----------------------------------------------------------
+    def _publish_gauges(self) -> None:
+        # guarded-by: caller
+        free = len(self._free)
+        self._blocks_total_gauge.set(self.num_blocks)
+        self._blocks_free_gauge.set(free)
+        used = self.num_blocks - free
+        self._util_gauge.set(used / self.num_blocks)
+
+    def publish_fragmentation(self, used_tokens: int) -> None:
+        """Internal-fragmentation gauge: ``used_tokens`` positions live
+        across ``used_blocks * block_size`` allocated capacity; the
+        difference is stranded tail space in partial last blocks."""
+        with self._lock:
+            cap = self.used_blocks * self.block_size
+            frac = 0.0 if cap == 0 else 1.0 - (used_tokens / cap)
+            self._frag_gauge.set(max(0.0, frac))
+
+
+class PagedSeqKV:
+    """One sequence's paged cache: a private pool + allocator + table.
+
+    The speculative decoder's verify path uses this instead of a
+    contiguous ``KVCache``: each verify round writes up to ``k`` draft
+    tokens past the accepted prefix, and a rejection must ROLL BACK —
+    :meth:`truncate` releases every block past the accepted length, so
+    rejected drafts can never leak pool capacity (the contiguous path's
+    metadata-only truncate has no blocks to leak; here the free list is
+    the proof, checked by ``allocator.check_leaks`` in tests)."""
+
+    def __init__(self, config: ModelConfig, *, max_len: int,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 registry=None):
+        if num_blocks is None:
+            num_blocks = -(-max_len // block_size)
+        self.allocator = BlockAllocator(num_blocks, block_size,
+                                        registry=registry)
+        self.pool = init_paged_pool(config, num_blocks, block_size)
+        self.max_blocks = -(-max_len // block_size)
+        self.table: List[int] = []
+        self.length = 0
+
+    def ensure(self, new_len: int) -> None:
+        """Grow the table to cover positions ``< new_len``."""
+        need = self.allocator.blocks_for(new_len)
+        if need > len(self.table):
+            self.table.extend(self.allocator.alloc(need - len(self.table)))
+
+    def truncate(self, length: int) -> None:
+        """Roll back to ``length`` valid tokens, RELEASING every block
+        past the boundary (the paged analogue of resetting
+        ``KVCache.length``; stale data inside the kept partial block is
+        masked by the validity window, same as the contiguous path)."""
+        keep = self.allocator.blocks_for(length)
+        if keep < len(self.table):
+            self.allocator.release(self.table[keep:])
+            del self.table[keep:]
+        self.length = length
+
+    def free(self) -> None:
+        """Release the whole table (end of generation)."""
+        self.truncate(0)
+
+    def tables_array(self) -> jnp.ndarray:
+        """Dense (1, max_blocks) int32 table row for forward_paged."""
+        row = self.table + [0] * (self.max_blocks - len(self.table))
+        return jnp.asarray([row], jnp.int32)
+
+
+# -- device-side block movement (the only jitted code here) --------------
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def copy_blocks(pool: PagedKVPool, src: jnp.ndarray,
+                dst: jnp.ndarray) -> PagedKVPool:
+    """Copy pool blocks ``src[i] -> dst[i]`` (both ``(n,)`` int32) in
+    one gather+scatter per tensor — the COW copy."""
+    return PagedKVPool(k=pool.k.at[:, dst].set(pool.k[:, src]),
+                       v=pool.v.at[:, dst].set(pool.v[:, src]))
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def install_blocks(pool: PagedKVPool, k_buf: jnp.ndarray,
+                   v_buf: jnp.ndarray, dst: jnp.ndarray) -> PagedKVPool:
+    """Scatter contiguous buffers ``(L, n, block_size, Hkv, Dh)`` into
+    pool blocks ``dst`` ``(n,)`` — the cross-engine prefix import."""
+    return PagedKVPool(k=pool.k.at[:, dst].set(k_buf),
+                       v=pool.v.at[:, dst].set(v_buf))
+
+
+@jax.jit
+def gather_blocks(pool: PagedKVPool, idx: jnp.ndarray):
+    """Contiguous ``(L, n*block_size, Hkv, Dh)`` view of pool blocks
+    ``idx`` ``(n,)`` — the prefix export."""
+    l, _, bs, hkv, dh = pool.k.shape
+    n = idx.shape[0]
+    k = pool.k[:, idx].reshape(l, n * bs, hkv, dh)
+    v = pool.v[:, idx].reshape(l, n * bs, hkv, dh)
+    return k, v

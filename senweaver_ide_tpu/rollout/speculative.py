@@ -42,7 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.config import ModelConfig
-from ..models.transformer import KVCache, Params, forward, init_kv_cache
+from ..models.transformer import (KVCache, Params, forward, forward_paged,
+                                  init_kv_cache)
+from .paged_kv import PagedKVPool, PagedSeqKV
 
 
 @functools.partial(jax.jit, static_argnames=("config",),
@@ -52,6 +54,25 @@ def _verify_forward(params: Params, config: ModelConfig, tokens: jax.Array,
     """Feed (1, k) tokens; return fp32 logits (k, V) + updated cache."""
     logits, cache = forward(params, config, tokens, cache=cache)
     return logits[0], cache
+
+
+@functools.partial(jax.jit, static_argnames=("config", "last_only"),
+                   donate_argnames=("pool_k", "pool_v"))
+def _verify_forward_paged(params: Params, config: ModelConfig,
+                          tokens: jax.Array, tables: jax.Array,
+                          positions: jax.Array, write_block: jax.Array,
+                          write_off: jax.Array, pool_k: jax.Array,
+                          pool_v: jax.Array, last_only: bool):
+    """Paged verify: feed (k,) tokens through the block-table forward.
+    ``last_only`` slices the final row in-jit (prefill — avoids
+    materializing (n_prompt, V) fp32 on host just to keep one row)."""
+    logits, pool_k, pool_v = forward_paged(
+        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+        tables=tables, seq_row=jnp.zeros_like(tokens),
+        positions=positions, write_block=write_block, write_off=write_off)
+    if last_only:
+        logits = logits[-1:]
+    return logits, pool_k, pool_v
 
 
 def _truncate(cache: KVCache, length: int) -> KVCache:
@@ -72,7 +93,8 @@ class SpeculativeDecoder:
 
     def __init__(self, target_params: Params, target_config: ModelConfig,
                  draft_params: Params, draft_config: ModelConfig, *,
-                 k: int = 4):
+                 k: int = 4, kv_layout: str = "slots",
+                 block_size: int = 16):
         if target_config.vocab_size != draft_config.vocab_size:
             raise ValueError(
                 "draft and target must share a vocabulary "
@@ -89,9 +111,18 @@ class SpeculativeDecoder:
                 "overwritten ring slots — use sampler.generate instead")
         if k < 1:
             raise ValueError(f"k must be >= 1, got {k}")
+        if kv_layout not in ("slots", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
         self.tp, self.tc = target_params, target_config
         self.dp, self.dc = draft_params, draft_config
         self.k = k
+        # "paged" verifies through block tables (rollout/paged_kv.py):
+        # rejection releases the rejected drafts' blocks instead of
+        # only resetting a length — _last_paged_kv exposes the
+        # (target, draft) caches so tests can assert no block leaks.
+        self.kv_layout = kv_layout
+        self.block_size = block_size
+        self._last_paged_kv: Optional[Tuple[PagedSeqKV, PagedSeqKV]] = None
         self.rounds = 0          # verify forwards issued (observability)
         self.accepted = 0        # proposals accepted across rounds
         self.proposed = 0
@@ -113,16 +144,30 @@ class SpeculativeDecoder:
         # error) — so enforce the speculative headroom on top of any
         # caller-supplied max_len.
         max_len = max(max_len or 0, n_prompt + max_new_tokens + k + 1)
-        t_cache = init_kv_cache(self.tc, 1, max_len)
-        d_cache = init_kv_cache(self.dc, 1, max_len)
-        toks = jnp.asarray([prompt], jnp.int32)
+        paged = self.kv_layout == "paged"
+        if paged:
+            t_kv = PagedSeqKV(self.tc, max_len=max_len,
+                              block_size=self.block_size)
+            d_kv = PagedSeqKV(self.dc, max_len=max_len,
+                              block_size=self.block_size)
+            self._last_paged_kv = (t_kv, d_kv)
+            t_cache = d_cache = None
+            t_last = self._paged_feed(t_kv, self.tp, self.tc, prompt,
+                                      last_only=True)
+            self._paged_feed(d_kv, self.dp, self.dc, prompt,
+                             last_only=True)
+        else:
+            t_cache = init_kv_cache(self.tc, 1, max_len)
+            d_cache = init_kv_cache(self.dc, 1, max_len)
+            toks = jnp.asarray([prompt], jnp.int32)
 
-        # sampler.prefill slices the last-token logits INSIDE the jit —
-        # verify-shaped prefill would materialize (n_prompt, V) fp32 per
-        # model only to discard all but one row.
-        from .sampler import prefill
-        t_last, t_cache = prefill(self.tp, self.tc, toks, t_cache)
-        _d_last, d_cache = prefill(self.dp, self.dc, toks, d_cache)
+            # sampler.prefill slices the last-token logits INSIDE the
+            # jit — verify-shaped prefill would materialize
+            # (n_prompt, V) fp32 per model only to discard all but one
+            # row. (_paged_feed's last_only flag does the same in-jit.)
+            from .sampler import prefill
+            t_last, t_cache = prefill(self.tp, self.tc, toks, t_cache)
+            _d_last, d_cache = prefill(self.dp, self.dc, toks, d_cache)
         # pending = emitted-but-uncached; its target dist is in hand
         pending = int(jnp.argmax(t_last[0])) if temperature <= 0.0 \
             else self._pick(np.asarray(t_last[0]), temperature, rng)
@@ -145,9 +190,12 @@ class SpeculativeDecoder:
             proposals: List[int] = []
             tok = pending
             for _ in range(k):
-                dl, d_cache = _verify_forward(
-                    self.dp, self.dc, jnp.asarray([[tok]], jnp.int32),
-                    d_cache)
+                if paged:
+                    dl = self._paged_feed(d_kv, self.dp, self.dc, [tok])
+                else:
+                    dl, d_cache = _verify_forward(
+                        self.dp, self.dc, jnp.asarray([[tok]], jnp.int32),
+                        d_cache)
                 if greedy:
                     tok = int(jnp.argmax(dl[-1]))
                 else:
@@ -156,9 +204,14 @@ class SpeculativeDecoder:
                 proposals.append(tok)
 
             # -- verify in ONE target forward ------------------------------
-            verify_in = jnp.asarray([[pending] + proposals[:-1]], jnp.int32)
-            p_dev, t_cache = _verify_forward(self.tp, self.tc,
-                                             verify_in, t_cache)
+            if paged:
+                p_dev = self._paged_feed(t_kv, self.tp, self.tc,
+                                         [pending] + proposals[:-1])
+            else:
+                verify_in = jnp.asarray([[pending] + proposals[:-1]],
+                                        jnp.int32)
+                p_dev, t_cache = _verify_forward(self.tp, self.tc,
+                                                 verify_in, t_cache)
             self.rounds += 1
             self.proposed += k
 
@@ -199,8 +252,15 @@ class SpeculativeDecoder:
                 emitted = proposals[:m] + [correction]
                 new_pending = correction
                 n_cached += 1 + m            # pending + accepted prefix
-                t_cache = _truncate(t_cache, n_cached)
-                d_cache = _truncate(d_cache, n_cached)
+                if paged:
+                    # Paged rollback returns the rejected drafts' blocks
+                    # to the pool (refcount-exact), not just a length
+                    # reset — the leak assertion in tests rides on this.
+                    t_kv.truncate(n_cached)
+                    d_kv.truncate(n_cached)
+                else:
+                    t_cache = _truncate(t_cache, n_cached)
+                    d_cache = _truncate(d_cache, n_cached)
 
             for tok in emitted:
                 out.append(int(tok))
@@ -211,6 +271,27 @@ class SpeculativeDecoder:
             pending = new_pending
 
         return out[:max_new_tokens]
+
+    def _paged_feed(self, kv: PagedSeqKV, params: Params,
+                    config: ModelConfig, toks: List[int], *,
+                    last_only: bool = False) -> jax.Array:
+        """Feed host tokens at the cache tip through the block-table
+        forward; returns fp32 logits rows ((1, V) when ``last_only``,
+        else (len(toks), V)). Grows the block table first so every
+        write lands in an owned block."""
+        start = kv.length
+        kv.ensure(start + len(toks))
+        bs = kv.allocator.block_size
+        poss = list(range(start, start + len(toks)))
+        logits, pk, pv = _verify_forward_paged(
+            params, config, jnp.asarray(toks, jnp.int32),
+            kv.tables_array(), jnp.asarray(poss, jnp.int32),
+            jnp.asarray([kv.table[p // bs] for p in poss], jnp.int32),
+            jnp.asarray([p % bs for p in poss], jnp.int32),
+            kv.pool.k, kv.pool.v, last_only)
+        kv.pool = PagedKVPool(k=pk, v=pv)
+        kv.length = start + len(toks)
+        return logits
 
     @property
     def acceptance_rate(self) -> float:

@@ -11,11 +11,18 @@ economics (PAPERS.md) applied across engines instead of within one:
    the **donor**: it prefills the tokens (``engine.register_prefix``)
    and exports its one-slot KV buffer (``engine.export_prefix``).
 2. The store installs that buffer into every other LIVE replica via
-   ``engine.import_prefix`` — a ``jax.device_put`` device-to-device
-   copy, validated against the receiver's pool layout and accounted in
-   its prefix LRU like a locally-prefilled entry. TTFT for
-   prefix-bearing requests on those replicas drops from O(prefix FLOPs)
-   to O(HBM bandwidth).
+   ``engine.import_prefix`` — validated against the receiver's pool
+   layout and accounted in its prefix LRU like a locally-prefilled
+   entry. TTFT for prefix-bearing requests on those replicas drops from
+   O(prefix FLOPs) to O(HBM bandwidth). Under the slot layout the
+   install is a ``jax.device_put`` buffer copy; under the paged layout
+   (EngineConfig.kv_layout="paged", the default) it is ONE scatter into
+   freshly allocated pool blocks (``senweaver_kv_install_copies_total``)
+   — and from then on every request naming the prefix GRAFTS those
+   blocks into its own block table (a refcount bump,
+   ``senweaver_kv_prefix_grafts_total``, zero KV bytes moved; divergent
+   writes copy-on-write only the boundary block). Per-request prefix
+   cost on a warm replica is therefore O(table ints), not O(prefix KV).
 3. Replicas that join late, resurrect after death, or were DRAINING
    during the broadcast are **backfilled** on their next prefix-bearing
    dispatch (:meth:`ensure` runs in the dispatch path).
@@ -130,13 +137,29 @@ class SharedPrefixStore:
         return entry
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "shared_prefixes": len(self._entries),
             "prefixes_materialized": sum(
                 e.kv is not None for e in self._entries.values()),
             "prefixes_failed": sum(
                 e.failed for e in self._entries.values()),
         }
+        # Graft-vs-copy economics across the fleet: paged replicas report
+        # kv_prefix_grafts / kv_install_copies in engine stats — aggregate
+        # them so one number answers "are imports actually zero-copy?".
+        grafts = copies = 0
+        paged_any = False
+        for rep in self.replicas:
+            st = getattr(rep, "engine", None)
+            st = st.stats() if st is not None else {}
+            if st.get("kv_paged"):
+                paged_any = True
+                grafts += st.get("kv_grafts", 0)
+                copies += st.get("kv_install_copies", 0)
+        if paged_any:
+            out["kv_prefix_grafts"] = grafts
+            out["kv_install_copies"] = copies
+        return out
 
     # -- broadcast protocol --------------------------------------------------
     def ensure(self, replica: EngineReplica,

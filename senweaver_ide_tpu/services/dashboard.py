@@ -150,6 +150,7 @@ class DashboardService:
         out["training_health"] = self._training_health_summary()
         out["resilience"] = self._resilience_summary()
         out["serving"] = self._serving_summary()
+        out["kv_pool"] = self._kv_pool_summary()
         out["slo"] = self._slo_summary()
         return out
 
@@ -328,6 +329,43 @@ class DashboardService:
                     "drain"),
                 "autoscale_shed_rate": total(
                     "senweaver_serve_autoscale_shed_rate"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _kv_pool_summary(self) -> Dict[str, Any]:
+        """Paged-KV pool tile, read straight off the registry's
+        ``senweaver_kv_*`` series (zero wiring — any BlockAllocator in
+        the process shows up; all None/zero under the slot layout).
+        Block gauges sum across allocators; the utilization and
+        fragmentation ratios report the WORST pool, since one starved
+        engine stalls its replica no matter how empty the others are."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        def worst(name: str) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            vals = [float(v) for v in m.samples().values()]
+            return max(vals) if vals else None
+
+        try:
+            return {
+                "blocks_total": total("senweaver_kv_blocks_total"),
+                "blocks_free": total("senweaver_kv_blocks_free"),
+                "utilization": worst("senweaver_kv_pool_utilization"),
+                "fragmentation": worst("senweaver_kv_fragmentation"),
+                "cow_copies": total("senweaver_kv_cow_copies_total"),
+                "prefix_grafts":
+                    total("senweaver_kv_prefix_grafts_total"),
+                "install_copies":
+                    total("senweaver_kv_install_copies_total"),
+                "exhaustion_rejections": total(
+                    "senweaver_kv_exhaustion_rejections_total"),
             }
         except Exception as e:
             return {"error": str(e)}

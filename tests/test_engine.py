@@ -170,12 +170,16 @@ def test_engine_tp_sharded_and_weight_sync():
 
     from senweaver_ide_tpu.models import get_config, init_params
     from senweaver_ide_tpu.parallel import make_named_mesh
-    from senweaver_ide_tpu.rollout import RolloutEngine
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
 
     config = get_config("tiny-test")
     params = init_params(config, jax.random.PRNGKey(0))
     mesh = make_named_mesh({"tp": 2}, devices=jax.devices()[:2])
-    ref = RolloutEngine(params, config, num_slots=2, max_len=256, seed=3)
+    # mesh engines fall back to the slot KV layout; pin the reference
+    # to the same layout so same-seed sampling streams are comparable
+    # (stochastic streams differ across layouts; greedy streams don't)
+    ref = RolloutEngine(params, config, num_slots=2, max_len=256, seed=3,
+                        engine_config=EngineConfig(kv_layout="slots"))
     eng = RolloutEngine(params, config, num_slots=2, max_len=256, seed=3,
                         mesh=mesh)
     prompt = list(range(1, 20))

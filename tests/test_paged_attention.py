@@ -1,0 +1,93 @@
+"""Paged-attention Pallas kernel (interpret mode) vs the gather+einsum
+reference the engine's default paged path uses: block-table indirection,
+GQA grouping, ragged lengths, block skipping."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu.ops.attention import attention
+from senweaver_ide_tpu.ops.paged_attention import paged_flash_decode
+
+
+def _mk(t, nb, bs, mb, hq, hkv, d, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+    q = jax.random.normal(ks[0], (t, hq, d), jnp.float32)
+    k_pool = jax.random.normal(ks[1], (nb, bs, hkv, d), jnp.float32)
+    v_pool = jax.random.normal(ks[2], (nb, bs, hkv, d), jnp.float32)
+    tables = jax.random.randint(ks[3], (t, mb), 0, nb)
+    return q, k_pool, v_pool, tables
+
+
+def _ref(q, k_pool, v_pool, tables, lengths):
+    """Gather the tables into contiguous per-token sequences and run the
+    einsum cache attention — exactly models.transformer._paged_layer's
+    non-kernel path."""
+    t, mb = tables.shape
+    _, bs, hkv, d = k_pool.shape
+    k_seq = k_pool[tables].reshape(t, mb * bs, hkv, d)
+    v_seq = v_pool[tables].reshape(t, mb * bs, hkv, d)
+    valid = jnp.arange(mb * bs)[None, :] < lengths[:, None]
+    return attention(q[:, None], k_seq, v_seq, q_offset=lengths - 1,
+                     kv_mask=valid, causal=True)[:, 0]
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 1)])
+def test_matches_gather_reference(hq, hkv):
+    t, nb, bs, mb, d = 5, 9, 16, 4, 16
+    q, k_pool, v_pool, tables = _mk(t, nb, bs, mb, hq, hkv, d)
+    lengths = jnp.asarray([1, 17, 33, 64, 50], jnp.int32)
+    out = paged_flash_decode(q, k_pool, v_pool, tables, lengths,
+                             interpret=True)
+    ref = _ref(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_aliased_blocks_shared_prefix():
+    """Several tokens reading THROUGH the same physical blocks (the COW
+    shared-prefix shape) must each see the same keys."""
+    t, nb, bs, mb, d, hq, hkv = 4, 6, 8, 3, 16, 4, 2
+    q, k_pool, v_pool, _ = _mk(t, nb, bs, mb, hq, hkv, d, seed=3)
+    # every token's table aliases the same two prefix blocks, then a
+    # private third
+    tables = jnp.asarray([[0, 1, 2 + i % 3] for i in range(t)],
+                         jnp.int32)
+    lengths = jnp.asarray([20, 24, 17, 21], jnp.int32)
+    out = paged_flash_decode(q, k_pool, v_pool, tables, lengths,
+                             interpret=True)
+    ref = _ref(q, k_pool, v_pool, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_scalar_length_broadcasts():
+    t, nb, bs, mb, d, hq, hkv = 3, 5, 8, 2, 16, 4, 2
+    q, k_pool, v_pool, tables = _mk(t, nb, bs, mb, hq, hkv, d, seed=4)
+    out = paged_flash_decode(q, k_pool, v_pool, tables, 12,
+                             interpret=True)
+    ref = _ref(q, k_pool, v_pool, tables,
+               jnp.full((t,), 12, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_length_one_skips_dead_blocks():
+    """A fresh row (length 1) must ignore every block past the first —
+    garbage in dead table entries cannot contaminate the output."""
+    t, nb, bs, mb, d, hq, hkv = 2, 4, 8, 4, 16, 4, 2
+    q, k_pool, v_pool, tables = _mk(t, nb, bs, mb, hq, hkv, d, seed=5)
+    tables = tables.at[:, 0].set(jnp.asarray([0, 1]))  # live blocks
+    lengths = jnp.asarray([1, 1], jnp.int32)
+    out = paged_flash_decode(q, k_pool, v_pool, tables, lengths,
+                             interpret=True)
+    # poison all non-first blocks: output must not move
+    poison = jnp.full_like(k_pool, 1e4)
+    k_bad = k_pool.at[2:].set(poison[2:])
+    v_bad = v_pool.at[2:].set(poison[2:])
+    tables_bad = tables.at[:, 1:].set(3)
+    out_bad = paged_flash_decode(q, k_bad, v_bad, tables_bad, lengths,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_bad),
+                               atol=2e-5, rtol=2e-5)

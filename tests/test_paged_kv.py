@@ -1,0 +1,288 @@
+"""Paged KV-cache subsystem: allocator invariants, COW sharing, typed
+exhaustion backpressure with engine preemption/requeue, fragmentation
+accounting, publish invalidation, cross-layout golden decode, and the
+fleet zero-copy graft property (ISSUE 10 acceptance)."""
+
+import jax
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout import (BlockAllocator, BlocksExhausted,
+                                       EngineConfig, RolloutEngine)
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import ServingFleet
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_paged(model, num_slots=2, max_len=64, **eng_kw):
+    params, config = model
+    cfg = EngineConfig(kv_layout="paged", block_size=4,
+                       **{k: eng_kw.pop(k) for k in
+                          ("num_blocks", "step_tokens")
+                          if k in eng_kw})
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY,
+                         engine_config=cfg, **eng_kw)
+
+
+def registry_value(name):
+    m = obs.get_registry().get(name)
+    return None if m is None else float(m.value())
+
+
+# ---- allocator unit invariants -------------------------------------------
+
+def test_alloc_release_roundtrip():
+    a = BlockAllocator(8, 4)
+    t = a.alloc(3)
+    assert a.used_blocks == 3 and a.free_blocks == 5
+    assert all(a.refcount(b) == 1 for b in t)
+    a.release(t)
+    a.check_leaks()
+    c = a.counters()
+    assert c["allocs"] == 3 and c["releases"] == 3
+
+
+def test_exhaustion_is_typed_and_all_or_nothing():
+    a = BlockAllocator(4, 4)
+    held = a.alloc(3)
+    with pytest.raises(BlocksExhausted) as ei:
+        a.alloc(2)
+    assert (ei.value.requested, ei.value.free,
+            ei.value.num_blocks) == (2, 1, 4)
+    # no partial grant: the one free block is still free
+    assert a.free_blocks == 1
+    assert a.counters()["exhaustions"] == 1
+    a.release(held)
+    a.check_leaks()
+
+
+def test_fork_grafts_and_cow_diverges():
+    a = BlockAllocator(8, 4)
+    table = a.alloc(2)
+    graft = a.fork(table)
+    assert graft == table
+    assert all(a.refcount(b) == 2 for b in table)
+    assert a.counters()["grafts"] == 1
+
+    # writing into the shared boundary block forces exactly one copy;
+    # the grafted table drops its shared ref in the exchange
+    fresh = a.cow_target(graft[1])
+    assert fresh is not None and fresh != table[1]
+    graft[1] = fresh
+    assert a.refcount(table[1]) == 1        # donor's ref only
+    assert a.counters()["cow_copies"] == 1
+    # an exclusively-owned block writes in place — no copy
+    assert a.cow_target(fresh) is None
+    assert a.counters()["cow_copies"] == 1
+
+    a.release(table)
+    a.release(graft)
+    a.check_leaks()
+
+
+def test_cow_exhaustion_leaves_shared_block_intact():
+    a = BlockAllocator(2, 4)
+    table = a.alloc(2)          # pool now full
+    graft = a.fork(table)
+    with pytest.raises(BlocksExhausted):
+        a.cow_target(table[0])
+    # failed COW must not have dropped the caller's reference
+    assert a.refcount(table[0]) == 2
+    a.release(table)
+    a.release(graft)
+    a.check_leaks()
+
+
+def test_refcount_misuse_raises():
+    a = BlockAllocator(2, 4)
+    with pytest.raises(ValueError):
+        a.retain([0])           # never allocated
+    with pytest.raises(ValueError):
+        a.release([1])
+    b = a.alloc(1)
+    a.release(b)
+    with pytest.raises(ValueError):
+        a.release(b)            # double free
+
+
+# ---- engine: exhaustion mid-decode → preempt + requeue, never lose -------
+
+def test_pool_exhaustion_preempts_and_requeues(model):
+    """A pool too small for two concurrent rollouts must preempt one
+    (typed BlocksExhausted → recompute later), and BOTH requests still
+    finish with their exact solo-run outputs (greedy invariance)."""
+    prompts = [[5, 9, 2, 7], [11, 3, 8, 1]]
+    solo = []
+    for p in prompts:
+        e = make_paged(model, num_slots=1)
+        r = e.submit(p, max_new_tokens=12)
+        solo.append(e.run()[r])
+
+    # each finished rollout is 16 tokens = 4 blocks at block_size=4;
+    # 6 blocks cannot hold two of them concurrently
+    eng = make_paged(model, num_slots=2, num_blocks=6)
+    rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    out = eng.run()
+    for rid, ref in zip(rids, solo):
+        assert out[rid] == ref
+    stats = eng.stats()
+    assert stats["kv_paged"]
+    assert stats["kv_preemptions"] >= 1
+    assert stats["kv_exhaustions"] >= 1
+    eng._alloc.check_leaks()    # everything returned after completion
+
+
+def test_single_request_survives_tight_pool(model):
+    """One request exactly filling the pool completes without help."""
+    eng = make_paged(model, num_slots=1, num_blocks=4)
+    rid = eng.submit([5, 9, 2, 7], max_new_tokens=12)   # 16 tok = 4 blk
+    assert len(eng.run()[rid]) == 12
+    eng._alloc.check_leaks()
+
+
+# ---- COW under donor death ------------------------------------------------
+
+def test_cow_consumer_survives_donor_release(model):
+    """A request grafted onto a prefix keeps decoding correctly after
+    the prefix entry itself is released mid-flight (refcounts keep the
+    shared blocks alive until the LAST table drops them)."""
+    prefix = [5, 9, 2, 7, 4, 4]          # 2 blocks, partial boundary
+    suffix = [1, 3]
+
+    ref_eng = make_paged(model)
+    ref_rid = ref_eng.submit(prefix + suffix, max_new_tokens=10)
+    ref = ref_eng.run()[ref_rid]
+
+    eng = make_paged(model)
+    pid = eng.register_prefix(prefix)
+    rid = eng.submit(prefix + suffix, max_new_tokens=10, prefix_id=pid)
+    for _ in range(3):                   # decode has begun
+        eng.step()
+    eng.release_prefix(pid)              # donor dies mid-flight
+    assert eng.run()[rid] == ref
+    c = eng._alloc.counters()
+    assert c["grafts"] == 1
+    assert c["cow_copies"] >= 1          # boundary block diverged
+    eng._alloc.check_leaks()
+
+
+# ---- fragmentation + reuse after many short requests ---------------------
+
+def test_many_short_requests_no_external_fragmentation(model):
+    """Any free block serves any request: after many short rollouts the
+    pool drains back to fully free, and the fragmentation gauge stays a
+    sane ratio while running."""
+    eng = make_paged(model, num_slots=2)
+    for batch in range(4):
+        rids = [eng.submit([batch * 3 + i + 1, 2, 3], max_new_tokens=3)
+                for i in range(3)]
+        out = eng.run()
+        assert all(len(out[r]) == 3 for r in rids)
+        frag = registry_value("senweaver_kv_fragmentation")
+        assert frag is not None and 0.0 <= frag <= 1.0
+    c = eng._alloc.counters()
+    assert c["allocs"] == c["releases"]
+    eng._alloc.check_leaks()
+    assert registry_value("senweaver_kv_blocks_free") == \
+        eng._alloc.num_blocks
+
+
+# ---- publish invalidation drops shared refcounts to zero -----------------
+
+def test_update_params_drops_prefix_block_refcounts(model):
+    params, _ = model
+    eng = make_paged(model)
+    pid1 = eng.register_prefix([5, 9, 2, 7])
+    pid2 = eng.register_prefix([8, 8, 1])
+    rid = eng.submit([5, 9, 2, 7, 1], max_new_tokens=4, prefix_id=pid1)
+    eng.run()
+    assert eng._alloc.used_blocks > 0    # prefix blocks still resident
+    eng.update_params(params)            # publish: old-policy KV dies
+    eng._alloc.check_leaks()             # every shared refcount hit 0
+    for pid in (pid1, pid2):
+        with pytest.raises(KeyError):
+            eng.submit([5, 9, 2, 7, 1], max_new_tokens=2, prefix_id=pid)
+    assert eng.run()[rid]                # pre-publish result retained
+
+
+# ---- cross-layout golden decode ------------------------------------------
+
+def test_cross_layout_golden_decode(model):
+    """The golden parity gate: identical greedy token streams from the
+    slot and paged layouts over mixed-length prompts (chunked prefill
+    interleaving with decode on the paged side)."""
+    params, config = model
+    prompts = [[5, 9, 2, 7, 1, 3], [11, 3], [4, 4, 8, 1, 2, 6, 9, 5]]
+
+    slots = RolloutEngine(params, config, num_slots=2, max_len=64,
+                          sample=GREEDY,
+                          engine_config=EngineConfig(kv_layout="slots"))
+    s_rids = [slots.submit(p, max_new_tokens=10) for p in prompts]
+    s_out = slots.run()
+
+    paged = make_paged(model, num_slots=2)
+    p_rids = [paged.submit(p, max_new_tokens=10) for p in prompts]
+    p_out = paged.run()
+
+    for sr, pr in zip(s_rids, p_rids):
+        assert s_out[sr] == p_out[pr]
+    assert not slots.stats().get("kv_paged")
+    assert paged.stats()["kv_paged"]
+    paged._alloc.check_leaks()
+
+
+# ---- fleet: shared-prefix import is graft-only per request ---------------
+
+def test_fleet_prefix_graft_zero_copy_per_request(model):
+    """Acceptance: on a 4-replica paged fleet, the per-request cost of a
+    shared prefix is a block-table graft — the only KV buffer copies are
+    the 3 one-time import scatters (one per non-donor replica), counted
+    in blocks; request volume moves the graft counter ONLY."""
+    params, config = model
+    # block-aligned prefix: consumers append in a fresh block, so even
+    # the COW boundary copy disappears — truly zero bytes per request
+    prefix = [5, 9, 2, 7] * 4            # 16 tokens = 1 block @ bs 16
+    engines = [RolloutEngine(params, config, num_slots=2, max_len=64,
+                             sample=GREEDY) for _ in range(4)]
+    assert all(e.kv_layout == "paged" for e in engines)  # the default
+    fleet = ServingFleet(engines)
+    pid = fleet.register_prefix(prefix)
+
+    n_requests = 8
+    tickets = [fleet.submit(prefix + [i + 1], max_new_tokens=4,
+                            prefix_id=pid) for i in range(n_requests)]
+    out = fleet.run()
+    assert all(t in out for t in tickets)
+
+    def kv_stat(key):
+        return sum(e.stats().get(key, 0) for e in engines)
+
+    nblk = engines[0]._alloc.blocks_for(len(prefix))
+    assert kv_stat("kv_grafts") == n_requests
+    assert kv_stat("kv_install_copies") == 3 * nblk   # imports only
+    assert kv_stat("kv_cow_copies") == 0              # block-aligned
+    assert fleet.prefix_store.stats()["kv_prefix_grafts"] == n_requests
+
+    # more traffic moves grafts, not copies
+    more = [fleet.submit(prefix + [20 + i], max_new_tokens=4,
+                         prefix_id=pid) for i in range(4)]
+    fleet.run()
+    assert kv_stat("kv_grafts") == n_requests + 4
+    assert kv_stat("kv_install_copies") == 3 * nblk

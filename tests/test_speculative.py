@@ -174,3 +174,65 @@ def test_eval_speculative_script_reports_gain():
     assert report["outputs_exact"] is True
     assert report["gain"] > 0.2, report
     assert report["verify_rounds_after"] < report["verify_rounds_before"]
+
+
+# ---- paged KV layout ----------------------------------------------------
+
+def test_paged_layout_matches_slots_greedy(models):
+    """Block-table verification must reproduce the slot-cache token
+    stream exactly (fp32 logits are bitwise-equal across layouts)."""
+    target, tc, draft, dc = models
+    prompt = [5, 9, 2, 7, 1, 3]
+    ref = SpeculativeDecoder(target, tc, draft, dc, k=3)
+    out_ref = ref.generate(prompt, max_new_tokens=12, max_len=64)
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=3,
+                             kv_layout="paged", block_size=4)
+    out = dec.generate(prompt, max_new_tokens=12, max_len=64)
+    assert out == out_ref
+
+
+def test_paged_rejection_releases_blocks_no_leak(models):
+    """Rejected drafts roll the block table back and RETURN the blocks:
+    after generate, each cache holds exactly len(table) blocks, and
+    free() drains the allocator to zero (check_leaks passes)."""
+    target, tc, _, _ = models
+    # an unrelated draft ⇒ near-total rejection ⇒ every round exercises
+    # the truncate/release path
+    dc = dataclasses.replace(tc, num_layers=1, name="tiny-draft-bad")
+    draft = init_params(dc, jax.random.PRNGKey(1234))
+    dec = SpeculativeDecoder(target, tc, draft, dc, k=4,
+                             kv_layout="paged", block_size=4)
+    out = dec.generate([5, 9, 2, 7], max_new_tokens=10, max_len=64)
+    assert len(out) == 10
+    assert dec.rounds >= 2            # rejection path actually ran
+    t_kv, d_kv = dec._last_paged_kv
+    for kv in (t_kv, d_kv):
+        # exactly the live table is held — nothing orphaned by rollback
+        assert kv.allocator.used_blocks == len(kv.table)
+        assert kv.allocator.blocks_for(kv.length) == len(kv.table)
+        kv.free()
+        kv.allocator.check_leaks()    # raises on any dangling refcount
+
+
+def test_paged_full_acceptance_no_leak(models):
+    """Self-draft (always accepts) never truncates — the no-rollback
+    path must account blocks just as exactly."""
+    target, tc, _, _ = models
+    dec = SpeculativeDecoder(target, tc, target, tc, k=4,
+                             kv_layout="paged")
+    ref = SpeculativeDecoder(target, tc, target, tc, k=4)
+    prompt = [5, 9, 2, 7, 1, 3]
+    assert dec.generate(prompt, max_new_tokens=12, max_len=64) == \
+        ref.generate(prompt, max_new_tokens=12, max_len=64)
+    assert dec.acceptance_rate == 1.0
+    t_kv, d_kv = dec._last_paged_kv
+    for kv in (t_kv, d_kv):
+        assert kv.allocator.used_blocks == len(kv.table)
+        kv.free()
+        kv.allocator.check_leaks()
+
+
+def test_paged_rejects_unknown_layout(models):
+    target, tc, draft, dc = models
+    with pytest.raises(ValueError, match="kv_layout"):
+        SpeculativeDecoder(target, tc, draft, dc, kv_layout="ring")
