@@ -51,6 +51,21 @@ TIMED_ITERS = 3
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "BENCH_CACHE.json")
 
+# Per-case warmup/steady split, stamped into the artifact as
+# extra["timing"]: compile_s is the warmup wall (trace + XLA compile +
+# first execution), step_s the steady-state wall per timed unit (one
+# run / one dispatch / one train step). Every reported throughput
+# number comes from the steady-state side only — the split makes that
+# auditable and gives scripts/perf_gate.py its baseline axes.
+TIMINGS: dict = {}
+
+
+def _stamp_timing(key: Optional[str], compile_s: float,
+                  step_s: float) -> None:
+    if key:
+        TIMINGS[key] = {"compile_s": round(compile_s, 3),
+                        "step_s": round(step_s, 4)}
+
 
 def _log(msg: str) -> None:
     """Progress to stderr (stdout carries ONLY the one JSON line)."""
@@ -248,7 +263,8 @@ def _wait_for_backend(*, attempts: int = None, probe_timeout_s: float = None,
 
 def _measure(model_name: str, batch: int, prompt_len: int,
              decode_tokens: int, *, weight_quant: bool = False,
-             decode_attn_impl: Optional[str] = None) -> float:
+             decode_attn_impl: Optional[str] = None,
+             timing_key: Optional[str] = None) -> float:
     """Decode tokens/sec via the slope between two decode lengths.
 
     ``weight_quant``: serve int8 weight-only quantized params
@@ -296,8 +312,10 @@ def _measure(model_name: str, batch: int, prompt_len: int,
 
     # Warmup/compile as plain statements: inside `assert` they would be
     # stripped under python -O, moving compilation into the timed loops.
+    t_warm = time.perf_counter()
     warm_lo = run(jax.random.PRNGKey(1), n_lo)
     warm_hi = run(jax.random.PRNGKey(1), n_hi)
+    compile_s = time.perf_counter() - t_warm
     if warm_lo.shape != (batch, n_lo) or warm_hi.shape != (batch, n_hi):
         raise RuntimeError("generate_scan returned unexpected shapes")
 
@@ -323,6 +341,7 @@ def _measure(model_name: str, batch: int, prompt_len: int,
         raise RuntimeError(
             f"decode slope not positive (t_lo={t_lo:.3f}s "
             f"t_hi={t_hi:.3f}s); timing too noisy to report")
+    _stamp_timing(timing_key, compile_s, t_hi / TIMED_ITERS)
     return batch * decode_tokens * TIMED_ITERS / (t_hi - t_lo)
 
 
@@ -375,7 +394,8 @@ def _init_int8_params(config, key):
 
 def _measure_steps(model_name: str, batch: int, prompt_len: int,
                    decode_tokens: int, *, quantized: bool = False,
-                   weight_quant: bool = False) -> float:
+                   weight_quant: bool = False,
+                   timing_key: Optional[str] = None) -> float:
     """Decode tokens/sec via pipelined per-step dispatch (the `generate`
     / rollout-engine serving path): prefill once, then ``decode_tokens``
     back-to-back ``decode_step`` dispatches, blocking only at the end.
@@ -408,16 +428,20 @@ def _measure_steps(model_name: str, batch: int, prompt_len: int,
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     key = jax.random.PRNGKey(1)
     # warmup: compiles decode_step and fills the dispatch pipeline
+    t_warm = _time.perf_counter()
     tok, _, cache = decode_step(params, config, tok[:, None], cache, key,
                                 sample)
     np.asarray(tok)    # host materialization: see _measure's comment
+    compile_s = _time.perf_counter() - t_warm
 
     t0 = _time.perf_counter()
     for i in range(decode_tokens):
         tok, _, cache = decode_step(params, config, tok[:, None], cache,
                                     jax.random.fold_in(key, i), sample)
     np.asarray(tok)    # forces the whole dependent chain to execute
-    return batch * decode_tokens / (_time.perf_counter() - t0)
+    dt = _time.perf_counter() - t0
+    _stamp_timing(timing_key, compile_s, dt / decode_tokens)
+    return batch * decode_tokens / dt
 
 
 # bf16 peak FLOP/s per chip by device kind; the MFU denominator.
@@ -428,7 +452,8 @@ _PEAK_FLOPS = {
 
 
 def _measure_train(model_name: str, batch: int, seq: int, *,
-                   accum_steps: int = 1, iters: int = 3) -> dict:
+                   accum_steps: int = 1, iters: int = 3,
+                   timing_key: Optional[str] = None) -> dict:
     """GRPO train-step throughput: tokens/sec and MFU.
 
     Times the full clipped-objective update (forward + backward + adamw)
@@ -474,19 +499,23 @@ def _measure_train(model_name: str, batch: int, seq: int, *,
                                  accum_steps=accum_steps)
         return st, metrics
 
+    t_warm = time.perf_counter()
     state, metrics = step(state)             # compile + warmup
     jax.block_until_ready(state.params)
+    compile_s = time.perf_counter() - t_warm
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state)
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
 
+    _stamp_timing(timing_key, compile_s, dt / iters)
     toks_per_sec = batch * seq * iters / dt
     dev = jax.devices()[0]
     peak = _PEAK_FLOPS.get(getattr(dev, "device_kind", ""), None)
     out = {"tokens_per_sec": round(toks_per_sec, 2),
            "step_ms": round(dt / iters * 1000.0, 1),
+           "compile_s": round(compile_s, 3),
            "n_params": n_params}
     if peak is not None and dev.platform != "cpu":
         # 6·N FLOPs/token covers fwd (2N) + bwd (4N) dense matmuls; the
@@ -508,6 +537,8 @@ def _measure_prefix_fleet(*, n_replicas: int = 4, prefix_len: int = 48,
     (≈ 2·N_params per prefix token per avoided prefill), and the
     prefix-bearing TTFT mean per mode — the acceptance signal is
     broadcast TTFT < lazy TTFT."""
+    import time as _time
+
     import jax
     import numpy as np
 
@@ -547,9 +578,13 @@ def _measure_prefix_fleet(*, n_replicas: int = 4, prefix_len: int = 48,
             "ttft_ms_mean": sum(ttfts) / max(1, len(ttfts)),
         }
 
+    t_warm = _time.perf_counter()
     run(shared=True)        # warm the jit caches so neither mode pays
+    compile_s = _time.perf_counter() - t_warm
     lazy = run(shared=False)
+    t0 = _time.perf_counter()
     bcast = run(shared=True)
+    _stamp_timing("prefix_fleet", compile_s, _time.perf_counter() - t0)
     obs._reset_for_tests()
     avoided = bcast["prefills_avoided"]
     return {
@@ -605,10 +640,14 @@ def _measure_paged_vs_slots(*, num_slots: int = 4, prompt_len: int = 16,
                 "tokens": [out[r] for r in rids],
                 "stats": eng.stats()}
 
+    t_warm = _time.perf_counter()
     run("slots")            # compile warmup, both layouts
     run("paged")
+    compile_s = _time.perf_counter() - t_warm
     slots = run("slots")
+    t0 = _time.perf_counter()
     paged = run("paged")
+    _stamp_timing("paged_vs_slots", compile_s, _time.perf_counter() - t0)
     obs._reset_for_tests()
     exact = paged["tokens"] == slots["tokens"]
     return {
@@ -678,8 +717,10 @@ def _measure_fleet_remote(*, n_replicas: int = 4,
             retry_base_delay_s=0.0)
 
     obs._reset_for_tests()
+    t_warm = _time.perf_counter()
     drive(ServingFleet(engines()))          # warm the jit caches
     drive(build_remote())
+    compile_s = _time.perf_counter() - t_warm
     # Interleave repetitions and keep the best of each mode: at the
     # tiny model's ~50 ms scale, scheduler noise swamps a single run.
     local = min((drive(ServingFleet(engines())) for _ in range(3)),
@@ -702,6 +743,7 @@ def _measure_fleet_remote(*, n_replicas: int = 4,
     remote_fleet.run()
     replay_ms = (_time.perf_counter() - t0) * 1000.0
     assert remote_fleet.outcome(t2) is not None
+    _stamp_timing("fleet_remote", compile_s, remote["wall_s"])
     obs._reset_for_tests()
     return {
         "replicas": n_replicas,
@@ -776,7 +818,9 @@ def _measure_learner_publish(*, n_replicas: int = 3,
     obs._reset_for_tests()
     # In-process baseline: the trainer-side blocking publish.
     fleet_local, _, _, _ = build()
+    t_warm = _time.perf_counter()
     fleet_local.update_params(params)   # warm
+    compile_s = _time.perf_counter() - t_warm
     t0 = _time.perf_counter()
     for _ in range(n_publishes):
         fleet_local.update_params(params)
@@ -785,7 +829,9 @@ def _measure_learner_publish(*, n_replicas: int = 3,
     # Learner saga over the loopback gateway (stage + poll-to-converge).
     fleet, handler, client, learner = build()
     learner.start()
+    t_warm = _time.perf_counter()
     learner.run_round()                 # warm
+    compile_s += _time.perf_counter() - t_warm
     t0 = _time.perf_counter()
     for _ in range(n_publishes):
         learner.run_round()
@@ -812,6 +858,7 @@ def _measure_learner_publish(*, n_replicas: int = 3,
     recovery_ms = (_time.perf_counter() - t0) * 1000.0
     versions = {r.weight_version for r in fleet.replicas}
     assert versions == {successor.version}, "reconvergence failed"
+    _stamp_timing("learner_publish", compile_s, learner_ms / 1000.0)
     obs._reset_for_tests()
     return {
         "replicas": n_replicas,
@@ -850,7 +897,8 @@ def main() -> None:
     model_name = "qwen2.5-coder-1.5b" if on_accel else "tiny-test"
 
     _log(f"primary decode measure: {model_name}")
-    primary = _measure(model_name, BATCH, PROMPT_LEN, DECODE_TOKENS)
+    primary = _measure(model_name, BATCH, PROMPT_LEN, DECODE_TOKENS,
+                       timing_key="primary")
     _log(f"primary done: {primary:.1f} tok/s")
 
     extra = {}
@@ -880,7 +928,8 @@ def main() -> None:
         ):
             if mode == "scan":
                 try:
-                    extra[key] = round(_measure(name, b, p, n), 2)
+                    extra[key] = round(
+                        _measure(name, b, p, n, timing_key=key), 2)
                     continue
                 except Exception:
                     # Fall through OUTSIDE this handler: the in-flight
@@ -893,7 +942,8 @@ def main() -> None:
             try:
                 extra[key] = round(
                     _measure_steps(name, b, p, n, quantized=quant,
-                                   weight_quant=wq), 2)
+                                   weight_quant=wq,
+                                   timing_key=key), 2)
             except Exception as e:
                 extra[key] = f"error: {type(e).__name__}: {e}"[:200]
 
@@ -909,7 +959,7 @@ def main() -> None:
                 _log(f"extra measure: {key}")
                 extra[key] = round(_measure("qwen2.5-coder-1.5b", BATCH,
                                             PROMPT_LEN, DECODE_TOKENS,
-                                            **kw), 2)
+                                            timing_key=key, **kw), 2)
             except Exception as e:
                 extra[key] = f"error: {type(e).__name__}: {e}"[:200]
 
@@ -921,7 +971,8 @@ def main() -> None:
     for name, b, s, acc, key in train_shapes:
         try:
             _log(f"train measure: {key}")
-            extra[key] = _measure_train(name, b, s, accum_steps=acc)
+            extra[key] = _measure_train(name, b, s, accum_steps=acc,
+                                        timing_key=key)
         except Exception as e:
             extra[key] = f"error: {type(e).__name__}: {e}"[:200]
 
@@ -959,6 +1010,9 @@ def main() -> None:
     except Exception as e:
         extra["learner_publish"] = f"error: {type(e).__name__}: {e}"[:200]
 
+    # Warmup/steady split for every case that ran (satellite of the
+    # runtime observatory: compile_s vs step_s, see TIMINGS).
+    extra["timing"] = dict(sorted(TIMINGS.items()))
     baseline = _baseline()
     metric = (f"decode_tokens_per_sec_per_chip[{model_name}"
               f",b{BATCH},p{PROMPT_LEN}]")

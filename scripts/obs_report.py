@@ -17,6 +17,12 @@ JSONL (``TrainingHealthMonitor.export_jsonl``): signal last/min/max
 and detector trigger counts — the latency table's companion question,
 "and was the learning signal any good while it ran?".
 
+``--runtime`` appends a compile/retrace ledger block from a runtime
+profile JSONL (``RuntimeProfiler.export_jsonl``): per profiled
+function, calls vs compiles vs distinct signatures, compile wall time,
+transfer bytes, and any retrace storms — the OTHER companion question,
+"and did the device spend its time executing or recompiling?".
+
 When the file contains cross-process rpc spans (``rpc.client.*`` /
 ``rpc.server.*`` — see ``obs/propagation.py``), a span-stitching
 section follows the table: how many server spans attached under their
@@ -100,6 +106,57 @@ def render_health(summary: Dict) -> str:
     return "\n".join(lines)
 
 
+def summarize_runtime(path: str) -> List[Dict]:
+    """Rows from a RuntimeProfiler.export_jsonl file (one profiled
+    function per line; torn/blank lines skipped like the span loader)."""
+    import json
+
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "fn" in rec:
+                rows.append(rec)
+    rows.sort(key=lambda r: r.get("compile_ms", 0.0), reverse=True)
+    return rows
+
+
+def render_runtime(rows: List[Dict]) -> str:
+    """Compile/retrace ledger table + storm verdict line."""
+    headers = ("profiled fn", "calls", "compiles", "sigs", "compile_ms",
+               "last_step_ms", "h2d_kb", "d2h_kb", "storms")
+    table = [headers] + [
+        (str(r["fn"]), str(r.get("calls", 0)),
+         str(r.get("compiles", 0)),
+         str(len(r.get("signatures", []))),
+         f"{r.get('compile_ms', 0.0):.1f}",
+         f"{r.get('last_step_ms', 0.0):.3f}",
+         f"{r.get('h2d_bytes', 0) / 1024.0:.1f}",
+         f"{r.get('d2h_bytes', 0) / 1024.0:.1f}",
+         str(r.get("storms", 0))) for r in rows]
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    lines = ["runtime ledger:"]
+    for i, row in enumerate(table):
+        lines.append("  " + "  ".join(
+            cell.ljust(widths[j]) if j == 0 else cell.rjust(widths[j])
+            for j, cell in enumerate(row)))
+        if i == 0:
+            lines.append("  " + "  ".join("-" * w for w in widths))
+    storming = [r["fn"] for r in rows if r.get("storms", 0)]
+    lines.append(
+        "  retrace storms: " + (", ".join(storming) + " — see "
+                                "docs/observability.md runbook"
+                                if storming else "none"))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-stage latency summary of an obs span JSONL.")
@@ -113,6 +170,10 @@ def main(argv=None) -> int:
                         help="training-health ring JSONL "
                              "(TrainingHealthMonitor.export_jsonl) to "
                              "summarize after the latency table")
+    parser.add_argument("--runtime", default=None,
+                        help="runtime profile JSONL "
+                             "(RuntimeProfiler.export_jsonl) to "
+                             "summarize after the latency table")
     args = parser.parse_args(argv)
 
     if not os.path.exists(args.path):
@@ -121,17 +182,19 @@ def main(argv=None) -> int:
     spans = load_span_jsonl(args.path)
     rows = summarize_spans(spans)
     if not rows:
+        # Keep going: the --health/--runtime companion sections are
+        # still meaningful against an empty or torn span file.
         print("obs_report: no spans found (empty or torn file)")
-        return 0
-    reverse = args.sort != "name"
-    rows.sort(key=lambda r: r[args.sort], reverse=reverse)
-    if args.top > 0:
-        rows = rows[: args.top]
-    print(render(rows))
-    total_ms = sum(r["total"] for r in rows)
-    total_spans = sum(r["count"] for r in rows)
-    print(f"\n{total_spans} spans, {total_ms:.1f} ms total "
-          f"(sorted by {args.sort})")
+    else:
+        reverse = args.sort != "name"
+        rows.sort(key=lambda r: r[args.sort], reverse=reverse)
+        if args.top > 0:
+            rows = rows[: args.top]
+        print(render(rows))
+        total_ms = sum(r["total"] for r in rows)
+        total_spans = sum(r["count"] for r in rows)
+        print(f"\n{total_spans} spans, {total_ms:.1f} ms total "
+              f"(sorted by {args.sort})")
     stitch = stitch_summary(spans)
     if stitch["client_spans"] or stitch["server_spans"]:
         print(
@@ -149,6 +212,12 @@ def main(argv=None) -> int:
         sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
         from training_health_report import summarize_ring
         print("\n" + render_health(summarize_ring(args.health)))
+    if args.runtime:
+        if not os.path.exists(args.runtime):
+            print(f"obs_report: no such file: {args.runtime}",
+                  file=sys.stderr)
+            return 2
+        print("\n" + render_runtime(summarize_runtime(args.runtime)))
     return 0
 
 

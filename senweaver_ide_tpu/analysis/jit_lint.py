@@ -76,6 +76,7 @@ RULES: Dict[str, str] = {
 
 # Host modules whose decode/step drivers get the JIT110 sync budget.
 HOT_MODULES: Tuple[str, ...] = (
+    "senweaver_ide_tpu/obs/runtime_profile.py",
     "senweaver_ide_tpu/rollout/engine.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",
     "senweaver_ide_tpu/rollout/sampler.py",
@@ -399,6 +400,10 @@ class _FnChecker:
             # transfers, whose RESULT is host (the call itself is the
             # sync, caught separately).
             return leaf not in ("device_get",)
+        if leaf == "profiled_device_get":
+            # obs.runtime_profile's transfer-accounted jax.device_get:
+            # same semantics — the call is the sync, its result is host.
+            return False
         if head in ("np", "numpy"):
             return False
         if leaf in ("len", "int", "float", "bool", "str", "range",
@@ -455,6 +460,8 @@ class _FnChecker:
             return f".{f.attr}()"
         if name.endswith("device_get") and head in ("jax",):
             return "jax.device_get"
+        if leaf == "profiled_device_get":
+            return "profiled_device_get"
         if head in ("np", "numpy") and leaf in ("asarray", "array"):
             if any(self._device(a) for a in call.args):
                 return f"{head}.{leaf}"

@@ -35,6 +35,9 @@ from .metrics import (Counter, DEFAULT_MS_BUCKETS, Gauge, Histogram,
 from .propagation import (TraceContext, clock_skew_s, extract,
                           format_traceparent, inject, parse_traceparent,
                           server_span)
+from .runtime_profile import (ProfiledFunction, RuntimeProfiler,
+                              get_profiler, profiled_device_get,
+                              sample_memory, set_profiler)
 from .slo import (SECONDS_BUCKETS, SLOConfig, SLOTarget, SLOTracker)
 from .telemetry import StepTelemetry, advantage_stats, estimate_mfu
 from .training_health import (TrainingHealthConfig, TrainingHealthMonitor,
@@ -52,6 +55,8 @@ __all__ = [
     "RequestTimeline", "TimelineRecorder",
     "SLOConfig", "SLOTarget", "SLOTracker",
     "StepTelemetry", "advantage_stats", "estimate_mfu",
+    "ProfiledFunction", "RuntimeProfiler", "get_profiler",
+    "profiled_device_get", "sample_memory", "set_profiler",
     "TrainingHealthConfig", "TrainingHealthMonitor", "evaluate_health",
     "get_health_monitor", "set_health_monitor",
     "get_tracer", "get_registry", "enable", "disable", "is_enabled",
@@ -123,4 +128,5 @@ def _reset_for_tests() -> None:
         _tracer = Tracer(enabled=False)
         _registry = MetricsRegistry()
     set_health_monitor(None)   # next get_health_monitor() rebuilds
+    set_profiler(None)         # next get_profiler() rebuilds
     old.close()

@@ -1,0 +1,705 @@
+"""Runtime performance observatory for jit/pjit callables.
+
+The static analysis pass (analysis/jit_lint.py JIT201-203) PREDICTS
+retrace storms from source shape; this module PROVES what the runtime
+actually did. :class:`ProfiledFunction` wraps a jitted callable and
+maintains, per wrapped function:
+
+- a **compile/retrace ledger**: compile count + compile wall time per
+  distinct abstract signature (shape/dtype fingerprint of the args),
+  cross-checked against the jit cache (``_cache_size``) so a compile is
+  counted only when the runtime really traced, with a named
+  retrace-storm detector (``senweaver_runtime_retrace_storms_total``);
+- per-call **device-time histograms** — with ``block=True`` (the
+  default) the wrapper blocks on the outputs, so the window covers the
+  device step, not just its dispatch. Every wired hot path syncs on its
+  outputs immediately after the call anyway (the engine's single
+  batched ``device_get`` per step), so blocking here moves the existing
+  sync, it does not add one;
+- **host→device transfer accounting**: bytes of host-resident (numpy)
+  leaves fed per call — PR 10 showed the host feed is where wins hide.
+  ``profiled_device_get`` is the device→host counterpart;
+- **XLA cost analysis** (``lowered.compile().cost_analysis()``): FLOPs
+  and bytes touched per compiled signature, turned into
+  achieved-vs-roofline utilization gauges against
+  ``SENWEAVER_PEAK_FLOPS`` / ``SENWEAVER_PEAK_BYTES_PER_SEC``. OFF by
+  default (it costs one extra trace+compile per new signature) — enable
+  with ``get_profiler().set_cost_analysis(True)`` or
+  ``SENWEAVER_RUNTIME_COST_ANALYSIS=1``;
+- **HBM/live-buffer watermark sampling** (:func:`sample_memory`):
+  ``device.memory_stats()`` where the backend provides it (TPU/GPU),
+  degrading to live-array byte accounting on CPU — the gauges carry a
+  ``backend`` label so dashboards never mix CPU and TPU watermarks.
+
+Compile wall time comes from ``jax.monitoring`` duration events
+(``/jax/core/compile/*``) attributed to the in-flight call via a
+thread-local frame stack, so it reflects real trace+lower+backend time
+rather than first-call-minus-steady guesswork.
+
+Everything exports as ``senweaver_runtime_*`` metrics through the
+process-global registry (resolved per publish, so ``_reset_for_tests``
+isolation works) and as a JSONL ledger for ``scripts/obs_report.py
+--runtime``. Profiling is on by default (a handful of dict writes per
+call); ``SENWEAVER_RUNTIME_PROFILE=0`` or
+``get_profiler().set_enabled(False)`` turns the wrappers into plain
+pass-throughs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
+
+import jax
+import numpy as np
+
+from .metrics import DEFAULT_MS_BUCKETS
+
+_COMPILE_EVENT_PREFIX = "/jax/core/compile/"
+
+# Compile-time buckets: compiles run seconds, not microseconds.
+COMPILE_MS_BUCKETS: Tuple[float, ...] = (
+    10.0, 50.0, 100.0, 250.0, 500.0, 1_000.0, 2_500.0, 5_000.0,
+    10_000.0, 30_000.0, 60_000.0, 300_000.0)
+
+_tls = threading.local()
+
+
+def _frames() -> List[Dict[str, float]]:
+    st = getattr(_tls, "frames", None)
+    if st is None:
+        st = _tls.frames = []
+    return st
+
+
+def _on_event_duration(event: str, duration: float, **kw: Any) -> None:
+    """jax.monitoring listener: compile-phase durations land on the
+    innermost in-flight ProfiledFunction call of THIS thread (XLA
+    compiles on the calling thread)."""
+    if not str(event).startswith(_COMPILE_EVENT_PREFIX):
+        return
+    st = getattr(_tls, "frames", None)
+    if st:
+        st[-1]["compile_s"] += float(duration)
+
+
+_listener_lock = threading.Lock()
+_listener_installed = False
+
+
+def _install_compile_listener() -> None:
+    global _listener_installed
+    with _listener_lock:
+        if _listener_installed:
+            return
+        # Mark installed even on failure: an older jax without the
+        # monitoring hook should not re-raise on every profiler build
+        # (the ledger then falls back to signature-novelty timing).
+        _listener_installed = True
+        try:
+            from jax import monitoring
+            monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+        except Exception:
+            pass
+
+
+# -- abstract signatures -------------------------------------------------
+
+def _leaf_fingerprint(x: Any) -> Any:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(shape), str(dtype))
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return x
+    # static config objects (ModelConfig, SampleParams, optimizers):
+    # identity by repr — good enough to separate compile cache keys
+    return repr(x)[:160]
+
+
+def _scan_tree(tree: Any) -> Tuple[Any, int]:
+    """(hashable fingerprint, host-resident bytes) of one argument."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    h2d = 0
+    fps = []
+    for leaf in leaves:
+        fps.append(_leaf_fingerprint(leaf))
+        if isinstance(leaf, np.ndarray):
+            h2d += int(leaf.nbytes)
+    return (treedef, tuple(fps)), h2d
+
+
+def signature_of(args: Sequence[Any], kwargs: Dict[str, Any],
+                 skip_args: Sequence[int] = (),
+                 skip_kwargs: Sequence[str] = ()) -> Tuple[Tuple, int]:
+    """Abstract-signature fingerprint of a call + host-feed bytes.
+
+    ``skip_args``/``skip_kwargs`` name shape-stable arguments (params
+    trees, configs) excluded from the scan — retraces they cause are
+    still COUNTED via the jit cache size, just attributed to the
+    coarser signature."""
+    skip = frozenset(skip_args)
+    skipk = frozenset(skip_kwargs)
+    sig: List[Any] = []
+    h2d = 0
+    for i, a in enumerate(args):
+        if i in skip:
+            sig.append(("skip", i))
+            continue
+        fp, b = _scan_tree(a)
+        sig.append(fp)
+        h2d += b
+    for k in sorted(kwargs):
+        if k in skipk:
+            sig.append(("skip", k))
+            continue
+        fp, b = _scan_tree(kwargs[k])
+        sig.append((k, fp))
+        h2d += b
+    return tuple(sig), h2d
+
+
+def _tree_nbytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+    return total
+
+
+# -- ledger --------------------------------------------------------------
+
+class _SigEntry:
+    __slots__ = ("calls", "compiles", "compile_ms")
+
+    def __init__(self) -> None:
+        self.calls = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+
+
+class _FnLedger:
+    """Per-wrapped-function ledger. All mutation happens under the
+    owning profiler's lock."""
+
+    def __init__(self, name: str, storm_threshold: int,
+                 blocking: bool) -> None:
+        self.name = name
+        self.storm_threshold = storm_threshold
+        self.blocking = blocking
+        self.calls = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+        self.storms = 0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.step_ms_sum = 0.0
+        self.last_step_ms = 0.0
+        self.signatures: Dict[Tuple, _SigEntry] = {}
+        # cost analysis per signature: sig -> (flops, bytes) or None
+        self.cost: Dict[Tuple, Optional[Tuple[float, float]]] = {}
+
+    def snapshot(self) -> Dict[str, Any]:
+        sigs = []
+        for sig, e in self.signatures.items():
+            sigs.append({"key": repr(sig), "calls": e.calls,
+                         "compiles": e.compiles,
+                         "compile_ms": round(e.compile_ms, 3)})
+        costs = [c for c in self.cost.values() if c is not None]
+        flops = max((c[0] for c in costs), default=None)
+        cbytes = max((c[1] for c in costs), default=None)
+        return {
+            "fn": self.name, "calls": self.calls,
+            "compiles": self.compiles,
+            "compile_ms": round(self.compile_ms, 3),
+            "storms": self.storms,
+            "storm_threshold": self.storm_threshold,
+            "h2d_bytes": self.h2d_bytes, "d2h_bytes": self.d2h_bytes,
+            "step_ms_sum": round(self.step_ms_sum, 3),
+            "last_step_ms": round(self.last_step_ms, 3),
+            "blocking": self.blocking,
+            "flops_per_call": flops, "cost_bytes_per_call": cbytes,
+            "signatures": sigs,
+        }
+
+
+class RuntimeProfiler:
+    """Process-global home of every :class:`ProfiledFunction` ledger.
+
+    Publishes ``senweaver_runtime_*`` into the global metrics registry
+    (re-resolved whenever the global is swapped, so test isolation via
+    ``obs._reset_for_tests`` holds)."""
+
+    def __init__(self, *, enabled: Optional[bool] = None,
+                 cost_analysis: Optional[bool] = None):
+        if enabled is None:
+            enabled = os.environ.get(
+                "SENWEAVER_RUNTIME_PROFILE", "1") != "0"
+        if cost_analysis is None:
+            cost_analysis = os.environ.get(
+                "SENWEAVER_RUNTIME_COST_ANALYSIS", "0") == "1"
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._ledgers: Dict[str, _FnLedger] = {}    # guarded-by: _lock
+        self._cost_analysis = cost_analysis
+        self._registry = None                        # guarded-by: _lock
+        self._instruments: Dict[str, Any] = {}       # guarded-by: _lock
+        self._hbm_watermark: Dict[str, float] = {}   # guarded-by: _lock
+        self.storm_events: List[Dict[str, Any]] = []  # guarded-by: _lock
+        _install_compile_listener()
+
+    # -- switches ----------------------------------------------------------
+    def set_enabled(self, on: bool) -> None:
+        self.enabled = bool(on)
+
+    def set_cost_analysis(self, on: bool) -> None:
+        self._cost_analysis = bool(on)
+
+    @property
+    def cost_analysis_enabled(self) -> bool:
+        return self._cost_analysis
+
+    # -- instruments -------------------------------------------------------
+    def _metrics(self) -> Dict[str, Any]:
+        """Instrument cache keyed to the CURRENT global registry;
+        rebuilt when the global is swapped (test isolation)."""
+        from . import get_registry
+        reg = get_registry()
+        with self._lock:
+            if reg is self._registry:
+                return self._instruments
+            ins = {
+                "calls": reg.counter(
+                    "senweaver_runtime_calls_total",
+                    "Profiled jit-callable invocations.",
+                    labelnames=("fn",)),
+                "compiles": reg.counter(
+                    "senweaver_runtime_compiles_total",
+                    "Traces+compiles observed per profiled callable "
+                    "(one per distinct abstract signature on a healthy "
+                    "path).", labelnames=("fn",)),
+                "compile_ms": reg.histogram(
+                    "senweaver_runtime_compile_ms",
+                    "Wall time of each observed trace+compile.",
+                    labelnames=("fn",), buckets=COMPILE_MS_BUCKETS),
+                "step_ms": reg.histogram(
+                    "senweaver_runtime_step_ms",
+                    "Per-call wall time (device window when the "
+                    "wrapper blocks on outputs, dispatch otherwise).",
+                    labelnames=("fn",), buckets=DEFAULT_MS_BUCKETS),
+                "storms": reg.counter(
+                    "senweaver_runtime_retrace_storms_total",
+                    "Retrace-storm detector trips: compiles exceeded "
+                    "the per-fn threshold AND outnumber cache reuse "
+                    "(runtime counterpart of static JIT201-203).",
+                    labelnames=("fn",)),
+                "transfer": reg.counter(
+                    "senweaver_runtime_transfer_bytes_total",
+                    "Host<->device bytes moved by profiled calls.",
+                    labelnames=("fn", "direction")),
+                "signatures": reg.gauge(
+                    "senweaver_runtime_signatures",
+                    "Distinct abstract signatures seen per callable "
+                    "(the compile-cache footprint).",
+                    labelnames=("fn",)),
+                "flops": reg.gauge(
+                    "senweaver_runtime_flops_per_call",
+                    "XLA cost_analysis FLOPs of the largest compiled "
+                    "signature.", labelnames=("fn",)),
+                "cost_bytes": reg.gauge(
+                    "senweaver_runtime_bytes_per_call",
+                    "XLA cost_analysis bytes accessed of the largest "
+                    "compiled signature.", labelnames=("fn",)),
+                "achieved": reg.gauge(
+                    "senweaver_runtime_achieved_flops_per_sec",
+                    "cost_analysis FLOPs / measured device window of "
+                    "the last profiled call.", labelnames=("fn",)),
+                "roofline": reg.gauge(
+                    "senweaver_runtime_roofline_utilization",
+                    "Achieved / peak per resource (peaks from "
+                    "SENWEAVER_PEAK_FLOPS and "
+                    "SENWEAVER_PEAK_BYTES_PER_SEC).",
+                    labelnames=("fn", "resource")),
+            }
+            self._registry = reg
+            self._instruments = ins
+            return ins
+
+    # -- ledger access -----------------------------------------------------
+    def _ledger(self, name: str, storm_threshold: int,
+                blocking: bool) -> _FnLedger:
+        with self._lock:
+            led = self._ledgers.get(name)
+            if led is None:
+                led = self._ledgers[name] = _FnLedger(
+                    name, storm_threshold, blocking)
+            return led
+
+    def ledger(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-friendly snapshot of every function's ledger."""
+        with self._lock:
+            return {name: led.snapshot()
+                    for name, led in self._ledgers.items()}
+
+    def export_jsonl(self, path: str) -> int:
+        """One JSON line per profiled function (obs_report --runtime
+        reads this); returns the number of lines written."""
+        snap = self.ledger()
+        with open(path, "w") as f:
+            for name in sorted(snap):
+                f.write(json.dumps(snap[name]) + "\n")
+        return len(snap)
+
+    def flops_per_call(self, name: str) -> Optional[float]:
+        """Largest cost_analysis FLOPs figure recorded for ``name``
+        (None until a compiled signature was analyzed)."""
+        with self._lock:
+            led = self._ledgers.get(name)
+            if led is None:
+                return None
+            costs = [c[0] for c in led.cost.values() if c is not None]
+            return max(costs) if costs else None
+
+    def utilization(self, name: str) -> Optional[Dict[str, float]]:
+        """Achieved FLOP/s (and utilization vs SENWEAVER_PEAK_FLOPS)
+        from the last blocking call's device window."""
+        with self._lock:
+            led = self._ledgers.get(name)
+            if led is None or not led.blocking or led.last_step_ms <= 0:
+                return None
+            costs = [c[0] for c in led.cost.values() if c is not None]
+            if not costs:
+                return None
+            achieved = max(costs) / (led.last_step_ms / 1_000.0)
+        out = {"achieved_flops_per_sec": achieved}
+        peak = _env_float("SENWEAVER_PEAK_FLOPS")
+        if peak:
+            out["utilization"] = achieved / peak
+        return out
+
+    # -- recording ---------------------------------------------------------
+    def account_transfer(self, name: str, nbytes: int,
+                         direction: str = "h2d") -> None:
+        if not self.enabled or nbytes <= 0:
+            return
+        led = self._ledger(name, 10, False)
+        with self._lock:
+            if direction == "d2h":
+                led.d2h_bytes += int(nbytes)
+            else:
+                led.h2d_bytes += int(nbytes)
+        self._metrics()["transfer"].inc(
+            int(nbytes), fn=name, direction=direction)
+
+    def maybe_cost_analysis(self, pf: "ProfiledFunction", sig: Tuple,
+                            args: Tuple, kwargs: Dict[str, Any]
+                            ) -> Optional[Tuple[float, float]]:
+        """Once per new signature when enabled: AOT lower+compile the
+        wrapped callable and read flops / bytes accessed. Best-effort —
+        any failure caches None so it is never retried per call."""
+        led = self._ledger(pf.profile_name, pf.storm_threshold, pf.block)
+        with self._lock:
+            if not self._cost_analysis or sig in led.cost:
+                return led.cost.get(sig)
+        cost: Optional[Tuple[float, float]] = None
+        try:
+            lowered = pf.wrapped.lower(*args, **kwargs)
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if isinstance(ca, dict):
+                cost = (float(ca.get("flops", 0.0)),
+                        float(ca.get("bytes accessed", 0.0)))
+        except Exception:
+            cost = None
+        with self._lock:
+            led.cost[sig] = cost
+        return cost
+
+    def record_call(self, pf: "ProfiledFunction", sig: Tuple, *,
+                    compiled: bool, compile_s: float, step_ms: float,
+                    h2d_bytes: int,
+                    cost: Optional[Tuple[float, float]]) -> None:
+        name = pf.profile_name
+        led = self._ledger(name, pf.storm_threshold, pf.block)
+        compile_ms = compile_s * 1_000.0
+        storm = False
+        with self._lock:
+            led.calls += 1
+            led.step_ms_sum += step_ms
+            led.last_step_ms = step_ms
+            led.h2d_bytes += h2d_bytes
+            entry = led.signatures.get(sig)
+            if entry is None:
+                entry = led.signatures[sig] = _SigEntry()
+            entry.calls += 1
+            if compiled:
+                led.compiles += 1
+                led.compile_ms += compile_ms
+                entry.compiles += 1
+                entry.compile_ms += compile_ms
+                # Storm: the compile set exceeded its budget AND the
+                # cache is missing more often than it hits — a healthy
+                # bucket ladder amortizes (calls >> compiles).
+                if (led.compiles >= led.storm_threshold
+                        and led.compiles * 2 > led.calls):
+                    storm = True
+                    led.storms += 1
+                    self.storm_events.append({
+                        "fn": name, "compiles": led.compiles,
+                        "calls": led.calls, "signature": repr(sig)})
+                    del self.storm_events[:-50]
+            n_sigs = len(led.signatures)
+        ins = self._metrics()
+        ins["calls"].inc(fn=name)
+        ins["step_ms"].observe(step_ms, fn=name)
+        ins["signatures"].set(n_sigs, fn=name)
+        if h2d_bytes > 0:
+            ins["transfer"].inc(h2d_bytes, fn=name, direction="h2d")
+        if compiled:
+            ins["compiles"].inc(fn=name)
+            ins["compile_ms"].observe(compile_ms, fn=name)
+        if storm:
+            ins["storms"].inc(fn=name)
+        if cost is not None:
+            flops, cbytes = cost
+            ins["flops"].set(flops, fn=name)
+            ins["cost_bytes"].set(cbytes, fn=name)
+            if pf.block and step_ms > 0:
+                step_s = step_ms / 1_000.0
+                ins["achieved"].set(flops / step_s, fn=name)
+                peak = _env_float("SENWEAVER_PEAK_FLOPS")
+                if peak:
+                    ins["roofline"].set(flops / step_s / peak,
+                                        fn=name, resource="flops")
+                peak_bw = _env_float("SENWEAVER_PEAK_BYTES_PER_SEC")
+                if peak_bw and cbytes:
+                    ins["roofline"].set(cbytes / step_s / peak_bw,
+                                        fn=name, resource="bytes")
+
+    # -- HBM / live-buffer watermarks --------------------------------------
+    def sample_memory(self) -> Dict[str, Dict[str, Any]]:
+        """Per-backend memory watermarks, published with a ``backend``
+        label. Uses ``device.memory_stats()`` where the runtime
+        provides it; a backend without stats (CPU) degrades to
+        live-array byte accounting — never raises."""
+        from . import get_registry
+        reg = get_registry()
+        in_use = reg.gauge(
+            "senweaver_runtime_hbm_bytes_in_use",
+            "Device memory in use (memory_stats where available, "
+            "live-array bytes otherwise).", labelnames=("backend",))
+        limit_g = reg.gauge(
+            "senweaver_runtime_hbm_bytes_limit",
+            "Device memory capacity (memory_stats backends only).",
+            labelnames=("backend",))
+        peak_g = reg.gauge(
+            "senweaver_runtime_hbm_watermark_bytes",
+            "High-water mark of device memory in use.",
+            labelnames=("backend",))
+        live_g = reg.gauge(
+            "senweaver_runtime_live_buffer_bytes",
+            "Bytes held by live jax arrays (the CPU fallback "
+            "accounting, sampled everywhere for cross-checks).",
+            labelnames=("backend",))
+        by_backend: Dict[str, Dict[str, Any]] = {}
+        try:
+            devices = jax.devices()
+        except Exception:
+            devices = []
+        for d in devices:
+            platform = getattr(d, "platform", "unknown")
+            agg = by_backend.setdefault(
+                platform, {"backend": platform, "source": "live_arrays",
+                           "bytes_in_use": 0, "bytes_limit": 0,
+                           "peak_bytes": 0})
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats:
+                agg["source"] = "memory_stats"
+                agg["bytes_in_use"] += int(stats.get("bytes_in_use", 0))
+                agg["bytes_limit"] += int(stats.get("bytes_limit", 0))
+                agg["peak_bytes"] += int(
+                    stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0)))
+        live_bytes = 0
+        try:
+            for a in jax.live_arrays():
+                try:
+                    live_bytes += int(a.nbytes)
+                except Exception:
+                    continue
+        except Exception:
+            live_bytes = 0
+        for platform, agg in by_backend.items():
+            if agg["source"] == "live_arrays":
+                agg["bytes_in_use"] = live_bytes
+                agg["peak_bytes"] = live_bytes
+            agg["live_buffer_bytes"] = live_bytes
+            with self._lock:
+                peak = max(self._hbm_watermark.get(platform, 0.0),
+                           float(agg["peak_bytes"]),
+                           float(agg["bytes_in_use"]))
+                self._hbm_watermark[platform] = peak
+            agg["watermark_bytes"] = peak
+            in_use.set(agg["bytes_in_use"], backend=platform)
+            peak_g.set(peak, backend=platform)
+            live_g.set(live_bytes, backend=platform)
+            if agg["bytes_limit"]:
+                limit_g.set(agg["bytes_limit"], backend=platform)
+        return by_backend
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+# -- the wrapper ---------------------------------------------------------
+
+class ProfiledFunction:
+    """Transparent profiling wrapper around a jit/pjit callable.
+
+    Call syntax, donation, and static-arg handling pass through
+    untouched; ``.lower``/``._cache_size``/etc. delegate to the wrapped
+    callable. The GLOBAL profiler is resolved per call (same pattern as
+    ``obs.get_tracer``), so swapping it for test isolation works.
+
+    ``skip_args``/``skip_kwargs`` name shape-stable arguments (params
+    trees, static configs) left out of the per-call signature scan to
+    keep wrapper overhead off the hot path; retraces they cause are
+    still counted via the jit cache size. ``block=False`` preserves
+    async-dispatch semantics (trainer) at the price of the step
+    histogram recording dispatch rather than device time.
+    """
+
+    def __init__(self, fn: Callable, name: str, *,
+                 skip_args: Sequence[int] = (),
+                 skip_kwargs: Sequence[str] = (),
+                 block: bool = True,
+                 storm_threshold: int = 10,
+                 mem_every: int = 64):
+        self._fn = fn
+        self.profile_name = name
+        self.skip_args = tuple(skip_args)
+        self.skip_kwargs = tuple(skip_kwargs)
+        self.block = block
+        self.storm_threshold = int(storm_threshold)
+        self.mem_every = int(mem_every)
+        self._mem_countdown = int(mem_every)
+
+    @property
+    def wrapped(self) -> Callable:
+        return self._fn
+
+    @property
+    def __wrapped__(self) -> Callable:
+        return self._fn
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._fn, item)
+
+    def __repr__(self) -> str:
+        return f"ProfiledFunction({self.profile_name!r})"
+
+    def _cache_len(self) -> int:
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return -1
+        try:
+            return int(probe())
+        except Exception:
+            return -1
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        prof = get_profiler()
+        if not prof.enabled:
+            return self._fn(*args, **kwargs)
+        sig, h2d = signature_of(args, kwargs, self.skip_args,
+                                self.skip_kwargs)
+        # AOT cost analysis BEFORE the call: donated buffers are still
+        # alive, and its compile events stay out of the timed frame.
+        cost = prof.maybe_cost_analysis(self, sig, args, kwargs)
+        size0 = self._cache_len()
+        frame = {"compile_s": 0.0}
+        st = _frames()
+        st.append(frame)
+        t0 = time.perf_counter()
+        try:
+            out = self._fn(*args, **kwargs)
+            if self.block:
+                out = jax.block_until_ready(out)
+        finally:
+            st.pop()
+        step_ms = (time.perf_counter() - t0) * 1_000.0
+        size1 = self._cache_len()
+        if size0 >= 0:
+            compiled = size1 > size0
+        else:
+            compiled = frame["compile_s"] > 0.0
+        prof.record_call(self, sig, compiled=compiled,
+                         compile_s=frame["compile_s"], step_ms=step_ms,
+                         h2d_bytes=h2d, cost=cost)
+        self._mem_countdown -= 1
+        if self._mem_countdown <= 0:
+            self._mem_countdown = self.mem_every
+            try:
+                prof.sample_memory()
+            except Exception:
+                pass
+        return out
+
+
+def wrap(fn: Callable, name: str, **kwargs: Any) -> ProfiledFunction:
+    """Sugar: ``_step = runtime_profile.wrap(_step, "engine.step")``."""
+    return ProfiledFunction(fn, name, **kwargs)
+
+
+def profiled_device_get(tree: Any, fn: str = "host") -> Any:
+    """``jax.device_get`` with device→host bytes accounted to ``fn``
+    (``senweaver_runtime_transfer_bytes_total{direction="d2h"}``)."""
+    out = jax.device_get(tree)
+    prof = get_profiler()
+    if prof.enabled:
+        prof.account_transfer(fn, _tree_nbytes(out), direction="d2h")
+    return out
+
+
+# -- process-global profiler ---------------------------------------------
+
+_profiler_lock = threading.Lock()
+_profiler: Optional[RuntimeProfiler] = None
+
+
+def get_profiler() -> RuntimeProfiler:
+    global _profiler
+    with _profiler_lock:
+        if _profiler is None:
+            _profiler = RuntimeProfiler()
+        return _profiler
+
+
+def set_profiler(profiler: Optional[RuntimeProfiler]) -> None:
+    """Swap the global (None → rebuild lazily). Test isolation hook,
+    called from ``obs._reset_for_tests``."""
+    global _profiler
+    with _profiler_lock:
+        _profiler = profiler
+
+
+def sample_memory() -> Dict[str, Dict[str, Any]]:
+    """Module-level convenience: sample HBM/live-buffer watermarks via
+    the global profiler."""
+    return get_profiler().sample_memory()

@@ -16,12 +16,15 @@ publishes:
 - ``senweaver_step_flops_per_sec`` and, when a peak-FLOPs figure is
   known, ``senweaver_mfu``.
 
-MFU is the standard analytic estimate: a dense decoder step costs
-``6 * params * tokens`` FLOPs (fwd 2x + bwd 4x), so
-``mfu = 6 * N * tokens / (step_s * peak_flops)``. Peak FLOPs comes from
-the constructor or the ``SENWEAVER_PEAK_FLOPS`` env var (e.g. 1.97e14
-for a v5e chip in bf16); without it the absolute achieved FLOP/s gauge
-still publishes.
+MFU: when the runtime observatory (``obs/runtime_profile.py``) has an
+XLA ``cost_analysis()`` FLOPs figure for the profiled GRPO step, the
+``senweaver_mfu`` gauge publishes the MEASURED utilization — compiled
+FLOPs per update over the round's wall time — instead of the analytic
+``6 * params * tokens`` estimate (fwd 2x + bwd 4x), which remains the
+fallback when cost analysis is off. ``mfu_source`` in the returned dict
+says which one you got. Peak FLOPs comes from the constructor or the
+``SENWEAVER_PEAK_FLOPS`` env var (e.g. 1.97e14 for a v5e chip in bf16);
+without it the absolute achieved FLOP/s gauge still publishes.
 """
 
 from __future__ import annotations
@@ -111,12 +114,14 @@ class StepTelemetry:
             "Trajectories (one per LLM call) collected.")
         self._flops = r.gauge(
             "senweaver_step_flops_per_sec",
-            "Achieved model FLOP/s of the last train step (6N/token "
-            "analytic estimate).")
+            "Achieved model FLOP/s of the last train step "
+            "(cost_analysis-measured when the runtime ledger has the "
+            "GRPO step, 6N/token analytic estimate otherwise).")
         self._mfu = r.gauge(
             "senweaver_mfu",
             "Model-FLOPs utilization of the last train step "
-            "(vs. peak_flops).")
+            "(vs. peak_flops; measured or analytic per "
+            "senweaver_step_flops_per_sec).")
         self._zero_adv_frac = r.gauge(
             "senweaver_grpo_zero_advantage_group_fraction",
             "Fraction of last round's GRPO groups with identical "
@@ -183,9 +188,28 @@ class StepTelemetry:
             std = health.get("advantage_std")
             if std is not None:
                 self._adv_std.set(float(std))
-        if self.param_count and train_s > 0:
+        # Measured MFU (PR 11): the runtime observatory's cost_analysis
+        # FLOPs for the profiled GRPO step, over the round's measured
+        # update time, REPLACES the 6N/token analytic estimate whenever
+        # the ledger has it (cost analysis is opt-in; see
+        # obs/runtime_profile.py). One update call per ppo epoch.
+        measured_fps = None
+        if train_s > 0:
+            from .runtime_profile import get_profiler
+            fpc = get_profiler().flops_per_call("trainer.grpo_step")
+            if fpc:
+                measured_fps = fpc * max(1, ppo_epochs) / train_s
+        if measured_fps is not None:
+            out["step_flops_per_sec"] = measured_fps
+            out["mfu_source"] = "cost_analysis"
+            self._flops.set(measured_fps)
+            if self.peak_flops:
+                out["mfu"] = measured_fps / self.peak_flops
+                self._mfu.set(out["mfu"])
+        elif self.param_count and train_s > 0:
             flops_per_sec = 6.0 * self.param_count * train_tokens / train_s
             out["step_flops_per_sec"] = flops_per_sec
+            out["mfu_source"] = "analytic"
             self._flops.set(flops_per_sec)
             if self.peak_flops:
                 out["mfu"] = estimate_mfu(self.param_count, train_tokens,

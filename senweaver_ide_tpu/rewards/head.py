@@ -30,6 +30,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs.runtime_profile import ProfiledFunction
 from ..traces import features as F
 from ..traces.schema import Trace
 from ..traces.features import trace_features
@@ -178,7 +179,11 @@ def reward_head(feat: jax.Array) -> RewardOutput:
 
 
 # Jitted batch scorer: (B, N_FEATURES) -> RewardOutput of (B, 9)/(B, 9)/(B,).
-reward_head_batch = jax.jit(jax.vmap(reward_head))
+# Profiled (obs/runtime_profile.py): batch-size variety is the expected
+# retrace axis here — the ledger shows whether callers bucket batches.
+reward_head_batch = ProfiledFunction(
+    jax.jit(jax.vmap(reward_head)), "reward.head_batch",
+    storm_threshold=32)
 _reward_head_jit = jax.jit(reward_head)
 
 

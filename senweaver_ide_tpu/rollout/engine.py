@@ -37,6 +37,7 @@ from ..models.config import ModelConfig
 from ..models.transformer import (KVCache, Params, forward, forward_paged,
                                   init_kv_cache)
 from ..obs import get_registry, get_tracer
+from ..obs.runtime_profile import ProfiledFunction, profiled_device_get
 from ..ops.sampling import sample_token, sampled_logprob
 from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
                        copy_blocks, gather_blocks, init_paged_pool,
@@ -257,6 +258,19 @@ def _paged_fused_step(params: Params, config: ModelConfig,
                             top_k=sample.top_k, top_p=sample.top_p)
     logp = sampled_logprob(logits, next_tok)
     return next_tok, logp, pool_k, pool_v
+
+
+# Runtime observatory wiring (obs/runtime_profile.py): the two step
+# drivers keep their compile/retrace ledger and device-time histograms
+# under these names. Params/config (args 0-1) are shape-stable and
+# skipped from the per-call signature scan; the fused step's storm
+# threshold covers its LEGITIMATE compile ladder (power-of-two table
+# widths x token-batch widths) so only unbounded retraces trip it.
+_pool_decode_step = ProfiledFunction(
+    _pool_decode_step, "engine.decode_step", skip_args=(0, 1))
+_paged_fused_step = ProfiledFunction(
+    _paged_fused_step, "engine.fused_step", skip_args=(0, 1),
+    storm_threshold=64)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -623,8 +637,9 @@ class RolloutEngine:
             # were three blocking roundtrips. device_get still blocks on
             # the device step, so the span spans the actual decode, not
             # just its dispatch.
-            toks, logps, lengths = jax.device_get(
-                (next_tok, logp, self.cache.length))
+            toks, logps, lengths = profiled_device_get(
+                (next_tok, logp, self.cache.length),
+                fn="engine.decode_step")
         if tracer.enabled:
             reg = get_registry()
             reg.counter("senweaver_engine_decode_steps_total",
@@ -1570,7 +1585,8 @@ class RolloutEngine:
             # ONE batched device→host transfer per fused step (the
             # analysis JIT110 budget), covering decode tokens AND the
             # first tokens of completing prefills.
-            toks, logps = jax.device_get((next_tok, logp))
+            toks, logps = profiled_device_get((next_tok, logp),
+                                              fn="engine.fused_step")
         n_emitted = 0
         for idx, row, req in decode_rows:
             tok = int(toks[idx])

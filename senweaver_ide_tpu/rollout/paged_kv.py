@@ -49,6 +49,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.transformer import ModelConfig
+from ..obs.runtime_profile import ProfiledFunction
 
 
 class BlocksExhausted(RuntimeError):
@@ -354,3 +355,15 @@ def gather_blocks(pool: PagedKVPool, idx: jnp.ndarray):
     k = pool.k[:, idx].reshape(l, n * bs, hkv, dh)
     v = pool.v[:, idx].reshape(l, n * bs, hkv, dh)
     return k, v
+
+
+# Runtime observatory wiring (obs/runtime_profile.py): block movement is
+# the prefix import/export + COW cost the KV-economics roadmap item
+# needs numbers for. The block-count ladder makes a handful of
+# signatures per pool shape legitimate; only unbounded growth storms.
+copy_blocks = ProfiledFunction(copy_blocks, "paged_kv.copy",
+                               storm_threshold=32)
+install_blocks = ProfiledFunction(install_blocks, "paged_kv.install",
+                                  storm_threshold=32)
+gather_blocks = ProfiledFunction(gather_blocks, "paged_kv.gather",
+                                 storm_threshold=32)

@@ -44,6 +44,7 @@ import numpy as np
 from ..models.config import ModelConfig
 from ..models.transformer import (KVCache, Params, forward, forward_paged,
                                   init_kv_cache)
+from ..obs.runtime_profile import ProfiledFunction
 from .paged_kv import PagedKVPool, PagedSeqKV
 
 
@@ -73,6 +74,17 @@ def _verify_forward_paged(params: Params, config: ModelConfig,
     if last_only:
         logits = logits[-1:]
     return logits, pool_k, pool_v
+
+
+# Runtime observatory wiring (obs/runtime_profile.py): the verify
+# forwards are the speculative hot path — their ledger shows whether
+# draft-length variation induces retraces (the k-ladder should bound
+# the compile set) and what each verify window costs on device.
+_verify_forward = ProfiledFunction(
+    _verify_forward, "speculative.verify", skip_args=(0, 1))
+_verify_forward_paged = ProfiledFunction(
+    _verify_forward_paged, "speculative.verify_paged", skip_args=(0, 1),
+    storm_threshold=32)
 
 
 def _truncate(cache: KVCache, length: int) -> KVCache:

@@ -152,6 +152,7 @@ class DashboardService:
         out["serving"] = self._serving_summary()
         out["kv_pool"] = self._kv_pool_summary()
         out["slo"] = self._slo_summary()
+        out["runtime"] = self._runtime_summary()
         return out
 
     def _resilience_summary(self) -> Dict[str, Any]:
@@ -433,6 +434,59 @@ class DashboardService:
         except Exception as e:
             return {"error": str(e)}
 
+    def _runtime_summary(self) -> Dict[str, Any]:
+        """Runtime observatory tile: compile/retrace ledger, transfer
+        bytes, and HBM watermarks from the global
+        :class:`~..obs.runtime_profile.RuntimeProfiler` (zero wiring —
+        any ProfiledFunction in the process shows up). Totals come from
+        the ledger itself rather than the ``senweaver_runtime_*``
+        series so the tile works even when profiling ran against a
+        since-swapped registry; the watermark gauges are registry-read
+        because memory sampling is per-backend."""
+        def label_max(name: str) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            vals = [float(v) for v in m.samples().values()]
+            return max(vals) if vals else None
+
+        try:
+            from ..obs.runtime_profile import get_profiler
+            mb = 1024.0 * 1024.0
+            rows = []
+            calls = compiles = storms = h2d = d2h = 0
+            for name, snap in sorted(get_profiler().ledger().items()):
+                calls += snap["calls"]
+                compiles += snap["compiles"]
+                storms += snap["storms"]
+                h2d += snap["h2d_bytes"]
+                d2h += snap["d2h_bytes"]
+                rows.append({
+                    "fn": name, "calls": snap["calls"],
+                    "compiles": snap["compiles"],
+                    "signatures": len(snap["signatures"]),
+                    "compile_ms": snap["compile_ms"],
+                    "last_step_ms": snap["last_step_ms"],
+                    "storms": snap["storms"],
+                })
+            wm = label_max("senweaver_runtime_hbm_watermark_bytes")
+            live = label_max("senweaver_runtime_live_buffer_bytes")
+            return {
+                "calls": calls, "compiles": compiles,
+                "retrace_storms": storms,
+                "h2d_mb": round(h2d / mb, 3),
+                "d2h_mb": round(d2h / mb, 3),
+                "hbm_watermark_mb":
+                    round(wm / mb, 1) if wm is not None else None,
+                "live_buffer_mb":
+                    round(live / mb, 3) if live is not None else None,
+                "roofline_utilization":
+                    label_max("senweaver_runtime_roofline_utilization"),
+                "functions": rows,
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
     def _obs_summary(self) -> Dict[str, Any]:
         """Span counts, top-5 slowest spans, and the live throughput
         gauges — the obs tile's data (and /api/state's view of what the
@@ -636,6 +690,9 @@ input[type=text], input[type=password], textarea {
 <div id="slo-exemplars"></div></section>
 <section><h2>Learner &amp; autoscaler</h2>
 <div id="learner" class="tiles"></div></section>
+<section><h2>Runtime</h2>
+<div id="runtime" class="tiles"></div>
+<div id="runtime-fns"></div></section>
 <section><h2>Engine serving counters</h2><div id="engine"></div></section>
 <section><h2>APO</h2>
 <div class="actionbar">
@@ -917,6 +974,22 @@ async function refresh() {
     ["autoscale adds", sv.autoscale_adds],
     ["autoscale drains", sv.autoscale_drains],
     ["shed rate (1/s)", sv.autoscale_shed_rate]]);
+  const rt = s.runtime || {};
+  tiles(document.getElementById("runtime"), [
+    ["profiled calls", rt.calls],
+    ["compiles", rt.compiles],
+    ["retrace storms", rt.retrace_storms],
+    ["h2d MB", rt.h2d_mb],
+    ["d2h MB", rt.d2h_mb],
+    ["hbm watermark MB", rt.hbm_watermark_mb],
+    ["live buffers MB", rt.live_buffer_mb],
+    ["roofline util", rt.roofline_utilization]]);
+  document.getElementById("runtime-fns").innerHTML = table(
+    (rt.functions || []).map(f => [f.fn, f.calls, f.compiles,
+                                   f.signatures, f.compile_ms,
+                                   f.last_step_ms, f.storms]),
+    ["profiled fn", "calls", "compiles", "sigs", "compile ms",
+     "last step ms", "storms"]);
   const eng = s.engine || {};
   document.getElementById("engine").innerHTML = table(
     Object.entries(eng).map(([k, v]) => [k, fmt(v)]), ["counter", "value"]);

@@ -29,13 +29,18 @@ DEFAULT_TOKEN_THRESHOLDS: Dict[str, int] = {
 
 
 class PerformanceMonitor:
-    """Stage timing with threshold warnings, optionally metric-captured.
+    """Stage timing with threshold warnings, metric-bridged.
 
-    ``registry`` (obs.MetricsRegistry) bridges every recorded stage into
-    the new metrics plane: ``senweaver_stage_ms{stage=...}`` histograms
-    and a ``senweaver_perf_warnings_total{stage=...}`` counter — legacy
-    callers keep their snapshot()/warnings surface and the /metrics
-    endpoint sees the same data."""
+    Every recorded stage lands in the ``obs.MetricsRegistry`` plane:
+    ``senweaver_stage_ms{stage=...}`` histograms and a
+    ``senweaver_perf_warnings_total{stage=...}`` counter. ``registry``
+    defaults to the PROCESS-GLOBAL registry (``obs.get_registry()``) so
+    there is ONE exporter — the monitor's legacy snapshot()/warnings
+    surface and the /metrics endpoint always describe the same data.
+    Pass an explicit registry to bridge elsewhere, or ``registry=False``
+    to keep a monitor off the metrics plane entirely. Instruments are
+    cached at construction (the documented ``_reset_for_tests``
+    contract: bridged monitors keep their registry by design)."""
 
     def __init__(self, metrics=None,
                  thresholds_ms: Optional[Dict[str, float]] = None,
@@ -49,7 +54,10 @@ class PerformanceMonitor:
         self.timings: Dict[str, float] = {}       # last value per stage
         self.warnings: list = []
         self._stage_hist = self._warn_counter = None
-        if registry is not None:
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        if registry is not False:
             self._stage_hist = registry.histogram(
                 "senweaver_stage_ms",
                 "PerformanceMonitor stage wall times.",

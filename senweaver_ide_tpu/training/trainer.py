@@ -20,6 +20,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.config import ModelConfig
 from ..models.transformer import Params, forward, init_params
+from ..obs.runtime_profile import ProfiledFunction
 from ..parallel.mesh import make_mesh
 from ..parallel.sharding import (param_shardings, param_specs,
                                  restrict_spec, shard_params)
@@ -262,6 +263,18 @@ def _grpo_step(state: TrainState, config: ModelConfig,
 
 # Default optimizer instance reused across steps (hashable for jit statics).
 _DEFAULT_OPT = make_optimizer()
+
+# Runtime observatory wiring (obs/runtime_profile.py): compile/retrace
+# ledger for the GRPO update. ``block=False`` keeps the async-dispatch
+# contract below (the span comment in train_step) — the step histogram
+# records dispatch; device time stays with rl_loop's train_s, which
+# obs/telemetry.py combines with this ledger's cost_analysis FLOPs for
+# the measured MFU. State/config/optimizer trees are shape-stable and
+# skipped from the signature scan (retraces they cause still count via
+# the jit cache).
+_grpo_step = ProfiledFunction(
+    _grpo_step, "trainer.grpo_step", skip_args=(0, 1, 2),
+    skip_kwargs=("mesh", "lora_base"), block=False)
 
 
 def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],

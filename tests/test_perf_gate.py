@@ -1,0 +1,106 @@
+"""Perf-regression gate (scripts/perf_gate.py): the hermetic selftest
+and the comparator's unit semantics — bands, missing metrics, cached
+refusal, and timed-window contamination. The live measurement cases run
+in CI's perf-gate job, not here (tier-1 stays fast)."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+import senweaver_ide_tpu.obs as obs
+
+
+def _load_gate():
+    path = (pathlib.Path(__file__).resolve().parents[1] / "scripts"
+            / "perf_gate.py")
+    spec = importlib.util.spec_from_file_location("perf_gate_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+def test_selftest_passes():
+    gate = _load_gate()
+    assert gate.main(["--selftest"]) == 0
+
+
+def test_comparator_flags_out_of_band_regression():
+    gate = _load_gate()
+    baseline = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010, "band": 2.0}}}
+    bad = {"cached": False,
+           "metrics": {"m": {"step_s": 0.025, "steady_compiles": 0}}}
+    problems = gate.compare(bad, baseline)
+    assert problems and "exceeds" in problems[0]
+
+
+def test_comparator_passes_in_band_run():
+    gate = _load_gate()
+    baseline = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010, "band": 2.0}}}
+    ok = {"cached": False,
+          "metrics": {"m": {"step_s": 0.019, "steady_compiles": 0}}}
+    assert gate.compare(ok, baseline) == []
+
+
+def test_comparator_flags_missing_metric():
+    gate = _load_gate()
+    baseline = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010}}}
+    assert any("missing" in p
+               for p in gate.compare({"cached": False, "metrics": {}},
+                                     baseline))
+
+
+def test_comparator_refuses_cached_evidence():
+    gate = _load_gate()
+    baseline = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010}}}
+    ok = {"cached": False,
+          "metrics": {"m": {"step_s": 0.010, "steady_compiles": 0}}}
+    assert gate.compare({**ok, "cached": True}, baseline)
+    assert gate.compare(ok, {**baseline, "cached": True})
+    poisoned = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010, "cached": True}}}
+    assert any("cached" in p for p in gate.compare(poisoned, baseline))
+
+
+def test_comparator_flags_contaminated_steady_window():
+    gate = _load_gate()
+    baseline = {"cached": False,
+                "metrics": {"m": {"step_s": 0.010, "band": 2.0}}}
+    dirty = {"cached": False,
+             "metrics": {"m": {"step_s": 0.005, "steady_compiles": 3}}}
+    assert any("timed window" in p for p in gate.compare(dirty, baseline))
+
+
+def test_gate_passes_vacuously_without_baseline(tmp_path, monkeypatch):
+    # A fresh checkout (or a branch that deleted the baseline) must not
+    # hard-fail CI — but also must not silently compare against junk.
+    gate = _load_gate()
+    assert gate._load_baseline(str(tmp_path / "missing.json")) is None
+    (tmp_path / "junk.json").write_text("[1, 2, 3]\n")
+    assert gate._load_baseline(str(tmp_path / "junk.json")) is None
+
+
+def test_committed_baseline_is_usable():
+    import json
+
+    gate = _load_gate()
+    baseline = gate._load_baseline(gate.BASELINE_PATH)
+    assert baseline is not None, "PERF_BASELINE.json missing/unreadable"
+    assert baseline.get("cached") is False
+    assert set(baseline["metrics"]) == set(gate.CASES)
+    for name, entry in baseline["metrics"].items():
+        assert entry["step_s"] > 0, name
+        assert entry.get("band", gate.DEFAULT_BAND) >= 1.5, name
+    # the artifact is committed: it must be valid JSON on disk too
+    json.load(open(gate.BASELINE_PATH))

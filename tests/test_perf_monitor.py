@@ -57,6 +57,27 @@ def test_registry_bridge_observes_stages():
     assert warns.value(stage="ok") == 0
 
 
+def test_default_monitor_bridges_to_global_registry():
+    """One exporter, not two: a bare PerformanceMonitor() lands its
+    stages in the process-global obs registry (the /metrics endpoint),
+    and registry=False is the explicit opt-out."""
+    from senweaver_ide_tpu import obs
+    obs._reset_for_tests()
+    try:
+        pm = PerformanceMonitor()
+        pm.record_ms("bridge_check", 3.0)
+        hist = obs.get_registry().get("senweaver_stage_ms")
+        assert hist is not None
+        assert hist.snapshot(stage="bridge_check")["count"] == 1
+
+        off = PerformanceMonitor(registry=False)
+        off.record_ms("unbridged", 1.0)
+        assert hist.snapshot(stage="unbridged")["count"] == 0
+        assert off.snapshot()["unbridged"] == 1.0
+    finally:
+        obs._reset_for_tests()
+
+
 def test_stage_context_manager():
     pm = PerformanceMonitor()
     with pm.stage("batch_build"):
