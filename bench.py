@@ -872,6 +872,107 @@ def _measure_learner_publish(*, n_replicas: int = 3,
     }
 
 
+def _measure_spec_adaptive(*, num_slots: int = 4, n_requests: int = 12,
+                           decode_tokens: int = 24) -> dict:
+    """Concurrency-adaptive speculation economics (ISSUE 12): the same
+    overloaded workload served with a FIXED depth-8 draft vs the
+    adaptive controller. The acceptance signal is
+    ``wasted_ratio_adaptive < wasted_ratio_fixed`` — under a saturated
+    fleet the controller throttles speculation so rejected draft
+    tokens stop stealing verify compute — plus an idle-engine arm
+    showing the controller sitting at the deepest rung where
+    speculation is near-free. Greedy outputs are asserted identical
+    across all arms (speculation only ever moves throughput)."""
+    import dataclasses as _dc
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.rollout.spec_controller import (
+        SpecController, SpecControllerConfig)
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    draft_cfg = _dc.replace(config, num_layers=2, name="tiny-draft")
+    draft = jax.block_until_ready(
+        init_params(draft_cfg, jax.random.PRNGKey(1)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompts = [[(i * 7 + j) % 200 + 2 for j in range(8)]
+               for i in range(n_requests)]
+
+    def run(mode: str) -> dict:
+        obs._reset_for_tests()
+        eng = RolloutEngine(
+            params, config, num_slots=num_slots, max_len=128,
+            sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged"))
+        if mode == "fixed":
+            eng.enable_speculation(draft, draft_cfg, depth=8)
+        elif mode == "adaptive":
+            eng.enable_speculation(
+                draft, draft_cfg, controller=SpecController(
+                    SpecControllerConfig(hysteresis_steps=2)))
+        rids = [eng.submit(p, max_new_tokens=decode_tokens)
+                for p in prompts]
+        # The router's backlog signal for a saturated replica.
+        eng.note_decode_load(float(n_requests * decode_tokens))
+        t0 = _time.perf_counter()
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        s = eng.spec_stats() if mode != "off" else {}
+        return {"tok_s": sum(len(out[r]) for r in rids) / dt,
+                "tokens": [out[r] for r in rids],
+                "proposed": s.get("proposed", 0),
+                "wasted": s.get("wasted_draft_tokens", 0)}
+
+    t_warm = _time.perf_counter()
+    for m in ("off", "fixed", "adaptive"):
+        run(m)              # compile warmup, all arms
+    compile_s = _time.perf_counter() - t_warm
+    off = run("off")
+    fixed = run("fixed")
+    t0 = _time.perf_counter()
+    adaptive = run("adaptive")
+    _stamp_timing("spec_adaptive", compile_s,
+                  _time.perf_counter() - t0)
+
+    # Idle arm: one light request; the controller should sit deep.
+    obs._reset_for_tests()
+    eng = RolloutEngine(
+        params, config, num_slots=num_slots, max_len=128, sample=greedy,
+        engine_config=EngineConfig(kv_layout="paged"))
+    eng.enable_speculation(
+        draft, draft_cfg,
+        controller=SpecController(SpecControllerConfig(hysteresis_steps=2)))
+    rid = eng.submit(prompts[0], max_new_tokens=decode_tokens)
+    idle_tokens = eng.run()[rid]
+    idle_depth = eng.spec_stats()["depth"]
+    obs._reset_for_tests()
+
+    emitted = sum(len(t) for t in off["tokens"])
+    exact = (fixed["tokens"] == off["tokens"]
+             == adaptive["tokens"])
+    return {
+        "num_slots": num_slots,
+        "n_requests": n_requests,
+        "outputs_exact": exact and idle_tokens == off["tokens"][0],
+        "off_tok_s": round(off["tok_s"], 1),
+        "fixed8_tok_s": round(fixed["tok_s"], 1),
+        "adaptive_tok_s": round(adaptive["tok_s"], 1),
+        "fixed8_wasted_draft_tokens": fixed["wasted"],
+        "adaptive_wasted_draft_tokens": adaptive["wasted"],
+        "fixed8_wasted_per_token": round(fixed["wasted"] / emitted, 3),
+        "adaptive_wasted_per_token": round(
+            adaptive["wasted"] / emitted, 3),
+        "idle_controller_depth": idle_depth,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -993,6 +1094,15 @@ def main() -> None:
         extra["paged_vs_slots"] = _measure_paged_vs_slots()
     except Exception as e:
         extra["paged_vs_slots"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Concurrency-adaptive speculation economics (fixed depth-8 vs the
+    # depth controller under an overloaded fleet). Protocol-level, so
+    # tiny-test covers it on every backend.
+    try:
+        _log("speculation measure: spec_adaptive")
+        extra["spec_adaptive"] = _measure_spec_adaptive()
+    except Exception as e:
+        extra["spec_adaptive"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Cross-host dispatch economics (loopback remote fleet vs the same
     # engines in-process) plus held-slot continuation replay latency.

@@ -8,6 +8,8 @@ model, deterministically enough to run per-commit in CI (tier1.yml
 
 - ``engine_decode``: paged fused-step decode through RolloutEngine
   (ledger fn ``engine.fused_step``),
+- ``spec_decode``: the same workload with a depth-4 draft fused into
+  the step (ledger fns ``engine.fused_step`` + ``engine.spec_propose``),
 - ``train_step``: one GRPO update via training.trainer.train_step
   (ledger fn ``trainer.grpo_step``),
 - ``reward_head``: the jitted batch reward scorer
@@ -148,6 +150,49 @@ def _case_engine_decode() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_spec_decode() -> Dict[str, Any]:
+    """The fused draft+verify speculative step (ISSUE 12): same paged
+    workload as ``engine_decode`` but with a depth-4 draft riding the
+    fused step. Gates BOTH that the spec path stays steady-state
+    compile-free and that the fused step doesn't regress with
+    speculation fused in."""
+    import dataclasses
+
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    draft_cfg = dataclasses.replace(config, num_layers=2,
+                                    name="tiny-draft")
+    draft = jax.block_until_ready(
+        init_params(draft_cfg, jax.random.PRNGKey(1)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompts = [[(i * 7 + j) % 200 + 2 for j in range(16)]
+               for i in range(4)]
+
+    def run():
+        eng = RolloutEngine(params, config, num_slots=4, max_len=128,
+                            sample=greedy,
+                            engine_config=EngineConfig(kv_layout="paged"))
+        eng.enable_speculation(draft, draft_cfg, depth=4)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=24)
+        eng.run()
+
+    run()                                   # warmup: compiles land here
+    c0 = _ledger_compiles("engine.spec_propose")
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    leaked += _ledger_compiles("engine.spec_propose") - c0
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")
+            + _ledger_compiles("engine.spec_propose")}
+
+
 def _case_train_step() -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -206,6 +251,7 @@ def _case_reward_head() -> Dict[str, Any]:
 
 CASES = {
     "engine_decode": _case_engine_decode,
+    "spec_decode": _case_spec_decode,
     "train_step": _case_train_step,
     "reward_head": _case_reward_head,
 }

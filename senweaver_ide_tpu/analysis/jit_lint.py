@@ -80,8 +80,10 @@ HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/rollout/engine.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",
     "senweaver_ide_tpu/rollout/sampler.py",
+    "senweaver_ide_tpu/rollout/spec_controller.py",
     "senweaver_ide_tpu/rollout/speculative.py",
     "senweaver_ide_tpu/serve/replica.py",
+    "senweaver_ide_tpu/training/draft_distill.py",
 )
 
 # Attribute reads that are STATIC under tracing even on a tracer:

@@ -260,17 +260,87 @@ def _paged_fused_step(params: Params, config: ModelConfig,
     return next_tok, logp, pool_k, pool_v
 
 
+@functools.partial(jax.jit, static_argnames=("config", "k", "use_kernel"),
+                   donate_argnames=("pool_k", "pool_v"))
+def _draft_propose_scan(params: Params, config: ModelConfig,
+                        cur_tok: jax.Array, base_pos: jax.Array,
+                        spec_mask: jax.Array, tables: jax.Array,
+                        pool_k: jax.Array, pool_v: jax.Array,
+                        k: int, use_kernel: bool):
+    """Greedy draft proposal loop, entirely on device: ``k`` sequential
+    draft-model decode steps over every speculating row at once
+    (``spec_mask``), each feeding its own argmax back in. One device
+    call and ONE host transfer replace k round-trips; ``k`` is static
+    so every speculation depth is its own pre-compiled bucket. Rows
+    outside the mask write to the sentinel block (dropped by the
+    scatter) and their proposals are ignored by the host. Returns
+    ``(proposals (R, k) int32, pool_k', pool_v')``."""
+    r = tables.shape[0]
+    mb = tables.shape[1]
+    nb = pool_k.shape[1]
+    bs = pool_k.shape[2]
+    seq_row = jnp.arange(r, dtype=jnp.int32)
+
+    def body(carry, _i):
+        pk, pv, tok, pos = carry
+        lb = jnp.clip(pos // bs, 0, mb - 1)
+        wb = jnp.where(spec_mask & (pos // bs < mb),
+                       tables[seq_row, lb], nb)
+        logits, pk, pv = forward_paged(
+            params, config, tok, pool_k=pk, pool_v=pv, tables=tables,
+            seq_row=seq_row, positions=pos, write_block=wb,
+            write_off=pos % bs, use_kernel=use_kernel)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(spec_mask, nxt, tok)
+        return (pk, pv, nxt, pos + 1), nxt
+
+    (pool_k, pool_v, _tok, _pos), props = jax.lax.scan(
+        body, (pool_k, pool_v, cur_tok, base_pos),
+        jnp.arange(k, dtype=jnp.int32))
+    return props.T, pool_k, pool_v
+
+
+@functools.partial(jax.jit, static_argnames=("config", "use_kernel"),
+                   donate_argnames=("pool_k", "pool_v"))
+def _draft_feed_step(params: Params, config: ModelConfig,
+                     tokens: jax.Array, tables: jax.Array,
+                     seq_row: jax.Array, positions: jax.Array,
+                     write_block: jax.Array, write_off: jax.Array,
+                     pool_k: jax.Array, pool_v: jax.Array,
+                     use_kernel: bool):
+    """Draft-cache catch-up: run the draft model over a flat token
+    batch purely for its KV writes (logits discarded, no transfer).
+    This is how the draft reaches lockstep with the target after
+    prefill, continuations, preemption resume, rollback, or a depth-0
+    stretch — the host replays the already-known token stream."""
+    _logits, pool_k, pool_v = forward_paged(
+        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+        tables=tables, seq_row=seq_row, positions=positions,
+        write_block=write_block, write_off=write_off,
+        use_kernel=use_kernel)
+    return pool_k, pool_v
+
+
 # Runtime observatory wiring (obs/runtime_profile.py): the two step
 # drivers keep their compile/retrace ledger and device-time histograms
 # under these names. Params/config (args 0-1) are shape-stable and
 # skipped from the per-call signature scan; the fused step's storm
 # threshold covers its LEGITIMATE compile ladder (power-of-two table
-# widths x token-batch widths) so only unbounded retraces trip it.
+# widths x token-batch widths x speculation depths) so only unbounded
+# retraces trip it. The draft propose/feed steps get the same
+# treatment: their ladders are (table-bucket x depth) and
+# (table-bucket x feed-width bucket) respectively.
 _pool_decode_step = ProfiledFunction(
     _pool_decode_step, "engine.decode_step", skip_args=(0, 1))
 _paged_fused_step = ProfiledFunction(
     _paged_fused_step, "engine.fused_step", skip_args=(0, 1),
     storm_threshold=64)
+_draft_propose_scan = ProfiledFunction(
+    _draft_propose_scan, "engine.spec_propose", skip_args=(0, 1),
+    storm_threshold=32)
+_draft_feed_step = ProfiledFunction(
+    _draft_feed_step, "engine.spec_feed", skip_args=(0, 1),
+    storm_threshold=32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -327,6 +397,58 @@ class _PrefillJob:
 class _RowPreempted(Exception):
     """Internal: the row being assembled lost its blocks to
     reclamation and was requeued — skip it for this step."""
+
+
+class _DraftMetricsView:
+    """Registry adapter for the draft block allocator: re-prefixes the
+    ``senweaver_kv_*`` series to ``senweaver_spec_draft_kv_*`` so the
+    draft pool's bookkeeping doesn't overwrite the target pool's
+    gauges."""
+
+    def __init__(self, registry):
+        self._registry = registry
+
+    @staticmethod
+    def _rename(name: str) -> str:
+        return name.replace("senweaver_kv_", "senweaver_spec_draft_kv_")
+
+    def gauge(self, name, desc=""):
+        return self._registry.gauge(self._rename(name), desc)
+
+    def counter(self, name, desc=""):
+        return self._registry.counter(self._rename(name), desc)
+
+
+@dataclasses.dataclass
+class _SpecState:
+    """Host-side state for fused speculative decoding (one per engine,
+    created by :meth:`RolloutEngine.enable_speculation`). All fields
+    are guarded by the engine lock."""
+
+    params: Params
+    config: ModelConfig
+    controller: object          # SpecController / FixedDepth duck type
+    alloc: BlockAllocator       # draft KV block pool bookkeeping
+    version: int = 0            # draft weight version (publish fence)
+    # target publishes seen vs. the target version the draft was last
+    # distilled/installed against: staleness = target_version - synced
+    target_version: int = 0
+    draft_synced_at: int = 0
+    # WeightPublisher.begin already stamped the in-flight publish; the
+    # engine-level install consumes the stamp instead of double-counting
+    publish_pending: bool = False
+    ema: float = 0.0            # acceptance-rate EMA (reset on publish)
+    ema_init: bool = False
+    depth_applied: int = 0      # controller depth used by the last step
+    # verification outcomes for the online distiller (training/
+    # draft_distill.py): bounded ring of {context, targets, accepted}
+    ctx_window: int = 64
+    outcomes: Deque = dataclasses.field(
+        default_factory=lambda: deque(maxlen=512))
+    depth_gauge: object = None
+    accept_gauge: object = None
+    staleness_gauge: object = None
+    wasted_total: object = None
 
 
 @dataclasses.dataclass
@@ -474,7 +596,10 @@ class RolloutEngine:
                        "prefix_cache_hits": 0, "prefix_cache_misses": 0,
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
-                       "hold_evictions": 0, "kv_preemptions": 0}
+                       "hold_evictions": 0, "kv_preemptions": 0,
+                       "spec_rounds": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "spec_wasted": 0,
+                       "spec_feed_tokens": 0, "spec_rollbacks": 0}
         # Bounded admission (None = legacy unbounded): submit() raises
         # QueueFull past this many QUEUED requests — in-flight slots and
         # continuations (which bypass the queue) don't count.
@@ -498,6 +623,15 @@ class RolloutEngine:
         self.max_prefixes = max(1, int(max_prefixes))
         self._prefix_last_use: Dict[int, int] = {}  # guarded-by: _lock
         self._prefix_use_seq = 0                # guarded-by: _lock
+        # Fused speculation (enable_speculation): draft model + its own
+        # block pool, in lockstep with the target rows. None = off.
+        self._spec: Optional[_SpecState] = None  # guarded-by: _lock
+        self._draft_tables: List[List[int]] = []  # guarded-by: _lock
+        self._draft_len: List[int] = []         # guarded-by: _lock
+        self._draft_pool = None                 # guarded-by: _lock
+        # fleet load signal (remaining decode tokens) pushed by the
+        # serving replica for the depth controller; None = standalone
+        self._spec_fleet_tokens: Optional[float] = None  # guarded-by: _lock
         # Many agent loops (subagent threads) drive one engine: all state
         # mutation is serialized; concurrency = slots, not host threads.
         self._lock = threading.RLock()
@@ -536,6 +670,184 @@ class RolloutEngine:
             # reason: continuations after a sync must re-prefill.
             for slot in range(self.num_slots):
                 self._drop_hold(slot)
+            # The draft is now distilled against a dead policy: stamp
+            # it stale and reset the acceptance EMA (mirroring the
+            # prefix drop above) — unless the fleet publisher already
+            # stamped this publish at begin() time.
+            if self._spec is not None:
+                if self._spec.publish_pending:
+                    self._spec.publish_pending = False
+                else:
+                    self._spec_mark_stale()
+
+    # -- fused speculative decoding ----------------------------------------
+
+    def enable_speculation(self, draft_params: Params,
+                           draft_config: ModelConfig, *,
+                           controller=None, depth: Optional[int] = None,
+                           num_blocks: Optional[int] = None,
+                           version: int = 0) -> None:
+        """Turn on fused speculative decoding: a draft model proposes
+        up to ``depth`` tokens per row and the target verifies them
+        INSIDE the engine's single jitted step, sharing the
+        ``step_tokens`` budget with chunked prefill and continuous
+        batching. Greedy acceptance (proposal == target argmax) keeps
+        outputs byte-identical to non-speculative decode.
+
+        ``controller`` picks the depth per step from load
+        (spec_controller.SpecController, the default); ``depth`` pins a
+        fixed depth instead. The draft serves from its own block pool
+        (``num_blocks``; default sized like the target's) whose
+        gauges publish under ``senweaver_spec_draft_kv_*``."""
+        from .spec_controller import FixedDepth, SpecController
+        if self.kv_layout != "paged":
+            raise ValueError(
+                "fused speculation needs the paged KV layout (engine "
+                f"fell back to slots: {self.kv_layout_fallback})")
+        if self.sample.temperature > 0:
+            raise ValueError(
+                "fused speculation is greedy-only: construct the "
+                "engine with sample.temperature == 0")
+        if draft_config.vocab_size != self.config.vocab_size:
+            raise ValueError(
+                f"draft vocab {draft_config.vocab_size} != target "
+                f"vocab {self.config.vocab_size}")
+        with self._lock:
+            if controller is None:
+                controller = (FixedDepth(int(depth)) if depth is not None
+                              else SpecController())
+            bs = self._alloc.block_size
+            nb = int(num_blocks) if num_blocks else (
+                (self.num_slots + 2) * self._blocks_per_row)
+            reg = get_registry()
+            self._spec = _SpecState(
+                params=draft_params, config=draft_config,
+                controller=controller, version=int(version),
+                alloc=BlockAllocator(nb, bs,
+                                     registry=_DraftMetricsView(reg)),
+                depth_gauge=reg.gauge(
+                    "senweaver_spec_depth",
+                    "Applied speculation depth of the most recently "
+                    "stepped engine (0 = speculation off)."),
+                accept_gauge=reg.gauge(
+                    "senweaver_spec_acceptance_rate",
+                    "EMA of the draft-token acceptance rate (reset on "
+                    "weight publish)."),
+                staleness_gauge=reg.gauge(
+                    "senweaver_spec_draft_staleness",
+                    "Target weight publishes since the draft was last "
+                    "republished (0 = draft tracks the policy)."),
+                wasted_total=reg.counter(
+                    "senweaver_spec_wasted_draft_tokens",
+                    "Draft tokens proposed but rejected by "
+                    "verification (pure wasted draft+verify work)."))
+            self._draft_pool = init_paged_pool(draft_config, nb, bs)
+            self._draft_tables = [[] for _ in range(self.num_slots)]
+            self._draft_len = [0] * self.num_slots
+            self._spec.staleness_gauge.set(0.0)
+
+    def update_draft_params(self, params: Params, *,
+                            version: Optional[int] = None) -> None:
+        """Install republished draft weights (the online distiller's
+        output). Draft rows are dropped — their KV came from the old
+        draft — and re-fed from the host token stream by catch-up; the
+        acceptance EMA restarts so the gauge reflects the new draft.
+        Never blocks on in-flight requests: draft weights cannot
+        affect output correctness, only the acceptance rate."""
+        with self._lock:
+            sp = self._spec
+            if sp is None:
+                raise RuntimeError("enable_speculation() first")
+            sp.params = params
+            sp.version = sp.version + 1 if version is None else int(version)
+            sp.draft_synced_at = sp.target_version
+            for row in range(self.num_slots):
+                self._draft_release_row(row)
+            self._spec_reset_ema()
+            sp.staleness_gauge.set(0.0)
+
+    def spec_note_publish_begin(self) -> None:
+        """Fleet hook (serve/weights.py WeightPublisher.begin): the
+        policy is about to change — version-stamp the draft stale and
+        reset the acceptance EMA NOW, mirroring how prefix refcounts
+        are dropped, instead of trusting stats from a draft that no
+        longer matches the policy being rolled out."""
+        with self._lock:
+            if self._spec is None:
+                return
+            self._spec.publish_pending = True
+            self._spec_mark_stale()
+
+    def _spec_mark_stale(self) -> None:
+        # guarded-by: caller
+        sp = self._spec
+        sp.target_version += 1
+        self._spec_reset_ema()
+        sp.staleness_gauge.set(sp.target_version - sp.draft_synced_at)
+
+    def _spec_reset_ema(self) -> None:
+        # guarded-by: caller
+        sp = self._spec
+        sp.ema = 0.0
+        sp.ema_init = False
+        sp.accept_gauge.set(0.0)
+
+    def set_spec_depth(self, depth: int) -> None:
+        """Pin the speculation depth (tests, manual override)."""
+        with self._lock:
+            sp = self._spec
+            if sp is None:
+                raise RuntimeError("enable_speculation() first")
+            if hasattr(sp.controller, "force_depth"):
+                sp.controller.force_depth(depth)
+            else:
+                sp.controller.value = int(depth)
+
+    def note_decode_load(self, remaining_tokens: float) -> None:
+        """Serving-replica hook: push the router's remaining-decode-
+        token gauge for this replica so the depth controller sees fleet
+        load, not just local occupancy."""
+        with self._lock:
+            self._spec_fleet_tokens = float(remaining_tokens)
+
+    def drain_spec_outcomes(self) -> List[dict]:
+        """Hand the buffered verification outcomes (context, the
+        target-chosen tokens, accepted count) to the online distiller
+        and clear the ring."""
+        with self._lock:
+            if self._spec is None:
+                return []
+            out = list(self._spec.outcomes)
+            self._spec.outcomes.clear()
+            return out
+
+    def spec_stats(self) -> Dict[str, object]:
+        """Speculation snapshot: depth, acceptance EMA, staleness,
+        proposal/acceptance counters."""
+        with self._lock:
+            sp = self._spec
+            if sp is None:
+                return {"enabled": False}
+            return {
+                "enabled": True,
+                "depth": sp.depth_applied,
+                "acceptance_ema": sp.ema if sp.ema_init else None,
+                "draft_version": sp.version,
+                "draft_staleness": sp.target_version - sp.draft_synced_at,
+                "rounds": self._stats["spec_rounds"],
+                "proposed": self._stats["spec_proposed"],
+                "accepted": self._stats["spec_accepted"],
+                "wasted_draft_tokens": self._stats["spec_wasted"],
+                "draft_feed_tokens": self._stats["spec_feed_tokens"],
+                "draft_blocks_free": sp.alloc.free_blocks,
+            }
+
+    def spec_check_leaks(self) -> None:
+        """Tripwire for tests: after all rows release, the DRAFT pool
+        must be fully free too (rollback/preemption/finish paths)."""
+        with self._lock:
+            if self._spec is not None:
+                self._spec.alloc.check_leaks()
 
     # -- public API ---------------------------------------------------------
 
@@ -1235,11 +1547,208 @@ class RolloutEngine:
 
     def _release_row(self, row: int) -> None:
         # guarded-by: caller
-        """Drop the row's reference on every block of its table."""
+        """Drop the row's reference on every block of its table (and
+        the draft pool's mirror row when speculation is on)."""
         if self._tables[row]:
             self._alloc.release(self._tables[row])
         self._tables[row] = []
         self._row_len[row] = 0
+        if self._spec is not None:
+            self._draft_release_row(row)
+
+    # -- fused-speculation internals ----------------------------------------
+
+    def _draft_release_row(self, row: int) -> None:
+        # guarded-by: caller
+        sp = self._spec
+        if sp is None or not self._draft_tables:
+            return
+        if self._draft_tables[row]:
+            sp.alloc.release(self._draft_tables[row])
+        self._draft_tables[row] = []
+        self._draft_len[row] = 0
+
+    def _draft_ensure_range(self, row: int, pos: int, n: int) -> bool:
+        # guarded-by: caller
+        """Make positions ``pos .. pos+n-1`` writable in the draft
+        row's table (append-only — the draft pool has no sharing, so
+        no COW). Returns False on draft-pool exhaustion: the row
+        simply doesn't speculate this step (never preempts — the
+        draft pool must not disturb target scheduling)."""
+        sp = self._spec
+        bs = sp.alloc.block_size
+        table = self._draft_tables[row]
+        for j in range(n):
+            lb = (pos + j) // bs
+            if lb < len(table):
+                continue
+            if lb > len(table):
+                return False
+            try:
+                table.append(sp.alloc.alloc(1)[0])
+            except BlocksExhausted:
+                return False
+        return True
+
+    def _draft_tables_device(self) -> np.ndarray:
+        # guarded-by: caller
+        """Dense draft block-table array, power-of-two bucketed like
+        :meth:`_tables_device` (host numpy for the same one-transfer
+        ingest reason)."""
+        widest = max((len(t) for t in self._draft_tables), default=0)
+        mb = 1
+        while mb < widest:
+            mb *= 2
+        mb = min(self._blocks_per_row, mb)
+        arr = np.zeros((self.num_slots, mb), np.int32)
+        for s, tbl in enumerate(self._draft_tables):
+            if tbl:
+                arr[s, :len(tbl)] = tbl
+        return arr
+
+    def _spec_observe_depth(self) -> int:
+        # guarded-by: caller
+        """Feed the controller this step's load signals; returns the
+        applied (hysteresis-filtered) ladder depth."""
+        sp = self._spec
+        active = sum(r is not None for r in self._slot_req)
+        occupancy = min(1.0, (active + len(self._queue)) / self.num_slots)
+        kv_pressure = self._alloc.used_blocks / self._alloc.num_blocks
+        k = sp.controller.observe(
+            occupancy=occupancy, kv_pressure=kv_pressure,
+            decode_tokens=self._spec_fleet_tokens,
+            num_slots=self.num_slots)
+        sp.depth_applied = k
+        sp.depth_gauge.set(k)
+        return k
+
+    def _spec_catch_up(self) -> None:
+        # guarded-by: caller
+        """Replay already-known tokens into draft rows that fell behind
+        the target (fresh prefill, continuation delta, preemption
+        resume, rollback, depth-0 stretch), under the step-token
+        budget. One draft forward for all lagging rows."""
+        sp = self._spec
+        bs = sp.alloc.block_size
+        budget = self._step_tokens
+        entries = []                    # (tok, row, pos, wb, wo)
+        advanced = []                   # (row, n)
+        for row in range(self.num_slots):
+            req = self._slot_req[row]
+            if req is None or req.rid in self._prefill_jobs:
+                continue
+            if budget <= 0:
+                break
+            gap = self._row_len[row] - self._draft_len[row]
+            if gap < 0:
+                # target rolled behind the draft outside a spec round
+                # (shouldn't happen): resync by dropping the draft row
+                self._draft_release_row(row)
+                gap = self._row_len[row]
+            if gap == 0:
+                continue
+            stream = req.prompt + req.tokens[:-1]
+            start = self._draft_len[row]
+            n = min(gap, budget)
+            if not self._draft_ensure_range(row, start, n):
+                continue
+            table = self._draft_tables[row]
+            for j in range(n):
+                p = start + j
+                entries.append((stream[p], row, p, table[p // bs],
+                                p % bs))
+            advanced.append((row, n))
+            budget -= n
+        if not entries:
+            return
+        t = _bucket(len(entries), max(16, self.num_slots))
+        nb = sp.alloc.num_blocks
+        toks = np.zeros((t,), np.int32)
+        rows = np.zeros((t,), np.int32)
+        pos = np.zeros((t,), np.int32)
+        wb = np.full((t,), nb, np.int32)    # sentinel-padded
+        wo = np.zeros((t,), np.int32)
+        for i, (tok, r, p, b, o) in enumerate(entries):
+            toks[i], rows[i], pos[i], wb[i], wo[i] = tok, r, p, b, o
+        dk, dv = _draft_feed_step(
+            sp.params, sp.config, toks, self._draft_tables_device(),
+            rows, pos, wb, wo, self._draft_pool.k, self._draft_pool.v,
+            self._use_paged_kernel)
+        self._draft_pool = PagedKVPool(k=dk, v=dv)
+        for row, n in advanced:
+            self._draft_len[row] += n
+        self._stats["spec_feed_tokens"] += len(entries)
+
+    def _spec_begin_step(self) -> tuple:
+        # guarded-by: caller
+        """Pre-step speculation phase: observe load → depth, catch the
+        draft cache up, then run the on-device draft proposal scan for
+        every row in lockstep. Returns ``(depth, {row: proposals})``
+        — empty plan when speculation is off or depth is 0."""
+        sp = self._spec
+        if sp is None:
+            return 0, {}
+        k = self._spec_observe_depth()
+        self._spec_catch_up()
+        if k <= 0:
+            return 0, {}
+        rows = []
+        for row in range(self.num_slots):
+            req = self._slot_req[row]
+            if (req is None or req.rid in self._prefill_jobs
+                    or not req.tokens):
+                continue
+            p = self._row_len[row]
+            if self._draft_len[row] != p:
+                continue        # draft not in lockstep yet
+            if p + k > self.max_len or p + 1 >= self.context_bound - 1:
+                continue        # would finish this step anyway
+            if not self._draft_ensure_range(row, p, k):
+                continue        # draft pool pressure: skip, don't block
+            rows.append(row)
+        if not rows:
+            return k, {}
+        r = self.num_slots
+        cur = np.zeros((r,), np.int32)
+        base = np.zeros((r,), np.int32)
+        mask = np.zeros((r,), bool)
+        for row in rows:
+            cur[row] = self._cur_tok_host[row]
+            base[row] = self._row_len[row]
+            mask[row] = True
+        props_dev, dk, dv = _draft_propose_scan(
+            sp.params, sp.config, cur, base, mask,
+            self._draft_tables_device(), self._draft_pool.k,
+            self._draft_pool.v, k, self._use_paged_kernel)
+        self._draft_pool = PagedKVPool(k=dk, v=dv)
+        props = profiled_device_get(props_dev, fn="engine.spec_propose")
+        plan = {}
+        for row in rows:
+            plan[row] = [int(x) for x in props[row]]
+            self._draft_len[row] = self._row_len[row] + k
+        return k, plan
+
+    def _spec_rollback(self, row: int, new_len: int) -> None:
+        # guarded-by: caller
+        """Truncate both the target row and its draft mirror to the
+        verified prefix: blocks past ``blocks_for(new_len)`` go back to
+        their pools (the PagedSeqKV.truncate contract — stale entries
+        in the kept partial block sit at positions the causal mask
+        never reads and the next write overwrites)."""
+        keep = self._alloc.blocks_for(new_len)
+        table = self._tables[row]
+        if len(table) > keep:
+            self._alloc.release(table[keep:])
+            del table[keep:]
+        self._row_len[row] = new_len
+        sp = self._spec
+        dtable = self._draft_tables[row]
+        dkeep = sp.alloc.blocks_for(new_len)
+        if len(dtable) > dkeep:
+            sp.alloc.release(dtable[dkeep:])
+            del dtable[dkeep:]
+        self._draft_len[row] = min(self._draft_len[row], new_len)
+        self._stats["spec_rollbacks"] += 1
 
     def _preempt_row(self, row: int) -> None:
         # guarded-by: caller
@@ -1474,12 +1983,14 @@ class RolloutEngine:
         self._prefill_jobs[req.rid] = _PrefillJob(
             toks=list(req.prompt), pos=0, sample_last=True)
 
-    def _assemble_paged_plan(self):
+    def _assemble_paged_plan(self, spec_plan=None, depth: int = 0):
         # guarded-by: caller
         """Build the flat token batch for one fused step: one decode
-        entry per active row, then exact-size chunked-prefill segments
-        round-robined in row order under the remaining token budget.
-        Returns None when there is nothing to run."""
+        entry per active row — or ``depth`` verify entries for rows
+        with draft proposals (``spec_plan``) — then exact-size
+        chunked-prefill segments round-robined in row order under the
+        remaining token budget. Returns None when there is nothing to
+        run."""
         nb = self._alloc.num_blocks
         bs = self._alloc.block_size
         toks_l: List[int] = []
@@ -1488,6 +1999,7 @@ class RolloutEngine:
         wb_l: List[int] = []
         wo_l: List[int] = []
         decode_rows = []           # (entry_idx, row, req)
+        spec_rows = []             # (entry_idx, row, req, proposals, start)
         job_rows = []              # (row, req, job, n, last_idx, wrote)
         committed: set = set()
         for row in range(self.num_slots):
@@ -1495,6 +2007,27 @@ class RolloutEngine:
             if req is None or req.rid in self._prefill_jobs:
                 continue
             p = self._row_len[row]
+            props = spec_plan.get(row) if spec_plan else None
+            if props:
+                # verify window: [pending] + proposals[:-1] — entry i's
+                # logits are the target's argmax judging proposal i
+                feed = [self._cur_tok_host[row]] + list(props[:-1])
+                staged = []
+                try:
+                    for j, ftok in enumerate(feed):
+                        wb = self._ensure_block(row, p + j, committed)
+                        staged.append((ftok, p + j, wb, (p + j) % bs))
+                except _RowPreempted:
+                    continue
+                spec_rows.append((len(toks_l), row, req, list(props), p))
+                for ftok, fp, wb, wo in staged:
+                    toks_l.append(ftok)
+                    rows_l.append(row)
+                    pos_l.append(fp)
+                    wb_l.append(wb)
+                    wo_l.append(wo)
+                committed.add(row)
+                continue
             try:
                 wb = self._ensure_block(row, p, committed)
             except _RowPreempted:
@@ -1506,7 +2039,7 @@ class RolloutEngine:
             wb_l.append(wb)
             wo_l.append(p % bs)
             committed.add(row)
-        budget = self._step_tokens - len(toks_l)
+        budget = max(0, self._step_tokens - len(toks_l))
         for row in range(self.num_slots):
             req = self._slot_req[row]
             if req is None or budget <= 0:
@@ -1545,26 +2078,38 @@ class RolloutEngine:
             # the token-level analogue of _prefill_slots_batched
             self._stats["batched_prefills"] += 1
             self._stats["batched_prefill_slots"] += len(job_rows)
-        t = self.num_slots if not job_rows else self._step_tokens
+        # Padded batch width ladder: each (prefill?, depth) pair is ONE
+        # jit signature, so the retrace ledger stays at one compile per
+        # (table-width bucket, depth) — num_slots*depth always covers
+        # every verify window plus the non-speculating decode rows.
+        if spec_rows:
+            t = self.num_slots * max(1, depth)
+            if job_rows:
+                t = max(t, self._step_tokens)
+        else:
+            t = self.num_slots if not job_rows else self._step_tokens
         while len(toks_l) < t:
             toks_l.append(0)
             rows_l.append(0)
             pos_l.append(0)
             wb_l.append(nb)      # sentinel block: write dropped
             wo_l.append(0)
-        return toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, job_rows
+        return (toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows,
+                spec_rows, job_rows)
 
     def _step_paged(self) -> Dict[int, List[int]]:
         # guarded-by: caller
         self._schedule()
         emitted = self._pending_emits
         self._pending_emits = {}
-        plan = self._assemble_paged_plan()
+        depth, spec_plan = self._spec_begin_step()
+        plan = self._assemble_paged_plan(spec_plan, depth)
         if plan is None:
             return emitted
-        toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, job_rows = plan
+        (toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, spec_rows,
+         job_rows) = plan
         tracer = get_tracer()
-        n_active = len(decode_rows) + len(job_rows)
+        n_active = len(decode_rows) + len(spec_rows) + len(job_rows)
         with tracer.span("engine.decode_step", active=n_active):
             self._key, step_key = jax.random.split(self._key)
             # host numpy in, device out: the five plan vectors enter
@@ -1602,6 +2147,69 @@ class RolloutEngine:
             out_of_cache = self._row_len[row] >= self.context_bound - 1
             if hit_eos or out_of_budget or out_of_cache:
                 self._finish_request(req, row)
+        total_proposed = total_accepted = 0
+        for base, row, req, props, start in spec_rows:
+            k = len(props)
+            # greedy acceptance: walk the verify window until the
+            # target's argmax disagrees with the proposal; the
+            # disagreeing argmax IS the correction token, so every
+            # round emits >= 1 token and outputs stay byte-identical
+            # to non-speculative greedy decode
+            window = []
+            for i in range(k):
+                tok = int(toks[base + i])
+                window.append((tok, float(logps[base + i])))
+                if tok != props[i]:
+                    break
+            accepted = sum(1 for (tok, _), pr in zip(window, props)
+                           if tok == pr)
+            total_proposed += k
+            total_accepted += accepted
+            self._stats["spec_rounds"] += 1
+            self._stats["spec_proposed"] += k
+            self._stats["spec_accepted"] += accepted
+            self._stats["spec_wasted"] += k - accepted
+            # distillation harvest: the target-chosen continuation of
+            # the pre-round stream (accepted run + the correction)
+            sp = self._spec
+            sp.wasted_total.inc(k - accepted)
+            stream_before = req.prompt + req.tokens
+            sp.outcomes.append({
+                "context": stream_before[-sp.ctx_window:],
+                "targets": [tok for tok, _ in window],
+                "accepted": accepted,
+                "proposed": k,
+            })
+            finish = False
+            emitted_row = 0
+            for tok, lp in window:
+                req.tokens.append(tok)
+                req.logps.append(lp)
+                self._stats["tokens_emitted"] += 1
+                n_emitted += 1
+                emitted.setdefault(req.rid, []).append(tok)
+                emitted_row += 1
+                hit_eos = req.eos_id is not None and tok == req.eos_id
+                out_of_budget = len(req.tokens) >= req.max_new_tokens
+                out_of_cache = (start + emitted_row
+                                >= self.context_bound - 1)
+                if hit_eos or out_of_budget or out_of_cache:
+                    finish = True
+                    break
+            self._cur_tok_host[row] = req.tokens[-1]
+            # roll BOTH caches back to the verified prefix (the fed
+            # window is exactly the emitted stream, so the new length
+            # is start + tokens actually emitted)
+            self._spec_rollback(row, start + emitted_row)
+            if finish:
+                self._finish_request(req, row)
+        if total_proposed:
+            sp = self._spec
+            rate = total_accepted / total_proposed
+            sp.ema = (rate if not sp.ema_init
+                      else 0.9 * sp.ema + 0.1 * rate)
+            sp.ema_init = True
+            sp.accept_gauge.set(sp.ema)
         for row, req, job, n, last_idx, wrote in job_rows:
             self._row_len[row] += wrote
             job.toks = job.toks[n:]

@@ -469,6 +469,17 @@ class ServingFleet:
             self._track_publish_window(self.clock())
             return v
 
+    def publish_draft(self, params, *, epoch: Optional[int] = None,
+                      version: Optional[int] = None) -> int:
+        """Publish speculation-DRAFT weights to every live replica
+        (the online distiller's fleet entry point). Same
+        ``(epoch, version)`` fence as :meth:`update_params`, but
+        applied immediately — no drain, because draft weights only
+        move the acceptance rate, never the outputs."""
+        with self._lock:
+            return self.publisher.publish_draft(params, epoch=epoch,
+                                                version=version)
+
     @property
     def threaded(self) -> bool:
         """True when the dispatcher thread owns the pump (start()ed)."""

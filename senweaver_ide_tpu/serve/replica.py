@@ -113,10 +113,15 @@ class EngineReplica:
 
     def _update_decode_gauge(self) -> None:
         """Caller holds the lock."""
-        self._decode_tokens_gauge.set(
-            sum(max(0, r.max_new_tokens - r.emitted)
-                for r in self.inflight.values()),
-            replica=self.replica_id)
+        tokens = sum(max(0, r.max_new_tokens - r.emitted)
+                     for r in self.inflight.values())
+        self._decode_tokens_gauge.set(tokens, replica=self.replica_id)
+        # Same signal, pushed INTO the engine: the speculation depth
+        # controller reads remaining decode work as fleet load (an
+        # engine without speculation has no hook; skip silently).
+        note = getattr(self.engine, "note_decode_load", None)
+        if note is not None:
+            note(tokens)
 
     @property
     def accepting(self) -> bool:
@@ -319,6 +324,34 @@ class EngineReplica:
             self.weight_version = int(version)
             self._prefixes.clear()      # engine dropped old-policy KV
             self._version_gauge.set(version, replica=self.replica_id)
+
+    def mark_draft_stale(self) -> None:
+        """Publish-begin hook: the fleet is rolling new policy weights,
+        so this replica's speculation draft no longer matches the
+        policy being installed — stamp it stale and reset the
+        acceptance EMA immediately (engines without speculation have
+        no hook; no-op)."""
+        with self._lock:
+            if self.state == DEAD:
+                return
+            note = getattr(self.engine, "spec_note_publish_begin", None)
+            if note is not None:
+                note()
+
+    def install_draft_weights(self, params, version: int) -> bool:
+        """Install republished DRAFT weights (the online distiller's
+        output). Unlike :meth:`install_weights` this never waits for
+        drain: draft weights cannot affect output correctness, only
+        acceptance rate, so the swap is safe mid-decode. Returns False
+        when the engine has no speculation hook."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            update = getattr(self.engine, "update_draft_params", None)
+            if update is None:
+                return False
+            update(params, version=int(version))
+            return True
 
     def stamp_version(self, version: int) -> None:
         """Record the fleet's current published version on a replica

@@ -86,6 +86,14 @@ class Router:
         req.routed_by = "load"
         return min(accepting, key=load)
 
+    def load_snapshot(self) -> dict:
+        """Per-replica remaining-decode-token snapshot — the signal
+        :meth:`pick` balances on, exposed for the speculation depth
+        controller's report surface (scripts/spec_report.py) and the
+        dashboard. Keys are replica ids; DEAD replicas are omitted."""
+        return {r.replica_id: r.outstanding_decode_tokens
+                for r in self.live_replicas()}
+
     # -- failure handling ----------------------------------------------------
     def on_replica_death(self, replica: EngineReplica, now: float
                          ) -> Tuple[List[FleetRequest], List[Rejected]]:

@@ -75,6 +75,17 @@ class WeightPublisher:
             "senweaver_serve_stale_publish_total",
             "Publishes rejected by (epoch, version) fencing — a stale "
             "or duplicate writer was denied.")
+        # Draft (speculation) weight publishes share the epoch fence
+        # with target publishes but keep their own version watermark.
+        self.draft_version = 0                  # guarded-by: _lock
+        self._draft_publishes_total = registry.counter(
+            "senweaver_serve_draft_publishes_total",
+            "Speculation-draft weight versions published to the fleet.")
+        self._draft_install_failures_total = registry.counter(
+            "senweaver_serve_draft_install_failures_total",
+            "Per-replica draft installs that failed (replica keeps "
+            "serving with its previous draft; never quarantined — "
+            "drafts cannot corrupt outputs).")
         # install_weights failures collected here for the fleet to turn
         # into proper deaths (orphan triage included); the publisher
         # itself never kills — it has no router.
@@ -158,9 +169,58 @@ class WeightPublisher:
             self._roll_queue = [r for r in self.replicas
                                 if r.state != DEAD]
             self._current = None
+            # Speculation drafts are distilled against the OLD policy:
+            # stamp them stale on every replica now — mirroring the
+            # prefix-refcount drop below via _on_begin — instead of
+            # letting acceptance gauges keep vouching for a draft that
+            # no longer matches the weights being rolled out.
+            for r in self.replicas:
+                if r.state != DEAD:
+                    mark = getattr(r, "mark_draft_stale", None)
+                    if mark is not None:
+                        mark()
             for fn in self._on_begin:
                 fn(self.version)
             return self.version
+
+    def publish_draft(self, params, *, epoch: Optional[int] = None,
+                      version: Optional[int] = None) -> int:
+        """Publish republished DRAFT (speculation) weights through the
+        same ``(epoch, version)`` fence as target publishes — a zombie
+        distiller is denied exactly like a zombie learner — but with no
+        drain/roll: a draft swap cannot affect output correctness (only
+        the acceptance rate), so it applies to every live replica
+        immediately instead of stalling behind a rolling drain. Returns
+        the accepted draft version. Per-replica install failures are
+        counted, not quarantined: the replica simply keeps its previous
+        draft."""
+        with self._lock:
+            new_epoch = self.epoch if epoch is None else int(epoch)
+            new_version = (self.draft_version + 1 if version is None
+                           else int(version))
+            if new_epoch < self.epoch or (
+                    new_epoch == self.epoch
+                    and new_version <= self.draft_version):
+                self._stale_total.inc()
+                raise StalePublishError(
+                    f"draft publish (epoch={new_epoch}, "
+                    f"version={new_version}) is behind the fleet's "
+                    f"high-water mark (epoch={self.epoch}, "
+                    f"draft_version={self.draft_version})")
+            self.epoch = new_epoch
+            self.draft_version = new_version
+            self._draft_publishes_total.inc()
+            for r in self.replicas:
+                if r.state == DEAD:
+                    continue
+                install = getattr(r, "install_draft_weights", None)
+                if install is None:
+                    continue
+                try:
+                    install(params, new_version)
+                except Exception:
+                    self._draft_install_failures_total.inc()
+            return new_version
 
     def advance(self) -> bool:
         """One state-machine step of the roll; returns True when the

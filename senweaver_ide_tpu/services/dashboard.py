@@ -151,6 +151,7 @@ class DashboardService:
         out["resilience"] = self._resilience_summary()
         out["serving"] = self._serving_summary()
         out["kv_pool"] = self._kv_pool_summary()
+        out["speculation"] = self._speculation_summary()
         out["slo"] = self._slo_summary()
         out["runtime"] = self._runtime_summary()
         return out
@@ -367,6 +368,52 @@ class DashboardService:
                     total("senweaver_kv_install_copies_total"),
                 "exhaustion_rejections": total(
                     "senweaver_kv_exhaustion_rejections_total"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _speculation_summary(self) -> Dict[str, Any]:
+        """Speculation tile, read straight off the registry's
+        ``senweaver_spec_*`` series (zero wiring — all None/zero when
+        no engine enabled speculation). Depth/load/staleness report
+        the most recently stepped engine's gauge; acceptance reports
+        the WORST replica, since the depth controller throttles on the
+        replica that's wasting the most verify compute."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        def gauge(name: str, pick=max) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            vals = [float(v) for v in m.samples().values()]
+            return pick(vals) if vals else None
+
+        try:
+            return {
+                "depth": gauge("senweaver_spec_depth"),
+                "controller_load":
+                    gauge("senweaver_spec_controller_load"),
+                "depth_changes":
+                    total("senweaver_spec_depth_changes_total"),
+                "acceptance_rate":
+                    gauge("senweaver_spec_acceptance_rate", min),
+                "draft_staleness":
+                    gauge("senweaver_spec_draft_staleness"),
+                "wasted_draft_tokens":
+                    total("senweaver_spec_wasted_draft_tokens"),
+                "distill_steps":
+                    total("senweaver_spec_distill_steps_total"),
+                "distill_loss": gauge("senweaver_spec_distill_loss"),
+                "draft_publishes":
+                    total("senweaver_serve_draft_publishes_total"),
+                "draft_install_failures": total(
+                    "senweaver_serve_draft_install_failures_total"),
+                "draft_blocks_free":
+                    total("senweaver_spec_draft_kv_blocks_free"),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -685,6 +732,7 @@ input[type=text], input[type=password], textarea {
 <section><h2>Resilience</h2><div id="resilience" class="tiles"></div>
 <div id="guard-skips"></div></section>
 <section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
+<section><h2>Speculation</h2><div id="speculation" class="tiles"></div></section>
 <section><h2>SLO</h2>
 <div id="slo" class="tiles"></div>
 <div id="slo-exemplars"></div></section>
@@ -945,6 +993,19 @@ async function refresh() {
     ["probes dead", sv.probes_dead],
     ["continuation replays", sv.continuation_replays],
     ["publish quarantined", sv.publish_quarantined]]);
+  const spec = s.speculation || {};
+  tiles(document.getElementById("speculation"), [
+    ["depth", spec.depth],
+    ["controller load", spec.controller_load],
+    ["depth changes", spec.depth_changes],
+    ["acceptance (worst)", spec.acceptance_rate],
+    ["draft staleness", spec.draft_staleness],
+    ["wasted draft tokens", spec.wasted_draft_tokens],
+    ["distill steps", spec.distill_steps],
+    ["distill loss", spec.distill_loss],
+    ["draft publishes", spec.draft_publishes],
+    ["draft install failures", spec.draft_install_failures],
+    ["draft blocks free", spec.draft_blocks_free]]);
   const slo = s.slo || {};
   tiles(document.getElementById("slo"), [
     ["slo requests", slo.requests],

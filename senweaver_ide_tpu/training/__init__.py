@@ -14,3 +14,4 @@ from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
 from .rl_loop import (CollectResult, EpisodeRecord, GroupSizeScheduler,
                       RoundResult, collect_group_trajectories, grpo_round)
 from .online import OnlineImprovementLoop, OnlineRoundResult
+from .draft_distill import DraftDistiller

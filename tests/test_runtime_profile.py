@@ -329,3 +329,69 @@ def test_engine_fused_step_compiles_once_per_bucket():
         f"{after['signatures']}")
     assert after["calls"] > snap["calls"]
     assert after["storms"] == 0
+
+
+def test_speculation_depth_sweep_compiles_once_per_bucket():
+    """ISSUE 12 retrace gate: sweeping the speculation depth ladder
+    0 -> 2 -> 8 -> 4 -> 0 -> 8 across varying occupancy compiles each
+    of engine.fused_step / engine.spec_propose / engine.spec_feed at
+    most ONCE per (bucket, depth) signature, and repeating the sweep
+    adds ZERO compiles — a depth change lands on a pre-compiled bucket,
+    never a retrace. Distinctive vocab keeps the jit cache cold."""
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = dataclasses.replace(tiny_test(), vocab_size=89)
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    draft_cfg = dataclasses.replace(config, num_layers=2,
+                                    name="tiny-draft")
+    draft = jax.block_until_ready(
+        init_params(draft_cfg, jax.random.PRNGKey(1)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    SWEEP = [0, 2, 8, 4, 0, 8]
+    SPEC_FNS = ("engine.fused_step", "engine.spec_propose",
+                "engine.spec_feed")
+
+    def workload(prompt_lens):
+        eng = RolloutEngine(
+            params, config, num_slots=4, max_len=96, sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged"))
+        eng.enable_speculation(draft, draft_cfg, depth=SWEEP[0])
+        for i, n in enumerate(prompt_lens):
+            eng.submit([(i * 5 + j) % 80 + 2 for j in range(n)],
+                       max_new_tokens=8)
+        step = 0
+        while eng.has_work:
+            eng.step()
+            step += 1
+            if step < len(SWEEP):
+                eng.set_spec_depth(SWEEP[step])
+        eng._alloc.check_leaks()
+        eng.spec_check_leaks()
+
+    def snapshot():
+        led = get_profiler().ledger()
+        return {k: led[k] for k in SPEC_FNS if k in led}
+
+    workload([5])                       # low occupancy
+    workload([4, 7, 11, 6])             # full pool, varied fill
+    snap = snapshot()
+    assert set(snap) == set(SPEC_FNS)   # all three hot paths exercised
+    for name, rec in snap.items():
+        for sig in rec["signatures"]:
+            assert sig["compiles"] <= 1, (name, sig)
+        assert rec["storms"] == 0
+    # Bounded ladder: (occupancy-bucket x depth) signatures only.
+    assert len(snap["engine.fused_step"]["signatures"]) <= 10
+    assert len(snap["engine.spec_propose"]["signatures"]) <= 8
+
+    before = {k: v["compiles"] for k, v in snap.items()}
+    workload([4, 7, 11, 6])             # identical sweep, warm cache
+    after = snapshot()
+    for name in SPEC_FNS:
+        assert after[name]["compiles"] == before[name], (
+            f"repeat depth sweep recompiled {name}: "
+            f"{after[name]['signatures']}")
+        assert after[name]["storms"] == 0

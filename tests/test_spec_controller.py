@@ -1,0 +1,116 @@
+"""Speculation depth controller: ladder mapping, hysteresis, override
+semantics, and exported telemetry (ISSUE 12 tentpole, control half)."""
+
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.rollout.spec_controller import (FixedDepth,
+                                                      SpecController,
+                                                      SpecControllerConfig)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+def registry_value(name):
+    m = obs.get_registry().get(name)
+    return None if m is None else float(m.value())
+
+
+def settle(ctl, **signals):
+    """Observe the same signals past the hysteresis window; returns the
+    applied depth."""
+    d = ctl.depth
+    for _ in range(ctl.config.hysteresis_steps + 1):
+        d = ctl.observe(**signals)
+    return d
+
+
+# ---- ladder mapping -------------------------------------------------------
+
+def test_idle_runs_deepest_and_saturation_disables():
+    ctl = SpecController(SpecControllerConfig(hysteresis_steps=2))
+    assert ctl.depth == 8                    # idle default: deepest rung
+    assert settle(ctl, occupancy=0.1, kv_pressure=0.05) == 8
+    assert settle(ctl, occupancy=1.0, kv_pressure=0.2) == 0
+    assert settle(ctl, occupancy=0.05) == 8  # load gone -> deepest again
+
+
+def test_band_maps_monotonically_deeper_under_lighter_load():
+    cfg = SpecControllerConfig(hysteresis_steps=1)
+    depths = []
+    for load in (0.0, 0.4, 0.55, 0.7, 0.95):
+        ctl = SpecController(cfg)
+        depths.append(settle(ctl, occupancy=load))
+    assert depths[0] == 8 and depths[-1] == 0
+    assert depths == sorted(depths, reverse=True)
+    assert set(depths) <= set(cfg.ladder)    # only compiled rungs
+
+
+def test_any_saturated_signal_throttles():
+    """Load combines by max: KV pressure alone, or decode backlog
+    alone, must turn speculation off even with empty slots."""
+    cfg = SpecControllerConfig(hysteresis_steps=1)
+    ctl = SpecController(cfg)
+    assert settle(ctl, occupancy=0.0, kv_pressure=0.95) == 0
+    ctl2 = SpecController(cfg)
+    # backlog: 4 slots * 64 tokens/slot = 256 capacity; 1024 queued
+    assert settle(ctl2, decode_tokens=1024.0, num_slots=4) == 0
+    assert ctl2.last_load == 1.0             # clamped
+
+
+# ---- hysteresis -----------------------------------------------------------
+
+def test_hysteresis_delays_and_filters_flicker():
+    ctl = SpecController(SpecControllerConfig(hysteresis_steps=4))
+    for _ in range(3):
+        assert ctl.observe(occupancy=1.0) == 8   # not yet: streak < 4
+    assert ctl.observe(occupancy=1.0) == 0       # 4th consecutive applies
+    assert ctl.changes == 1
+    # Alternating load never accumulates a streak: depth holds.
+    for _ in range(16):
+        ctl.observe(occupancy=0.1)
+        ctl.observe(occupancy=1.0)
+    assert ctl.depth == 0 and ctl.changes == 1
+
+
+# ---- overrides & validation ----------------------------------------------
+
+def test_force_depth_is_ladder_checked():
+    ctl = SpecController()
+    ctl.force_depth(2)
+    assert ctl.depth == 2 and ctl.changes == 1
+    with pytest.raises(ValueError):
+        ctl.force_depth(3)                   # not a compiled bucket
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SpecControllerConfig(ladder=(2, 0, 4))       # unsorted
+    with pytest.raises(ValueError):
+        SpecControllerConfig(ladder=(2, 4, 8))       # missing off-rung
+    with pytest.raises(ValueError):
+        SpecControllerConfig(low_load=0.9, high_load=0.5)
+    with pytest.raises(ValueError):
+        SpecControllerConfig(hysteresis_steps=0)
+
+
+def test_fixed_depth_controller():
+    f = FixedDepth(4)
+    assert f.observe(occupancy=1.0, kv_pressure=1.0) == 4
+    assert f.depth == 4
+
+
+# ---- telemetry ------------------------------------------------------------
+
+def test_gauges_and_change_counter_exported():
+    ctl = SpecController(SpecControllerConfig(hysteresis_steps=1))
+    assert registry_value("senweaver_spec_depth") == 8.0
+    settle(ctl, occupancy=1.0)
+    assert registry_value("senweaver_spec_depth") == 0.0
+    assert registry_value("senweaver_spec_controller_load") == 1.0
+    assert registry_value("senweaver_spec_depth_changes_total") >= 1.0

@@ -1,0 +1,386 @@
+"""Fused speculative decoding in the paged engine: exact greedy parity
+at every depth, mid-decode depth switches, draft/target publishes
+mid-run, pool exhaustion with block-leak checks, fleet chaos, and the
+online draft distillation loop (ISSUE 12 acceptance)."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.rollout.spec_controller import (SpecController,
+                                                      SpecControllerConfig)
+from senweaver_ide_tpu.serve import Completed, ServingFleet
+from senweaver_ide_tpu.training.draft_distill import DraftDistiller
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def models():
+    target_cfg = tiny_test()
+    target = init_params(target_cfg, jax.random.PRNGKey(0))
+    draft_cfg = dataclasses.replace(target_cfg, num_layers=2,
+                                    name="tiny-draft")
+    draft = init_params(draft_cfg, jax.random.PRNGKey(1))
+    return target, target_cfg, draft, draft_cfg
+
+
+PROMPTS = [[5, 9, 2, 7, 1, 3], [1, 2, 3, 4], [8, 8, 1], [2, 4, 6, 8, 10]]
+
+
+def make_engine(params, config, *, num_slots=2, max_len=96, num_blocks=None,
+                eos_id=None):
+    return RolloutEngine(
+        params, config, num_slots=num_slots, max_len=max_len,
+        sample=GREEDY, eos_id=eos_id,
+        engine_config=EngineConfig(kv_layout="paged", block_size=4,
+                                   num_blocks=num_blocks))
+
+
+def reference(models, prompts=PROMPTS, max_new=12, eos_id=None):
+    target, target_cfg, _, _ = models
+    eng = make_engine(target, target_cfg, eos_id=eos_id)
+    rids = [eng.submit(p, max_new_tokens=max_new) for p in prompts]
+    out = eng.run()
+    return [out[r] for r in rids]
+
+
+def check_clean(eng):
+    eng._alloc.check_leaks()
+    eng.spec_check_leaks()
+
+
+# ---- exact parity ---------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [2, 4, 8])
+def test_greedy_parity_weak_draft(models, depth):
+    """A draft that almost never agrees with the target must still
+    yield byte-identical greedy outputs — speculation is exact, only
+    throughput varies."""
+    target, target_cfg, draft, draft_cfg = models
+    ref = reference(models)
+    eng = make_engine(target, target_cfg)
+    eng.enable_speculation(draft, draft_cfg, depth=depth)
+    rids = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    out = eng.run()
+    assert [out[r] for r in rids] == ref
+    s = eng.spec_stats()
+    assert s["enabled"] and s["rounds"] > 0 and s["proposed"] > 0
+    check_clean(eng)
+
+
+def test_perfect_draft_accepts_everything_fewer_rounds(models):
+    """Draft == target: every proposal accepted, rounds shrink with
+    depth, outputs still exact."""
+    target, target_cfg, _, _ = models
+    ref = reference(models)
+    rounds = {}
+    for depth in (2, 8):
+        eng = make_engine(target, target_cfg)
+        eng.enable_speculation(target, target_cfg, depth=depth)
+        rids = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+        out = eng.run()
+        assert [out[r] for r in rids] == ref
+        s = eng.spec_stats()
+        assert s["accepted"] == s["proposed"] > 0
+        assert s["acceptance_ema"] == pytest.approx(1.0)
+        rounds[depth] = s["rounds"]
+        check_clean(eng)
+    assert rounds[8] < rounds[2]
+
+
+def test_eos_inside_speculation_window(models):
+    """EOS surfacing mid-window truncates the emission exactly where
+    vanilla greedy stops."""
+    target, target_cfg, _, _ = models
+    probe = reference(models)[0]
+    eos = probe[2]
+    ref = reference(models, eos_id=eos)
+    eng = make_engine(target, target_cfg, eos_id=eos)
+    eng.enable_speculation(target, target_cfg, depth=8)
+    rids = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    out = eng.run()
+    assert [out[r] for r in rids] == ref
+    check_clean(eng)
+
+
+# ---- mid-decode transitions ----------------------------------------------
+
+def test_mid_decode_depth_switch_and_draft_swap(models):
+    """Depth changes (8 -> 2 -> 0 -> 8) and a draft-weight swap while
+    rows are mid-decode never change outputs; the swap resets the
+    acceptance EMA and stamps the new draft version."""
+    target, target_cfg, draft, draft_cfg = models
+    ref = reference(models, max_new=20)
+    eng = make_engine(target, target_cfg)
+    eng.enable_speculation(draft, draft_cfg, depth=8)
+    rids = [eng.submit(p, max_new_tokens=20) for p in PROMPTS]
+    for _ in range(3):
+        eng.step()
+    eng.set_spec_depth(2)
+    for _ in range(2):
+        eng.step()
+    eng.update_draft_params(draft, version=5)    # mid-flight swap
+    s = eng.spec_stats()
+    assert s["draft_version"] == 5
+    assert s["acceptance_ema"] is None           # EMA reset
+    eng.set_spec_depth(0)                        # speculation off...
+    for _ in range(2):
+        eng.step()
+    eng.set_spec_depth(8)                        # ...and back on
+    out = eng.run()
+    assert [out[r] for r in rids] == ref
+    check_clean(eng)
+
+
+def test_target_publish_marks_draft_stale_and_resets_ema(models):
+    """update_params (a policy publish) must invalidate draft trust:
+    staleness increments, the EMA restarts, and post-publish outputs
+    match a fresh engine on the new weights."""
+    target, target_cfg, draft, draft_cfg = models
+    bumped = jax.tree_util.tree_map(lambda x: x + 0.01, target)
+    eng = make_engine(target, target_cfg)
+    eng.enable_speculation(draft, draft_cfg, depth=4)
+    rid = eng.submit(PROMPTS[0], max_new_tokens=8)
+    eng.run()
+    assert eng.spec_stats()["acceptance_ema"] is not None
+    eng.update_params(bumped)
+    s = eng.spec_stats()
+    assert s["draft_staleness"] == 1
+    assert s["acceptance_ema"] is None
+    # Serving continues exact on the NEW weights with the stale draft.
+    ref_eng = make_engine(bumped, target_cfg)
+    ref_rid = ref_eng.submit(PROMPTS[1], max_new_tokens=10)
+    ref = ref_eng.run()[ref_rid]
+    rid2 = eng.submit(PROMPTS[1], max_new_tokens=10)
+    out = eng.run()[rid2]
+    assert out == ref
+    # Installing a fresh draft clears the staleness debt.
+    eng.update_draft_params(draft)
+    assert eng.spec_stats()["draft_staleness"] == 0
+    check_clean(eng)
+
+
+# ---- pool pressure --------------------------------------------------------
+
+@pytest.mark.parametrize("depth", [4, 8])
+def test_exhaustion_preempts_speculating_rows_exactly(models, depth):
+    """A pool too small for two concurrent rollouts preempts one while
+    speculation is active; both finish with solo-run outputs and BOTH
+    block pools (target + draft) come back leak-free."""
+    target, target_cfg, _, _ = models
+    prompts = [[5, 9, 2, 7], [11, 3, 8, 1]]
+    solo = []
+    for p in prompts:
+        e = make_engine(target, target_cfg, num_slots=1, max_len=64)
+        r = e.submit(p, max_new_tokens=12)
+        solo.append(e.run()[r])
+    eng = make_engine(target, target_cfg, num_slots=2, max_len=64,
+                      num_blocks=6)
+    eng.enable_speculation(target, target_cfg, depth=depth)
+    rids = [eng.submit(p, max_new_tokens=12) for p in prompts]
+    out = eng.run()
+    assert [out[r] for r in rids] == solo
+    assert eng.stats()["kv_preemptions"] >= 1
+    check_clean(eng)
+
+
+def test_draft_pool_exhaustion_never_blocks_target(models):
+    """Starve the DRAFT pool only: rows silently stop speculating
+    instead of stalling or corrupting target scheduling."""
+    target, target_cfg, draft, draft_cfg = models
+    ref = reference(models)
+    eng = make_engine(target, target_cfg)
+    eng.enable_speculation(draft, draft_cfg, depth=4, num_blocks=2)
+    rids = [eng.submit(p, max_new_tokens=12) for p in PROMPTS]
+    out = eng.run()
+    assert [out[r] for r in rids] == ref
+    check_clean(eng)
+
+
+# ---- adaptive depth through a live engine --------------------------------
+
+def test_controller_throttles_under_load_and_recovers(models):
+    target, target_cfg, _, _ = models
+    eng = make_engine(target, target_cfg, num_slots=2)
+    eng.enable_speculation(
+        target, target_cfg,
+        controller=SpecController(SpecControllerConfig(hysteresis_steps=1)))
+    for i in range(10):
+        eng.submit([(3 * i + j) % 97 for j in range(5)], max_new_tokens=12)
+    eng.note_decode_load(4096.0)            # router backlog signal
+    depths = []
+    for _ in range(6):
+        eng.step()
+        depths.append(eng.spec_stats()["depth"])
+    assert min(depths) == 0                 # throttled to off
+    eng.note_decode_load(0.0)
+    eng.run()
+    eng.submit([1, 2, 3], max_new_tokens=24)
+    eng.run()
+    assert eng.spec_stats()["depth"] > 0    # light load: back on
+    check_clean(eng)
+
+
+# ---- fleet chaos ----------------------------------------------------------
+
+def test_fleet_chaos_exact_parity(models):
+    """4 replicas (mixed fixed/adaptive depth), tight pools forcing
+    preemption, a mid-run draft publish AND a rolling target publish:
+    every request completes token-exact against the reference for the
+    weight version it finished under, and no pool leaks a block."""
+    target, target_cfg, draft, draft_cfg = models
+    leaves, treedef = jax.tree_util.tree_flatten(target)
+    keys = jax.random.split(jax.random.PRNGKey(9), len(leaves))
+    target_v1 = jax.tree_util.tree_unflatten(treedef, [
+        l + 0.01 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+    draft_v1 = init_params(draft_cfg, jax.random.PRNGKey(2))
+    prompts = [[(i * 5 + j) % 90 + 2 for j in range(4 + i % 3)]
+               for i in range(12)]
+    refs = {}
+    for v, pp in ((0, target), (1, target_v1)):
+        for i, pr in enumerate(prompts):
+            e = make_engine(pp, target_cfg, num_slots=1)
+            r = e.submit(pr, max_new_tokens=16)
+            refs[(v, i)] = e.run()[r]
+
+    def replica(i):
+        e = make_engine(target, target_cfg, num_slots=2, num_blocks=14)
+        if i % 2 == 0:
+            e.enable_speculation(draft, draft_cfg,
+                                 depth=(4 if i == 0 else 8))
+        else:
+            e.enable_speculation(
+                draft, draft_cfg,
+                controller=SpecController(
+                    SpecControllerConfig(hysteresis_steps=1)))
+        return e
+
+    engines = [replica(i) for i in range(4)]
+    fleet = ServingFleet(engines)
+    tickets = [fleet.submit(pr, max_new_tokens=16) for pr in prompts]
+    for _ in range(4):
+        fleet.step()
+    fleet.publish_draft(draft_v1)           # applies with NO drain
+    fleet.begin_publish(target_v1)          # rolling, drains replicas
+    for e in engines:
+        e.set_spec_depth(2)                 # chaos: depth churn too
+    fleet.run()
+    for i, t in enumerate(tickets):
+        out = fleet.outcome(t)
+        assert isinstance(out, Completed)
+        assert out.weight_version == out.weight_version_at_finish
+        assert fleet.result(t) == refs[(out.weight_version, i)]
+    for e in engines:
+        check_clean(e)
+        assert e.spec_stats()["draft_version"] >= 1   # publish landed
+        assert e.spec_stats()["draft_staleness"] >= 1  # begin() stamped
+
+
+def test_publisher_begin_stamps_draft_stale_fleetwide(models):
+    """Satellite 1: WeightPublisher.begin must mark every replica's
+    draft stale the instant a roll is staged (mirror of the prefix
+    refcount drop) — before any replica swaps."""
+    target, target_cfg, draft, draft_cfg = models
+    engines = [make_engine(target, target_cfg) for _ in range(2)]
+    for e in engines:
+        e.enable_speculation(draft, draft_cfg, depth=4)
+    fleet = ServingFleet(engines)
+    bumped = jax.tree_util.tree_map(lambda x: x + 0.01, target)
+    fleet.begin_publish(bumped)             # staged; no pump yet
+    for e in engines:
+        assert e.spec_stats()["draft_staleness"] == 1
+        assert e.spec_stats()["acceptance_ema"] is None
+
+
+# ---- online distillation --------------------------------------------------
+
+def test_distillation_raises_acceptance_after_policy_drift(models):
+    """FastGRPO loop: simulate a policy publish (target drifts off the
+    draft's teacher), distill on harvested verification outcomes, and
+    acceptance must rise while outputs stay byte-identical."""
+    _, target_cfg, _, _ = models
+    teacher = init_params(target_cfg, jax.random.PRNGKey(0))
+    leaves, treedef = jax.tree_util.tree_flatten(teacher)
+    keys = jax.random.split(jax.random.PRNGKey(7), len(leaves))
+    policy = jax.tree_util.tree_unflatten(treedef, [
+        l + 0.02 * jax.random.normal(k, l.shape, l.dtype)
+        for l, k in zip(leaves, keys)])
+    prompts = [[(i * 7 + j) % 97 for j in range(4 + i % 3)]
+               for i in range(8)]
+
+    def serve(draft_params):
+        e = make_engine(policy, target_cfg, num_slots=4)
+        e.enable_speculation(draft_params, target_cfg, depth=4)
+        for p in prompts:
+            e.submit(p, max_new_tokens=24)
+        out = e.run()
+        s = e.spec_stats()
+        check_clean(e)
+        return s["accepted"] / max(1, s["proposed"]), e, out
+
+    frozen_rate, eng, out_frozen = serve(teacher)
+    distiller = DraftDistiller(teacher, target_cfg, learning_rate=3e-3,
+                               batch_size=8, seed=0)
+    assert distiller.harvest(eng) > 0
+    assert eng.drain_spec_outcomes() == []  # drained
+    distiller.run(30)
+    distilled_rate, _, out_distilled = serve(distiller.params)
+    assert distilled_rate > frozen_rate + 0.05
+    assert out_distilled == out_frozen      # throughput-only change
+
+
+def test_dashboard_speculation_tile(models):
+    """The dashboard's Speculation tile reads the senweaver_spec_*
+    series off the registry with zero wiring."""
+    import json
+
+    from senweaver_ide_tpu.services.dashboard import DashboardService
+
+    target, target_cfg, draft, draft_cfg = models
+    eng = make_engine(target, target_cfg)
+    eng.enable_speculation(draft, draft_cfg, depth=4)
+    rid = eng.submit(PROMPTS[0], max_new_tokens=8)
+    eng.run()
+    spec = DashboardService().state()["speculation"]
+    assert spec["depth"] == 4
+    assert spec["wasted_draft_tokens"] > 0
+    assert spec["draft_blocks_free"] > 0
+    json.dumps(spec)
+
+
+def test_distiller_round_publishes_through_fenced_path(models):
+    """DraftDistiller.round + WeightPublisher.publish_draft: the new
+    draft lands on every replica under the (epoch, version) fence and
+    a stale re-publish is rejected."""
+    from senweaver_ide_tpu.serve import StalePublishError
+
+    target, target_cfg, draft, draft_cfg = models
+    engines = [make_engine(target, target_cfg) for _ in range(2)]
+    for e in engines:
+        e.enable_speculation(draft, draft_cfg, depth=4)
+    fleet = ServingFleet(engines)
+    for i in range(4):
+        fleet.submit([i + 1, i + 2, i + 3], max_new_tokens=8)
+    fleet.run()
+    distiller = DraftDistiller(draft, draft_cfg)
+    loss = distiller.round(engines, steps=2, publisher=fleet.publisher)
+    assert loss > 0.0
+    assert distiller.version == 1
+    for e in engines:
+        assert e.spec_stats()["draft_version"] == 1
+    with pytest.raises(StalePublishError):
+        fleet.publisher.publish_draft(distiller.params, version=1)
