@@ -663,6 +663,87 @@ def _measure_paged_vs_slots(*, num_slots: int = 4, prompt_len: int = 16,
     }
 
 
+def _measure_kv_pressure(*, num_requests: int = 6, prefix_len: int = 16,
+                         decode_tokens: int = 12) -> dict:
+    """Host-RAM tiering vs evict-and-recompute when a prefix-sharing
+    workload runs ~2x over pool capacity (rollout/kv_pressure.py). Same
+    pool, same prompts; the only knob is EngineConfig.host_tier. The
+    acceptance signal is prefill_tokens strictly lower with the tier on
+    — restores from host replace re-prefills of the shared prefix — at
+    comparable tok/s, with the swap counters proving the tier (not
+    luck) supplied the savings."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prefix = [(j * 11) % 200 + 2 for j in range(prefix_len)]
+    prompts = [prefix + [(i * 7 + j) % 200 + 2 for j in range(4)]
+               for i in range(num_requests)]
+    # working set: 2 concurrent x ~8 blocks + 4 prefix blocks against
+    # a 10-block pool — sustained pressure, the ladder fires every run
+    num_blocks = 10
+
+    def run(host_tier: bool) -> dict:
+        obs._reset_for_tests()
+        eng = RolloutEngine(
+            params, config, num_slots=2, max_len=128, sample=greedy,
+            engine_config=EngineConfig(
+                kv_layout="paged", block_size=4, num_blocks=num_blocks,
+                host_tier=host_tier, tier_min_uses=1))
+        pid = eng.register_prefix(prefix)
+        rids = [eng.submit(p, max_new_tokens=decode_tokens,
+                           prefix_id=pid) for p in prompts]
+        t0 = _time.perf_counter()
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        return {"tok_s": sum(len(out[r]) for r in rids) / dt,
+                "tokens": [out[r] for r in rids],
+                "stats": eng.stats()}
+
+    t_warm = _time.perf_counter()
+    run(True)               # compile warmup, both modes
+    run(False)
+    compile_s = _time.perf_counter() - t_warm
+    evict = run(False)
+    t0 = _time.perf_counter()
+    tier = run(True)
+    _stamp_timing("kv_pressure", compile_s, _time.perf_counter() - t0)
+    obs._reset_for_tests()
+    # the minimum prefill work any run must do: the prefix once plus
+    # each request's non-prefix suffix
+    ideal = prefix_len + sum(len(p) - prefix_len for p in prompts)
+    return {
+        "num_requests": num_requests,
+        "kv_blocks_total": num_blocks,
+        "tier_tok_s": round(tier["tok_s"], 1),
+        "evict_tok_s": round(evict["tok_s"], 1),
+        "tier_over_evict": round(
+            tier["tok_s"] / max(1e-9, evict["tok_s"]), 3),
+        "prefill_tokens_ideal": ideal,
+        "prefill_tokens_tier": tier["stats"]["prefill_tokens"],
+        "prefill_tokens_evict": evict["stats"]["prefill_tokens"],
+        "recompute_ratio_tier": round(
+            tier["stats"]["prefill_tokens"] / max(1, ideal), 3),
+        "recompute_ratio_evict": round(
+            evict["stats"]["prefill_tokens"] / max(1, ideal), 3),
+        "swap_outs": tier["stats"].get("prefix_swap_outs", 0),
+        "swap_ins": tier["stats"].get("prefix_swap_ins", 0),
+        "evictions_evict": evict["stats"].get("prefix_evictions", 0),
+        "preemptions_tier": tier["stats"].get("kv_preemptions", 0),
+        "preemptions_evict": evict["stats"].get("kv_preemptions", 0),
+        "outputs_equal": tier["tokens"] == evict["tokens"],
+    }
+
+
 def _measure_fleet_remote(*, n_replicas: int = 4,
                           n_requests: int = 8) -> dict:
     """Cross-host dispatch economics: a loopback remote fleet
@@ -1094,6 +1175,16 @@ def main() -> None:
         extra["paged_vs_slots"] = _measure_paged_vs_slots()
     except Exception as e:
         extra["paged_vs_slots"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Memory-pressure ladder economics (host-RAM tiering vs
+    # evict-and-recompute at 2x over pool capacity;
+    # rollout/kv_pressure.py). Ladder-level, so tiny-test covers it on
+    # every backend.
+    try:
+        _log("kv pressure measure: kv_pressure")
+        extra["kv_pressure"] = _measure_kv_pressure()
+    except Exception as e:
+        extra["kv_pressure"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Concurrency-adaptive speculation economics (fixed depth-8 vs the
     # depth controller under an overloaded fleet). Protocol-level, so

@@ -249,9 +249,50 @@ def _case_reward_head() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("reward.head_batch")}
 
 
+def _case_kv_pressure() -> Dict[str, Any]:
+    """The memory-pressure ladder end to end (ISSUE 13): the
+    ``engine_decode`` workload made prefix-sharing and ~2x over pool
+    capacity with the host tier on, so scored eviction, swap-out /
+    on-demand restore, and preemption replay all ride the fused step.
+    Gates that pressure handling adds no steady-state retraces and
+    that the pressured end-to-end time is tracked run over run."""
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prefix = [(j * 11) % 200 + 2 for j in range(16)]
+    prompts = [prefix + [(i * 7 + j) % 200 + 2 for j in range(4)]
+               for i in range(6)]
+
+    def run():
+        eng = RolloutEngine(
+            params, config, num_slots=2, max_len=128, sample=greedy,
+            engine_config=EngineConfig(
+                kv_layout="paged", block_size=4, num_blocks=10,
+                tier_min_uses=1))
+        pid = eng.register_prefix(prefix)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=12, prefix_id=pid)
+        eng.run()
+        eng.release_prefix(pid)
+        eng._alloc.check_leaks()            # drain must stay leak-free
+
+    run()                                   # warmup: compiles land here
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")}
+
+
 CASES = {
     "engine_decode": _case_engine_decode,
     "spec_decode": _case_spec_decode,
+    "kv_pressure": _case_kv_pressure,
     "train_step": _case_train_step,
     "reward_head": _case_reward_head,
 }

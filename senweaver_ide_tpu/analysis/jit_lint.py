@@ -78,6 +78,7 @@ RULES: Dict[str, str] = {
 HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/obs/runtime_profile.py",
     "senweaver_ide_tpu/rollout/engine.py",
+    "senweaver_ide_tpu/rollout/kv_pressure.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",
     "senweaver_ide_tpu/rollout/sampler.py",
     "senweaver_ide_tpu/rollout/spec_controller.py",

@@ -22,6 +22,7 @@ holds the policy objects they share.
 
 from .chaos import (ChaosEngine, ChaosError, ChaosSession, EngineFault,
                     EPISODE_FAULT_KINDS, FaultPlan, FaultSpec,
+                    MemoryPressureFault, MemoryPressurePlan,
                     NETWORK_FAULT_KINDS, NetworkFault, NetworkFaultPlan)
 from .faults import (FailedEpisode, REASON_ERROR, REASON_TIMEOUT,
                      ResilienceConfig, episode_retry_delay_s)
@@ -37,6 +38,7 @@ from .retry import (BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN,
 __all__ = [
     "ChaosEngine", "ChaosError", "ChaosSession", "EngineFault",
     "EPISODE_FAULT_KINDS", "FaultPlan", "FaultSpec",
+    "MemoryPressureFault", "MemoryPressurePlan",
     "NETWORK_FAULT_KINDS", "NetworkFault", "NetworkFaultPlan",
     "FailedEpisode", "REASON_ERROR", "REASON_TIMEOUT",
     "ResilienceConfig", "episode_retry_delay_s",

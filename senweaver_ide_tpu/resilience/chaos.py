@@ -360,23 +360,134 @@ class NetworkFaultPlan:
             return out
 
 
+@dataclasses.dataclass(frozen=True)
+class MemoryPressureFault:
+    """One scheduled KV-pool squeeze on a paged engine.
+
+    At step ``at_step`` (0-based index of engine.step() calls), the
+    plan allocates ``hold_blocks`` REAL blocks from the engine's pool
+    and sits on them — a deterministic pool shrink, so the engine's
+    pressure ladder (evict → tier → preempt) fires from a genuine
+    ``BlocksExhausted``, not a mock. The squat releases at
+    ``release_step`` (None = held until :meth:`MemoryPressurePlan.
+    release_all`, e.g. at drain). ``hold_blocks`` is clamped to what
+    the pool can actually grant — a squeeze never kills the engine."""
+
+    at_step: int
+    hold_blocks: int
+    release_step: Optional[int] = None
+
+    def __post_init__(self):
+        if self.hold_blocks <= 0:
+            raise ValueError("hold_blocks must be positive")
+        if (self.release_step is not None
+                and self.release_step <= self.at_step):
+            raise ValueError("release_step must come after at_step")
+
+
+class MemoryPressurePlan:
+    """Deterministic schedule of KV memory-pressure faults.
+
+    The paged-pool twin of :class:`FaultPlan`: :meth:`wrap_engine`
+    returns a proxy whose ``step()`` consults the plan by step index,
+    squatting and releasing real pool blocks at exact coordinates.
+    Everything injected is logged to :attr:`injected` and mirrored on
+    ``senweaver_chaos_faults_injected_total{kind="memory_pressure"}``.
+    """
+
+    def __init__(self, faults: Sequence[MemoryPressureFault] = (), *,
+                 registry=None):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._fired = [False] * len(self.faults)   # guarded-by: _lock
+        # fault index -> squatted block ids (released on schedule)
+        self._held: Dict[int, List[int]] = {}      # guarded-by: _lock
+        self._steps = 0                            # guarded-by: _lock
+        self.injected: List[Tuple[str, Tuple[int, ...]]] = []  # guarded-by: _lock
+        if registry is None:
+            from ..obs import get_registry
+            registry = get_registry()
+        self._injected_total = registry.counter(
+            "senweaver_chaos_faults_injected_total",
+            "Faults injected by the chaos harness", labelnames=("kind",))
+
+    def on_step(self, engine) -> None:
+        """Advance the step clock; squat/release blocks due this step.
+        Called by the :class:`ChaosEngine` proxy before delegating."""
+        alloc = getattr(engine, "_alloc", None)
+        with self._lock:
+            idx = self._steps
+            self._steps += 1
+            if alloc is None:
+                return                     # slot layout: nothing to squeeze
+            for i, f in enumerate(self.faults):
+                if (f.release_step is not None and f.release_step == idx
+                        and i in self._held):
+                    alloc.release(self._held.pop(i))
+                if f.at_step == idx and not self._fired[i]:
+                    self._fired[i] = True
+                    # clamp to grantable so the squeeze pressures the
+                    # ladder instead of instantly exhausting the pool
+                    n = min(f.hold_blocks, alloc.free_blocks)
+                    if n > 0:
+                        self._held[i] = alloc.alloc(n)
+                    self.injected.append(("memory_pressure", (idx, n)))
+                    self._injected_total.inc(kind="memory_pressure")
+
+    def release_all(self, engine) -> None:
+        """Give every squatted block back (end of scenario / drain —
+        the leak tripwire ``check_leaks`` then owns the pool again)."""
+        alloc = getattr(engine, "_alloc", None)
+        with self._lock:
+            if alloc is not None:
+                for blocks in self._held.values():
+                    alloc.release(blocks)
+            self._held.clear()
+
+    @property
+    def holding_blocks(self) -> int:
+        with self._lock:
+            return sum(len(b) for b in self._held.values())
+
+    def injected_counts(self) -> Dict[str, int]:
+        with self._lock:
+            out: Dict[str, int] = {}
+            for kind, _ in self.injected:
+                out[kind] = out.get(kind, 0) + 1
+            return out
+
+    def wrap_engine(self, engine) -> "ChaosEngine":
+        return ChaosEngine(engine, None, pressure=self)
+
+
 class ChaosEngine:
     """Engine proxy injecting submit()-indexed faults below the session
-    layer (EnginePolicyClient calls submit/step on this transparently)."""
+    layer (EnginePolicyClient calls submit/step on this transparently).
+    Optionally carries a :class:`MemoryPressurePlan` whose step-indexed
+    pool squeezes fire inside ``step()``."""
 
-    def __init__(self, inner, plan: FaultPlan):
+    def __init__(self, inner, plan: Optional[FaultPlan], *,
+                 pressure: Optional["MemoryPressurePlan"] = None):
         self._inner = inner
         self._plan = plan
+        self._pressure = pressure
 
     def submit(self, *args, **kwargs):
-        fault = self._plan.take_engine()
-        if fault is not None:
-            if fault.kind == "hang":
-                time.sleep(fault.hang_s)
-            else:
-                raise ChaosError(
-                    f"injected engine raise at submit #{fault.call_idx}")
+        if self._plan is not None:
+            fault = self._plan.take_engine()
+            if fault is not None:
+                if fault.kind == "hang":
+                    time.sleep(fault.hang_s)
+                else:
+                    raise ChaosError(
+                        f"injected engine raise at submit "
+                        f"#{fault.call_idx}")
         return self._inner.submit(*args, **kwargs)
+
+    def step(self, *args, **kwargs):
+        if self._pressure is not None:
+            self._pressure.on_step(self._inner)
+        return self._inner.step(*args, **kwargs)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -39,6 +39,8 @@ from ..models.transformer import (KVCache, Params, forward, forward_paged,
 from ..obs import get_registry, get_tracer
 from ..obs.runtime_profile import ProfiledFunction, profiled_device_get
 from ..ops.sampling import sample_token, sampled_logprob
+from .kv_pressure import (HostPrefix, PrefixCandidate, blockify_host,
+                          pick_victim, should_tier, unblockify_host)
 from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
                        copy_blocks, gather_blocks, init_paged_pool,
                        install_blocks)
@@ -373,6 +375,19 @@ class EngineConfig:
     # None = auto: use the Pallas paged-attention kernel on TPU when
     # the model already opted into flash decode; True/False forces.
     paged_kernel: Optional[bool] = None
+    # Host-RAM tier for warm prefixes (rollout/kv_pressure.py): under
+    # pool pressure, warm/shared prefixes swap to host numpy buffers
+    # and restore on demand via the install scatter; False degrades to
+    # evict-only (the preempt-heavy PR-10 ladder, kept for benching).
+    host_tier: bool = True
+    # An unshared prefix must have been grafted this many times before
+    # it is worth the host round-trip; colder entries are dropped.
+    tier_min_uses: int = 2
+    # Preemption-starvation cap: a request preempted this many times
+    # becomes non-preemptible (it either finishes or, when even a
+    # whole-pool allocation cannot fit it, truncate-finishes) —
+    # counted in senweaver_kv_preemption_storms_total.
+    max_preempts: int = 3
 
 
 @dataclasses.dataclass
@@ -471,6 +486,9 @@ class _Request:
     # final sampled token (whose k/v is only written when it is fed) —
     # set when the request finishes while holding its slot.
     held_history: Optional[List[int]] = None
+    # times this request lost its blocks to preempt-by-recomputation;
+    # at EngineConfig.max_preempts it becomes non-preemptible
+    preempt_count: int = 0
 
 
 class RolloutEngine:
@@ -556,6 +574,7 @@ class RolloutEngine:
                                  length=jnp.zeros((num_slots,), jnp.int32),
                                  k_scale=ks0, v_scale=vs0)
             self.cur_tok = jnp.zeros((num_slots,), jnp.int32)
+            self._storm_total = None
         else:
             bs = max(1, int(self.engine_config.block_size))
             self._blocks_per_row = -(-max_len // bs)
@@ -563,6 +582,10 @@ class RolloutEngine:
             if nb is None:
                 nb = (num_slots + 4) * self._blocks_per_row
             self._alloc = BlockAllocator(nb, bs, registry=get_registry())
+            self._storm_total = get_registry().counter(
+                "senweaver_kv_preemption_storms_total",
+                "Requests preempted EngineConfig.max_preempts times and "
+                "latched non-preemptible (starvation guard).")
             self.pool = init_paged_pool(config, nb, bs)
             self.cache = None
             self.cur_tok = None
@@ -597,6 +620,9 @@ class RolloutEngine:
                        "continuations": 0, "continuation_delta_tokens": 0,
                        "decode_steps": 0, "tokens_emitted": 0,
                        "hold_evictions": 0, "kv_preemptions": 0,
+                       "prefix_swap_outs": 0, "prefix_swap_ins": 0,
+                       "kv_preemption_storms": 0,
+                       "prefix_host_exports": 0,
                        "spec_rounds": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "spec_wasted": 0,
                        "spec_feed_tokens": 0, "spec_rollbacks": 0}
@@ -623,6 +649,16 @@ class RolloutEngine:
         self.max_prefixes = max(1, int(max_prefixes))
         self._prefix_last_use: Dict[int, int] = {}  # guarded-by: _lock
         self._prefix_use_seq = 0                # guarded-by: _lock
+        # How often each prefix was grafted/exported — the tier-or-
+        # evict signal (kv_pressure.should_tier).
+        self._prefix_use_count: Dict[int, int] = {}  # guarded-by: _lock
+        # Host-RAM tier: pid -> HostPrefix for prefixes whose entry
+        # blocks were swapped out (paged entry becomes None). Restored
+        # on demand by _restore_prefix via the install scatter.
+        self._prefix_host: Dict[int, "HostPrefix"] = {}  # guarded-by: _lock
+        # Preemption-storm latch: rids already counted as storm-capped,
+        # so the counter fires once per starved request.
+        self._storm_rids: set = set()           # guarded-by: _lock
         # Fused speculation (enable_speculation): draft model + its own
         # block pool, in lockstep with the target rows. None = off.
         self._spec: Optional[_SpecState] = None  # guarded-by: _lock
@@ -997,7 +1033,20 @@ class RolloutEngine:
                     out[f"kv_{name}"] = val
                 out["kv_blocks_total"] = self._alloc.num_blocks
                 out["kv_blocks_free"] = self._alloc.free_blocks
+                out["kv_pressure"] = (self._alloc.used_blocks
+                                      / self._alloc.num_blocks)
+                out["kv_swapped_blocks"] = sum(
+                    hp.num_blocks for hp in self._prefix_host.values())
             return out
+
+    @property
+    def kv_pressure(self) -> float:
+        """Pool utilization 0..1 — the proactive-backpressure signal
+        the admission/autoscale planes watermark on (0.0 for the slot
+        layout, which has no block pool to exhaust)."""
+        if self.kv_layout != "paged":
+            return 0.0
+        return self._alloc.used_blocks / self._alloc.num_blocks
 
     @property
     def queue_depth(self) -> int:
@@ -1180,11 +1229,28 @@ class RolloutEngine:
             self._touch_prefix(prefix_id)
             self._stats["prefix_exports"] += 1
             if self.kv_layout == "paged":
-                # The fleet contract speaks contiguous one-slot buffers
-                # (slot engines import them as-is; paged peers
-                # re-blockify): gather the table into that layout.
-                entry = self._export_blocks(tokens, entry)
+                if entry is None:
+                    # host-tiered: serve the broadcast straight from
+                    # the host buffers — late replicas backfill from
+                    # RAM without forcing a swap-in on the donor (the
+                    # receiving engine's install scatter ingests host
+                    # numpy directly)
+                    entry = self._export_host(prefix_id)
+                    self._stats["prefix_host_exports"] += 1
+                else:
+                    # The fleet contract speaks contiguous one-slot
+                    # buffers (slot engines import them as-is; paged
+                    # peers re-blockify): gather the table into that
+                    # layout.
+                    entry = self._export_blocks(tokens, entry)
             return list(tokens), entry, last
+
+    def prefix_in_host_tier(self, prefix_id: int) -> bool:
+        """True when the prefix's KV currently lives only in the
+        host-RAM tier (serve/prefix_store.py counts backfills served
+        from host separately from device exports)."""
+        with self._lock:
+            return prefix_id in self._prefix_host
 
     def import_prefix(self, tokens: List[int], kv: KVCache,
                       last_logits=None) -> int:
@@ -1285,20 +1351,29 @@ class RolloutEngine:
         # guarded-by: caller
         self._prefix_use_seq += 1
         self._prefix_last_use[pid] = self._prefix_use_seq
+        self._prefix_use_count[pid] = (
+            self._prefix_use_count.get(pid, 0) + 1)
 
     def release_prefix(self, prefix_id: int) -> None:
         """Free a registered prefix's KV buffer. In the paged layout
         this drops the prefix's reference on each of its blocks;
         consumers that grafted the table keep their own references, so
         an in-flight request survives its donor's eviction (blocks
-        return to the pool only when the LAST table drops them)."""
+        return to the pool only when the LAST table drops them). A
+        host-tiered prefix (blocks swapped out) just drops its host
+        buffers — there are no pool references left to release."""
         with self._lock:
             entry = self._prefixes.pop(prefix_id, None)
             self._prefix_last_use.pop(prefix_id, None)
+            self._prefix_use_count.pop(prefix_id, None)
+            hp = self._prefix_host.pop(prefix_id, None)
             if entry is not None:
                 self._prefix_by_tokens.pop(tuple(entry[0]), None)
-                if self.kv_layout == "paged":
+                if self.kv_layout == "paged" and entry[1] is not None:
                     self._alloc.release(entry[1])
+            if hp is not None:
+                self._alloc.set_swapped_blocks(
+                    self._swapped_blocks_total())
 
     # -- internals ----------------------------------------------------------
 
@@ -1767,16 +1842,128 @@ class RolloutEngine:
         self._release_row(row)
         self._queue.appendleft(req)
         self._stats["kv_preemptions"] += 1
+        req.preempt_count += 1
+        if (req.preempt_count >= self.engine_config.max_preempts
+                and req.rid not in self._storm_rids):
+            # starvation latch: this request is now non-preemptible
+            # (counted once per rid, not once per further near-miss)
+            self._storm_rids.add(req.rid)
+            self._stats["kv_preemption_storms"] += 1
+            if self._storm_total is not None:
+                self._storm_total.inc()
+
+    def _prefix_candidates(self) -> List[PrefixCandidate]:
+        # guarded-by: caller
+        """Resident (device-backed) prefix entries as scoring
+        candidates; swapped-out entries hold no pool blocks and cannot
+        be victims."""
+        out = []
+        for pid, (tokens, blocks, _last) in self._prefixes.items():
+            if blocks is None:
+                continue
+            consumers = max(
+                (self._alloc.refcount(b) - 1 for b in blocks),
+                default=0)
+            out.append(PrefixCandidate(
+                pid=pid, num_tokens=len(tokens),
+                num_blocks=len(blocks), consumers=consumers,
+                last_use=self._prefix_last_use.get(pid, 0),
+                use_count=self._prefix_use_count.get(pid, 0)))
+        return out
+
+    def _evict_or_tier_prefix(self) -> bool:
+        # guarded-by: caller
+        """Scored prefix reclamation (kv_pressure.pick_victim): drop or
+        host-tier the entry the pool can best afford to lose. Unshared
+        prefixes always go before shared ones, cold-and-cheap before
+        hot-and-expensive; warm/shared victims swap to the host tier
+        (restorable) while cold unshared ones are simply evicted."""
+        victim = pick_victim(self._prefix_candidates(),
+                             self._prefix_use_seq)
+        if victim is None:
+            return False
+        cfg = self.engine_config
+        if should_tier(victim, host_tier=cfg.host_tier,
+                       tier_min_uses=cfg.tier_min_uses):
+            try:
+                self._swap_out_prefix(victim.pid)
+                return True
+            except Exception:
+                # torn swap (chaos, device loss): the entry is still
+                # fully resident — fall through to plain eviction so
+                # reclamation still makes progress
+                pass
+        self.release_prefix(victim.pid)
+        self._stats["prefix_evictions"] += 1
+        self._alloc.count_eviction()
+        return True
+
+    def _swapped_blocks_total(self) -> int:
+        # guarded-by: caller
+        return sum(hp.num_blocks for hp in self._prefix_host.values())
+
+    def _swap_out_prefix(self, pid: int) -> None:
+        # guarded-by: caller
+        """Tier a resident prefix to host RAM: gather its blocks into
+        contiguous buffers, land them on the host, and only then flip
+        the bookkeeping (entry -> None, blocks released). Any failure
+        before the flip leaves the prefix fully resident and the pool
+        untouched — a swap can tear but never half-apply."""
+        tokens, blocks, last = self._prefixes[pid]
+        nblk = len(blocks)
+        k, v = gather_blocks(self.pool, np.asarray(blocks, np.int32))
+        k_h, v_h = profiled_device_get((k, v), "engine.swap_out")
+        bs = self._alloc.block_size
+        k_b, v_b = blockify_host(np.asarray(k_h), np.asarray(v_h),
+                                 nblk, bs)
+        # -- point of no return: pure host bookkeeping from here ------
+        self._prefix_host[pid] = HostPrefix(k=k_b, v=v_b,
+                                            num_tokens=len(tokens))
+        self._prefixes[pid] = (tokens, None, last)
+        self._alloc.release(blocks)
+        self._alloc.count_swap_out(nblk)
+        self._alloc.set_swapped_blocks(self._swapped_blocks_total())
+        self._stats["prefix_swap_outs"] += 1
+
+    def _restore_prefix(self, pid: int) -> bool:
+        # guarded-by: caller
+        """Swap a host-tiered prefix back into the pool (the same
+        install scatter the cross-engine import uses — host numpy
+        feeds pjit directly). False when the pool cannot grant the
+        blocks even after reclamation: the caller degrades to a full
+        prefill and the host copy is KEPT for the next attempt."""
+        tokens, _blocks, last = self._prefixes[pid]
+        hp = self._prefix_host[pid]
+        nblk = hp.num_blocks
+        try:
+            blocks = self._alloc_blocks_evicting(nblk)
+        except BlocksExhausted:
+            return False
+        try:
+            self.pool = install_blocks(self.pool, hp.k, hp.v,
+                                       np.asarray(blocks, np.int32))
+        except Exception:
+            self._alloc.release(blocks)
+            raise
+        self._prefixes[pid] = (tokens, blocks, last)
+        del self._prefix_host[pid]
+        self._alloc.count_swap_in(nblk)
+        self._alloc.set_swapped_blocks(self._swapped_blocks_total())
+        self._stats["prefix_swap_ins"] += 1
+        return True
 
     def _reclaim_blocks(self, row: int, committed,
                         allow_preempt: bool = True) -> bool:
         # guarded-by: caller
-        """Free pool capacity, cheapest casualty first: held
+        """Free pool capacity, cheapest casualty first — the pressure
+        ladder (docs/serving.md "KV memory hierarchy"): held
         conversations (pure cache — the continuation re-prefills), then
-        LRU prefixes (consumers keep their grafted references), then
-        the youngest other active request (recompute preemption).
-        Returns False when nothing further can be reclaimed for
-        ``row`` — including after preempting ``row`` itself."""
+        scored prefix eviction/tiering (kv_pressure: cold unshared
+        entries drop, warm/shared ones swap to host), then the youngest
+        other active request still under the preemption cap (recompute
+        preemption). Returns False when nothing further can be
+        reclaimed for ``row`` — including after preempting ``row``
+        itself."""
         held = [s for s in range(self.num_slots)
                 if self._slot_held[s] is not None]
         if held:
@@ -1784,17 +1971,15 @@ class RolloutEngine:
             self._drop_hold(oldest)
             self._stats["hold_evictions"] += 1
             return True
-        if self._prefix_last_use:
-            lru = min(self._prefix_last_use,
-                      key=self._prefix_last_use.get)
-            self.release_prefix(lru)
-            self._stats["prefix_evictions"] += 1
+        if self._evict_or_tier_prefix():
             return True
         if not allow_preempt:
             return False
+        cap = self.engine_config.max_preempts
         victims = [s for s in range(self.num_slots)
                    if s != row and s not in committed
-                   and self._slot_req[s] is not None]
+                   and self._slot_req[s] is not None
+                   and self._slot_req[s].preempt_count < cap]
         if victims:
             youngest = max(victims, key=lambda s: self._slot_req[s].rid)
             self._preempt_row(youngest)
@@ -1803,9 +1988,12 @@ class RolloutEngine:
             req = self._slot_req[row]
             need = self._alloc.blocks_for(
                 len(req.prompt) + len(req.tokens) + 1)
-            if need > self._alloc.num_blocks:
-                # could never fit even with the pool to itself:
-                # truncate-finish instead of requeue-livelock
+            if need > self._alloc.num_blocks or req.preempt_count >= cap:
+                # could never fit even with the pool to itself, or the
+                # request already burned its preemption budget and
+                # every other row is capped too: truncate-finish
+                # instead of requeue-livelock — the request completes
+                # (short), it is never lost
                 self._finish_request(req, row)
             else:
                 self._preempt_row(row)
@@ -1883,6 +2071,20 @@ class RolloutEngine:
         return KVCache(k=k[:, None, :cap], v=v[:, None, :cap],
                        length=jnp.full((1,), len(tokens), jnp.int32))
 
+    def _export_host(self, pid: int) -> KVCache:
+        # guarded-by: caller
+        """Fleet-contract one-slot buffer built from a host-tiered
+        prefix — all numpy, zero device traffic on the donor; the
+        importer's install scatter ingests host arrays directly."""
+        hp = self._prefix_host[pid]
+        k, v = unblockify_host(hp)
+        cap = self.max_len
+        if k.shape[1] < cap:
+            pad = ((0, 0), (0, cap - k.shape[1]), (0, 0), (0, 0))
+            k, v = np.pad(k, pad), np.pad(v, pad)
+        return KVCache(k=k[:, None, :cap], v=v[:, None, :cap],
+                       length=np.full((1,), hp.num_tokens, np.int32))
+
     def _tables_device(self) -> jnp.ndarray:
         # guarded-by: caller
         """Dense (num_slots, mb) int32 block-table array for the fused
@@ -1951,6 +2153,21 @@ class RolloutEngine:
             return
         if req.prefix_id is not None:
             p_tokens, p_blocks, p_last = self._prefixes[req.prefix_id]
+            if p_blocks is None:
+                # host-tiered prefix: swap it back in on demand; if the
+                # pool cannot grant the blocks even after reclamation,
+                # degrade to a full prefill (the host copy is kept for
+                # the next consumer)
+                if self._restore_prefix(req.prefix_id):
+                    p_tokens, p_blocks, p_last = (
+                        self._prefixes[req.prefix_id])
+                else:
+                    req.prefix_id = None
+                    self._stats["prefix_cache_misses"] += 1
+                    self._stats["prefill_tokens"] += len(req.prompt)
+                    self._prefill_jobs[req.rid] = _PrefillJob(
+                        toks=list(req.prompt), pos=0, sample_last=True)
+                    return
             self._touch_prefix(req.prefix_id)
             # THE graft: the install is a refcount bump on the prefix's
             # blocks — zero KV bytes move (vs the slot layout's
@@ -2240,7 +2457,8 @@ class RolloutEngine:
         used_tokens = sum(self._row_len[s] for s in range(self.num_slots)
                           if self._tables[s])
         for _p_tokens, p_blocks, _last in self._prefixes.values():
-            used_tokens += len(_p_tokens)
+            if p_blocks is not None:  # host-tiered entries hold no pool
+                used_tokens += len(_p_tokens)
         self._alloc.publish_fragmentation(used_tokens)
         self._schedule()
         return emitted

@@ -117,7 +117,8 @@ class BlockAllocator:
         self._ref: List[int] = [0] * num_blocks  # guarded-by: _lock
         self._counters: Dict[str, int] = {  # guarded-by: _lock
             "allocs": 0, "releases": 0, "grafts": 0, "cow_copies": 0,
-            "exhaustions": 0, "install_copies": 0}
+            "exhaustions": 0, "install_copies": 0, "evictions": 0,
+            "swap_outs": 0, "swap_ins": 0}
         if registry is None:
             from ..obs import get_registry
             registry = get_registry()
@@ -150,6 +151,21 @@ class BlockAllocator:
             "senweaver_kv_exhaustion_rejections_total",
             "Allocations refused because the block pool was exhausted "
             "(preemptions + admission rejections).")
+        self._eviction_total = registry.counter(
+            "senweaver_kv_evictions_total",
+            "Prefix entries dropped by scored eviction (cold, unshared: "
+            "cheapest to recompute).")
+        self._swap_out_total = registry.counter(
+            "senweaver_kv_swaps_out_total",
+            "KV blocks swapped from the device pool to the host-RAM "
+            "tier (warm prefixes under pressure).")
+        self._swap_in_total = registry.counter(
+            "senweaver_kv_swaps_in_total",
+            "KV blocks restored from the host-RAM tier into the device "
+            "pool (on-demand prefix reuse).")
+        self._swapped_gauge = registry.gauge(
+            "senweaver_kv_swapped_blocks",
+            "KV blocks currently resident only in the host-RAM tier.")
         self._publish_gauges()
 
     # -- introspection (reads; callers may race, values are advisory) ----
@@ -256,6 +272,29 @@ class BlockAllocator:
         with self._lock:
             self._counters["install_copies"] += n
             self._install_copy_total.inc(n)
+
+    def count_eviction(self, n: int = 1) -> None:
+        """Account ``n`` prefix entries dropped by scored eviction."""
+        with self._lock:
+            self._counters["evictions"] += n
+            self._eviction_total.inc(n)
+
+    def count_swap_out(self, nblk: int) -> None:
+        """Account ``nblk`` blocks tiered device → host."""
+        with self._lock:
+            self._counters["swap_outs"] += nblk
+            self._swap_out_total.inc(nblk)
+
+    def count_swap_in(self, nblk: int) -> None:
+        """Account ``nblk`` blocks restored host → device."""
+        with self._lock:
+            self._counters["swap_ins"] += nblk
+            self._swap_in_total.inc(nblk)
+
+    def set_swapped_blocks(self, n: int) -> None:
+        """Publish how many blocks live only in the host tier."""
+        with self._lock:
+            self._swapped_gauge.set(n)
 
     # -- gauges ----------------------------------------------------------
     def _publish_gauges(self) -> None:

@@ -44,6 +44,7 @@ REJECT_RATE_LIMITED = "rate_limited"
 REJECT_DEADLINE = "deadline"
 REJECT_REPLICA_FAILURE = "replica_failure"
 REJECT_NO_REPLICAS = "no_replicas"
+REJECT_KV_PRESSURE = "kv_pressure"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,6 +144,14 @@ class ClassPolicy:
 class AdmissionConfig:
     interactive: ClassPolicy = ClassPolicy(max_queue=64)
     train_rollout: ClassPolicy = ClassPolicy(max_queue=512)
+    # KV-pool pressure watermarks (rollout/kv_pressure.WatermarkGate):
+    # the fleet feeds note_kv_pressure() each pump; at >= high the
+    # queue gates — new offers shed with REJECT_KV_PRESSURE and
+    # dispatch defers — until pressure drains to <= low. Backpressure
+    # thus arrives BEFORE BlocksExhausted, and in-flight decodes (whose
+    # blocks are already granted) always run to completion.
+    kv_pressure_high: float = 0.92
+    kv_pressure_low: float = 0.75
 
     def policy(self, priority: str) -> ClassPolicy:
         if priority == INTERACTIVE:
@@ -208,8 +217,32 @@ class AdmissionQueue:
             "senweaver_serve_admitted_total",
             "Requests admitted past the queue/rate gates.",
             labelnames=("priority",))
+        from ..rollout.kv_pressure import WatermarkGate
+        self._kv_gate = WatermarkGate(config.kv_pressure_high,
+                                      config.kv_pressure_low)
+        self._kv_pressure = 0.0
+        self._kv_gated_gauge = registry.gauge(
+            "senweaver_serve_kv_gated",
+            "1 while admission is gated on KV-pool pressure "
+            "(watermark hysteresis), else 0.")
+        self._kv_gated_gauge.set(0)
         for p in PRIORITY_CLASSES:      # pre-touch so gauges render at 0
             self._depth_gauge.set(0, priority=p)
+
+    # -- pressure ------------------------------------------------------------
+    def note_kv_pressure(self, pressure: float) -> bool:
+        """Feed the fleet's KV pool-pressure sample (0..1, worst
+        placeable replica). Returns the resulting gate state: True =
+        new offers shed and dispatch deferred until pressure drains
+        below the low watermark."""
+        self._kv_pressure = float(pressure)
+        gated = self._kv_gate.update(self._kv_pressure)
+        self._kv_gated_gauge.set(1 if gated else 0)
+        return gated
+
+    @property
+    def kv_gated(self) -> bool:
+        return self._kv_gate.gated
 
     # -- intake --------------------------------------------------------------
     def offer(self, req: FleetRequest, now: float) -> Optional[Rejected]:
@@ -217,6 +250,15 @@ class AdmissionQueue:
         (queue full / rate limited), None on admission. Applies the
         class default deadline when the request carries none."""
         pol = self.config.policy(req.priority)
+        if self._kv_gate.gated:
+            # proactive backpressure: the pool is near exhaustion
+            # fleet-wide — shed at the door (typed, before any blocks
+            # are at stake) rather than let BlocksExhausted preempt
+            # someone already decoding
+            return self._shed(req, REJECT_KV_PRESSURE,
+                              f"kv pool pressure "
+                              f"{self._kv_pressure:.2f} >= "
+                              f"{self.config.kv_pressure_high:g}")
         bucket = self._buckets[req.priority]
         if bucket is not None and not bucket.try_take(now):
             return self._shed(req, REJECT_RATE_LIMITED,
@@ -253,7 +295,14 @@ class AdmissionQueue:
         the request closest to blowing it runs next), deadline-less
         requests after all deadline-bearing ones in FIFO order.
         ``not_before`` backoff is honored: a request inside its retry
-        floor is skipped without losing its queue position."""
+        floor is skipped without losing its queue position.
+
+        While the KV-pressure gate is engaged, dispatch DEFERS: already
+        -queued requests keep their positions (the deadline sweep still
+        runs) and drain once in-flight completions release blocks and
+        the gate opens at the low watermark."""
+        if self._kv_gate.gated:
+            return None, self.shed_expired(now)
         sheds: List[Rejected] = []
         picked: Optional[FleetRequest] = None
         for p in PRIORITY_CLASSES:
@@ -328,8 +377,11 @@ class AdmissionQueue:
         return sum(len(q) for q in self._queues.values())
 
     def stats(self) -> Dict[str, Any]:
-        return {f"queue_depth_{p}": len(self._queues[p])
-                for p in PRIORITY_CLASSES}
+        out = {f"queue_depth_{p}": len(self._queues[p])
+               for p in PRIORITY_CLASSES}
+        out["kv_pressure"] = self._kv_pressure
+        out["kv_gated"] = int(self._kv_gate.gated)
+        return out
 
     # -- internals -----------------------------------------------------------
     def _shed(self, req: FleetRequest, reason: str,

@@ -49,9 +49,14 @@ class AutoscaleConfig:
     min_replicas: int = 1
     max_replicas: int = 8
     # Overload when queue depth >= this OR shed rate (sheds/sec over the
-    # evaluation window) >= shed_rate_high.
+    # evaluation window) >= shed_rate_high OR fleet KV pool pressure
+    # (senweaver_kv_pressure, published by the fleet pump) >=
+    # kv_pressure_high — the memory-pressure ladder's "scale" rung,
+    # fired by the same gauge admission gates on, so capacity arrives
+    # BEFORE BlocksExhausted starts preempting.
     queue_depth_high: int = 8
     shed_rate_high: float = 1.0
+    kv_pressure_high: float = 0.9
     sustain_s: float = 2.0          # overload must hold this long
     idle_sustain_s: float = 10.0    # idleness must hold this long
     cooldown_s: float = 5.0         # min gap between ANY two actions
@@ -102,6 +107,13 @@ class AutoscaleController:
     def _live(self):
         return [r for r in self.fleet.replicas if r.state != DEAD]
 
+    def _kv_pressure(self) -> float:
+        m = self._registry.get("senweaver_kv_pressure")
+        if m is None:
+            return 0.0
+        vals = m.samples().values()
+        return max((float(v) for v in vals), default=0.0)
+
     # -- the controller ------------------------------------------------------
     def evaluate(self, now: float) -> Optional[str]:
         """One hysteresis tick; returns the action taken (if any).
@@ -131,9 +143,12 @@ class AutoscaleController:
 
         depth = self.fleet.admission.depth()
         live = self._live()
+        kv_pressure = self._kv_pressure()
         overloaded = (depth >= cfg.queue_depth_high
-                      or shed_rate >= cfg.shed_rate_high)
+                      or shed_rate >= cfg.shed_rate_high
+                      or kv_pressure >= cfg.kv_pressure_high)
         idle = (depth == 0 and shed_rate == 0.0
+                and kv_pressure < cfg.kv_pressure_high
                 and all(r.outstanding == 0 for r in live))
 
         self._overload_since = (

@@ -146,6 +146,15 @@ class ServingFleet:
             "senweaver_serve_replicas_live",
             "Replicas not DEAD.")
         self._replicas_live.set(len(self.replicas))
+        # Fleet KV pool pressure: min over placeable (accepting)
+        # replicas — if ANY replica still has block headroom the fleet
+        # can route there, so that is the honest backpressure signal.
+        # Admission watermarks and the autoscaler both read this.
+        self._kv_pressure_gauge = registry.gauge(
+            "senweaver_kv_pressure",
+            "Fleet KV pool pressure (0..1): the least-pressured "
+            "placeable replica's block-pool utilization.")
+        self._kv_pressure_gauge.set(0.0)
         self._continuation_replays = registry.counter(
             "senweaver_serve_continuation_replays_total",
             "Held-slot turn continuations replayed on a survivor after "
@@ -389,6 +398,7 @@ class ServingFleet:
             self._track_publish_window(now)
             self._reap_quarantined(now)
             self._probe_replicas(now)
+            self._note_kv_pressure()
             for rej in self.admission.shed_expired(now):
                 self._record_rejection(rej)
             if self.autoscaler is not None:
@@ -571,6 +581,7 @@ class ServingFleet:
                     self._track_publish_window(now)
                     self._reap_quarantined(now)
                     self._probe_replicas(now)
+                    self._note_kv_pressure()
                     for rej in self.admission.shed_expired(now):
                         self._record_rejection(rej)
                     if self.autoscaler is not None:
@@ -590,6 +601,22 @@ class ServingFleet:
             self._dispatcher = None
         for replica in self.replicas:
             replica.stop()
+
+    def _note_kv_pressure(self) -> None:
+        # guarded-by: _lock
+        """Sample fleet KV pool pressure and feed the admission gate.
+
+        The aggregate is the MIN over accepting live replicas (any
+        headroom anywhere means the fleet can still place work); with
+        none accepting, the min over all live ones. Runs every pump,
+        BEFORE autoscaler.evaluate and _dispatch, so both planes act on
+        this pump's signal rather than last pump's."""
+        live = [r for r in self.replicas if r.state != DEAD]
+        pool = [r for r in live if r.accepting] or live
+        pressure = min((float(getattr(r, "kv_pressure", 0.0))
+                        for r in pool), default=0.0)
+        self._kv_pressure_gauge.set(pressure)
+        self.admission.note_kv_pressure(pressure)
 
     def _on_replica_step(self, replica: EngineReplica,
                          emitted: Dict[int, List[int]],

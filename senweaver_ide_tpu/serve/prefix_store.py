@@ -106,6 +106,11 @@ class SharedPrefixStore:
         self._shared_gauge = registry.gauge(
             "senweaver_serve_prefix_shared",
             "Shared prefixes currently registered in the store.")
+        self._host_backfills_total = registry.counter(
+            "senweaver_serve_prefix_host_backfills_total",
+            "Donor exports served from the engine's host-RAM KV tier "
+            "(the prefix had been swapped out — the broadcast cost "
+            "zero donor device traffic and no re-prefill).")
         self._shared_gauge.set(0)
 
     # -- registry ------------------------------------------------------------
@@ -196,10 +201,18 @@ class SharedPrefixStore:
     def _donate(self, entry: _SharedPrefix,
                 replica: EngineReplica) -> None:
         """First dispatch: ``replica`` pays the ONE prefill, then its
-        buffer broadcasts to every other live replica."""
+        buffer broadcasts to every other live replica. A donor whose
+        engine had already tiered the prefix to host RAM serves the
+        export straight from its host buffers — counted separately,
+        since the fleet then backfilled without any prefill OR device
+        readback."""
         try:
+            probe = getattr(replica, "prefix_in_host_tier", None)
+            from_host = bool(probe(tuple(entry.tokens))) if probe else False
             tokens, kv, last = replica.register_shared_prefix(
                 entry.tokens)
+            if from_host:
+                self._host_backfills_total.inc()
         except Exception:
             # Donor prefill failed (chaos / OOM): leave kv unset so the
             # next dispatch elects a new donor; repeated failure is the

@@ -124,6 +124,13 @@ class EngineReplica:
             note(tokens)
 
     @property
+    def kv_pressure(self) -> float:
+        """This replica's KV block-pool utilization (0..1; 0.0 for
+        engines without a pool) — the fleet pump aggregates it into the
+        senweaver_kv_pressure gauge admission/autoscale watermark on."""
+        return float(getattr(self.engine, "kv_pressure", 0.0))
+
+    @property
     def accepting(self) -> bool:
         """Routable: live with a free decode slot."""
         with self._lock:
@@ -132,6 +139,18 @@ class EngineReplica:
     def holds_prefix(self, tokens: Tuple[int, ...]) -> bool:
         with self._lock:
             return tokens in self._prefixes
+
+    def prefix_in_host_tier(self, tokens: Tuple[int, ...]) -> bool:
+        """True when this replica holds the prefix but its KV currently
+        lives in the engine's host-RAM tier (a donor export from here
+        costs zero device traffic — prefix_store counts those backfills
+        separately)."""
+        with self._lock:
+            pid = self._prefixes.get(tuple(tokens))
+            if pid is None:
+                return False
+            probe = getattr(self.engine, "prefix_in_host_tier", None)
+            return bool(probe(pid)) if probe is not None else False
 
     # -- shared prefix broadcast (serve/prefix_store.py) ---------------------
     def register_shared_prefix(self, tokens: List[int]):

@@ -368,6 +368,17 @@ class DashboardService:
                     total("senweaver_kv_install_copies_total"),
                 "exhaustion_rejections": total(
                     "senweaver_kv_exhaustion_rejections_total"),
+                # memory-pressure ladder: how often each rung fired,
+                # how much KV currently lives in the host tier, and
+                # whether admission is shedding on pool pressure
+                "pressure": worst("senweaver_kv_pressure"),
+                "evictions": total("senweaver_kv_evictions_total"),
+                "swaps_out": total("senweaver_kv_swaps_out_total"),
+                "swaps_in": total("senweaver_kv_swaps_in_total"),
+                "swapped_blocks": total("senweaver_kv_swapped_blocks"),
+                "preemption_storms": total(
+                    "senweaver_kv_preemption_storms_total"),
+                "kv_gated": total("senweaver_serve_kv_gated"),
             }
         except Exception as e:
             return {"error": str(e)}
