@@ -1054,6 +1054,139 @@ def _measure_spec_adaptive(*, num_slots: int = 4, n_requests: int = 12,
     }
 
 
+def _measure_multi_lora(*, n_tenants: int = 6, reqs_per_tenant: int = 2,
+                        decode_tokens: int = 16) -> dict:
+    """Multi-tenant adapter economics (ISSUE 14): the same N-tenant
+    request mix served (a) batched through ONE pool engine — every
+    tenant's rows share each fused step via the gathered adapter
+    banks — vs (b) sequentially with a swap-per-tenant engine
+    (update_params(merge_lora(...)) then that tenant's requests alone,
+    the pre-pool serving story). Outputs are asserted token-exact
+    across arms; the acceptance signal is aggregate tok/s >= 1.5x,
+    plus per-tenant mean TTFT for both arms and the gathered-step
+    overhead vs a base-only batch of the same shape."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import (AdapterPool, AdapterPoolConfig,
+                                           EngineConfig, RolloutEngine)
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.training.lora import init_lora, merge_lora
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    num_slots = n_tenants * reqs_per_tenant
+    tenants = [f"tenant-{i}" for i in range(n_tenants)]
+    loras = {}
+    for i, name in enumerate(tenants):
+        lora = init_lora(config, jax.random.PRNGKey(10 + i),
+                         rank=8 if i % 2 else 16)
+        for k in list(lora["layers"]):
+            if k.endswith("_lora_b"):
+                lora["layers"][k] = jax.random.normal(
+                    jax.random.PRNGKey(50 + i), lora["layers"][k].shape,
+                    lora["layers"][k].dtype) * 0.05
+        loras[name] = lora
+    mix = [(name, [(i * 13 + t * 7 + j) % 200 + 2 for j in range(8)])
+           for t, name in enumerate(tenants)
+           for i in range(reqs_per_tenant)]
+
+    def drain_with_ttft(eng, rids, t0):
+        first, out = {}, {r: [] for r in rids}
+        while eng.has_work:
+            emitted = eng.step()
+            now = _time.perf_counter()
+            for r, toks in emitted.items():
+                if toks and r not in first:
+                    first[r] = now - t0
+                out[r].extend(toks)
+        return out, first
+
+    def run_batched():
+        pool = AdapterPool(config, AdapterPoolConfig())
+        eng = RolloutEngine(
+            params, config, num_slots=num_slots, max_len=128,
+            sample=greedy, adapter_pool=pool,
+            engine_config=EngineConfig(kv_layout="paged"))
+        for name, lora in loras.items():
+            eng.publish_adapter(name, lora)
+        t0 = _time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=decode_tokens,
+                           adapter_id=name) for name, p in mix]
+        out, first = drain_with_ttft(eng, rids, t0)
+        dt = _time.perf_counter() - t0
+        return ([out[r] for r in rids], dt,
+                sum(first.values()) / len(first), pool)
+
+    def run_sequential():
+        eng = RolloutEngine(
+            params, config, num_slots=num_slots, max_len=128,
+            sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged"))
+        outs, ttfts = [], []
+        # TTFT from the ARM start: the whole mix arrives together, so a
+        # later tenant's first token honestly includes waiting for every
+        # earlier tenant's swap + decode (the queue the pool removes).
+        t0 = _time.perf_counter()
+        for name in tenants:
+            eng.update_params(merge_lora(params, loras[name]))
+            rids = [eng.submit(p, max_new_tokens=decode_tokens)
+                    for n2, p in mix if n2 == name]
+            out, first = drain_with_ttft(eng, rids, t0)
+            outs.extend(out[r] for r in rids)
+            ttfts.extend(first.values())
+        dt = _time.perf_counter() - t0
+        return outs, dt, sum(ttfts) / len(ttfts)
+
+    def run_base_only():
+        eng = RolloutEngine(
+            params, config, num_slots=num_slots, max_len=128,
+            sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged"))
+        t0 = _time.perf_counter()
+        for _, p in mix:
+            eng.submit(p, max_new_tokens=decode_tokens)
+        eng.run()
+        return _time.perf_counter() - t0
+
+    t_warm = _time.perf_counter()
+    run_batched(); run_sequential(); run_base_only()   # compile warmup
+    compile_s = _time.perf_counter() - t_warm
+    obs._reset_for_tests()
+    base_dt = run_base_only()
+    seq_out, seq_dt, seq_ttft = run_sequential()
+    t0 = _time.perf_counter()
+    bat_out, bat_dt, bat_ttft, pool = run_batched()
+    _stamp_timing("multi_lora", compile_s, _time.perf_counter() - t0)
+
+    # The batched arm must be reordered back to the sequential arm's
+    # tenant-major order before comparing (same mix, same order here).
+    exact = bat_out == seq_out
+    tokens = sum(len(t) for t in bat_out)
+    overhead = bat_dt / base_dt if base_dt > 0 else 1.0
+    pool.note_gather_overhead(overhead)
+    out = {
+        "n_tenants": n_tenants,
+        "requests": len(mix),
+        "outputs_exact": exact,
+        "batched_tok_s": round(tokens / bat_dt, 1),
+        "sequential_swap_tok_s": round(tokens / seq_dt, 1),
+        "aggregate_speedup": round(seq_dt / bat_dt, 2),
+        "batched_mean_ttft_s": round(bat_ttft, 4),
+        "sequential_mean_ttft_s": round(seq_ttft, 4),
+        "gather_overhead_vs_base": round(overhead, 3),
+        "pool": {k: v for k, v in pool.stats().items()
+                 if k in ("publishes", "installs", "evictions")},
+    }
+    obs._reset_for_tests()
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -1194,6 +1327,15 @@ def main() -> None:
         extra["spec_adaptive"] = _measure_spec_adaptive()
     except Exception as e:
         extra["spec_adaptive"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Multi-tenant adapter economics (batched N-tenant pool decode vs
+    # sequential swap-per-tenant on the same request mix). Protocol-
+    # level, so tiny-test covers it on every backend.
+    try:
+        _log("multi-tenant adapter measure: multi_lora")
+        extra["multi_lora"] = _measure_multi_lora()
+    except Exception as e:
+        extra["multi_lora"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Cross-host dispatch economics (loopback remote fleet vs the same
     # engines in-process) plus held-slot continuation replay latency.

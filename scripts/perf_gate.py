@@ -289,10 +289,62 @@ def _case_kv_pressure() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_multi_lora() -> Dict[str, Any]:
+    """Batched multi-tenant LoRA decode (ISSUE 14): four tenants across
+    both rank rungs ride one pool engine's fused step via the gathered
+    adapter banks. Gates that the gathered path stays steady-state
+    compile-free — each iteration rebuilds the pool and re-acquires
+    every slot, so tenant churn must land on warm signatures — and
+    tracks the mixed-batch end-to-end time."""
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import (AdapterPool, AdapterPoolConfig,
+                                           EngineConfig, RolloutEngine)
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+    from senweaver_ide_tpu.training.lora import init_lora
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompts = [[(i * 7 + j) % 200 + 2 for j in range(16)]
+               for i in range(4)]
+    loras = {}
+    for i in range(4):
+        lora = init_lora(config, jax.random.PRNGKey(10 + i),
+                         rank=8 if i % 2 else 16)
+        for k in list(lora["layers"]):
+            if k.endswith("_lora_b"):
+                lora["layers"][k] = jax.random.normal(
+                    jax.random.PRNGKey(50 + i), lora["layers"][k].shape,
+                    lora["layers"][k].dtype) * 0.05
+        loras[f"tenant-{i}"] = lora
+
+    def run():
+        pool = AdapterPool(config, AdapterPoolConfig(slots_per_rank=2))
+        eng = RolloutEngine(
+            params, config, num_slots=4, max_len=128, sample=greedy,
+            adapter_pool=pool,
+            engine_config=EngineConfig(kv_layout="paged"))
+        for name, lora in loras.items():
+            eng.publish_adapter(name, lora)
+        for p, name in zip(prompts, loras):
+            eng.submit(p, max_new_tokens=24, adapter_id=name)
+        eng.run()
+        eng._alloc.check_leaks()
+
+    run()                                   # warmup: compiles land here
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")}
+
+
 CASES = {
     "engine_decode": _case_engine_decode,
     "spec_decode": _case_spec_decode,
     "kv_pressure": _case_kv_pressure,
+    "multi_lora": _case_multi_lora,
     "train_step": _case_train_step,
     "reward_head": _case_reward_head,
 }

@@ -77,6 +77,7 @@ RULES: Dict[str, str] = {
 # Host modules whose decode/step drivers get the JIT110 sync budget.
 HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/obs/runtime_profile.py",
+    "senweaver_ide_tpu/rollout/adapter_pool.py",
     "senweaver_ide_tpu/rollout/engine.py",
     "senweaver_ide_tpu/rollout/kv_pressure.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",

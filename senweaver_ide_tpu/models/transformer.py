@@ -188,13 +188,50 @@ def _dense(h: jax.Array, lp: Dict[str, jax.Array], name: str,
     return out
 
 
+def _adapter_delta(h: jax.Array, adapters, adapter_ids, name: str):
+    """Gathered multi-LoRA delta for a flat token batch: each row t
+    applies ITS adapter's factors, ``B[ids[t]] @ (A[ids[t]] @ h[t])``.
+
+    ``adapters`` is the pool's rank ladder (rollout/adapter_pool.py) —
+    one bank dict per rung, each leaf ``(slots+1, d_in, r)`` /
+    ``(slots+1, r, d_out)`` after the layer scan consumes the leading
+    L axis — and ``adapter_ids`` the matching per-rung ``(T,)`` slot
+    vectors. Slot 0 of every rung is the permanent null adapter
+    (A = B = 0), so base-only rows contribute exact zeros and the sum
+    over rungs needs no masking: a row is non-null in at most one
+    rung. Returns None when no bank carries this target."""
+    out = None
+    for bank, ids in zip(adapters, adapter_ids):
+        a = bank.get(name + "_lora_a")
+        if a is None:
+            continue
+        b = bank[name + "_lora_b"]
+        d = jnp.einsum("tsr,tro->tso",
+                       jnp.einsum("tsi,tir->tsr", h, a[ids]), b[ids])
+        out = d if out is None else out + d
+    return out
+
+
+def _with_adapter(out: jax.Array, h: jax.Array, adapters, adapter_ids,
+                  name: str) -> jax.Array:
+    if adapters is None:
+        return out
+    d = _adapter_delta(h, adapters, adapter_ids, name)
+    return out if d is None else out + d
+
+
 def _qkv(c: ModelConfig, lp: Dict[str, jax.Array], h: jax.Array,
-         cos: jax.Array, sin: jax.Array):
+         cos: jax.Array, sin: jax.Array, adapters=None, adapter_ids=None):
     """Project + rotate. h: (B, S, D) → q (B,S,Hq,Dh), k/v (B,S,Hkv,Dh)."""
     b, s, _ = h.shape
     q = _dense(h, lp, "wq", "bsd,de->bse")
     k = _dense(h, lp, "wk", "bsd,de->bse")
     v = _dense(h, lp, "wv", "bsd,de->bse")
+    # Per-row adapter deltas land where the merged-LoRA ``_dense`` hook
+    # would: after the base matmul, before bias/reshape/norm/rope.
+    q = _with_adapter(q, h, adapters, adapter_ids, "wq")
+    k = _with_adapter(k, h, adapters, adapter_ids, "wk")
+    v = _with_adapter(v, h, adapters, adapter_ids, "wv")
     if c.qkv_bias:
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, s, c.num_heads, c.head_dim)
@@ -642,7 +679,8 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                  k_pool: jax.Array, v_pool: jax.Array,
                  tables: jax.Array, seq_row: jax.Array,
                  positions: jax.Array, write_block: jax.Array,
-                 write_off: jax.Array, use_kernel: bool = False):
+                 write_off: jax.Array, use_kernel: bool = False,
+                 adapters=None, adapter_ids=None):
     """One transformer block over a paged KV pool (rollout/paged_kv.py).
 
     ``x`` is a flat token batch ``(T, 1, D)`` — T independent
@@ -665,7 +703,8 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
     """
     t = x.shape[0]
     h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
-    q, k, v = _qkv(c, lp, h, cos, sin)   # q (T,1,Hq,Dh), k/v (T,1,Hkv,Dh)
+    q, k, v = _qkv(c, lp, h, cos, sin, adapters, adapter_ids)
+    # q (T,1,Hq,Dh), k/v (T,1,Hkv,Dh)
     k_pool = k_pool.at[write_block, write_off].set(
         k[:, 0].astype(k_pool.dtype), mode="drop")
     v_pool = v_pool.at[write_block, write_off].set(
@@ -684,7 +723,10 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
         valid = kv_pos < positions[:, None] + 1
         out = attention(q, k_seq.astype(x.dtype), v_seq.astype(x.dtype),
                         q_offset=positions, kv_mask=valid, causal=True)
-    x = x + _dense(out.reshape(t, 1, c.q_dim), lp, "wo", "bse,ed->bsd")
+    attn_in = out.reshape(t, 1, c.q_dim)
+    attn_out = _dense(attn_in, lp, "wo", "bse,ed->bsd")
+    attn_out = _with_adapter(attn_out, attn_in, adapters, adapter_ids, "wo")
+    x = x + attn_out
     x, aux = _mlp(c, lp, x)
     return x, (k_pool, v_pool), aux
 
@@ -704,6 +746,8 @@ def forward_paged(
                                   # (num_blocks = drop)
     write_off: jax.Array,         # (T,) int32 — offset within block
     use_kernel: bool = False,     # static: Pallas paged-decode kernel
+    adapters=None,                # per-rung LoRA bank dicts, leading L
+    adapter_ids=None,             # per-rung (T,) int32 slot ids
 ):
     """Run the model over a paged KV pool: every entry of the flat
     ``(T,)`` token batch is one (sequence, position) pair — a decode
@@ -720,31 +764,38 @@ def forward_paged(
                 params, c, tokens, pool_k=pool_k, pool_v=pool_v,
                 tables=tables, seq_row=seq_row, positions=positions,
                 write_block=write_block, write_off=write_off,
-                use_kernel=use_kernel)
+                use_kernel=use_kernel, adapters=adapters,
+                adapter_ids=adapter_ids)
     return _forward_paged_impl(
         params, c, tokens, pool_k=pool_k, pool_v=pool_v, tables=tables,
         seq_row=seq_row, positions=positions, write_block=write_block,
-        write_off=write_off, use_kernel=use_kernel)
+        write_off=write_off, use_kernel=use_kernel, adapters=adapters,
+        adapter_ids=adapter_ids)
 
 
 def _forward_paged_impl(params, c, tokens, *, pool_k, pool_v, tables,
                         seq_row, positions, write_block, write_off,
-                        use_kernel):
+                        use_kernel, adapters=None, adapter_ids=None):
     x = params["embed"][tokens][:, None, :]            # (T, 1, D)
     cos, sin = rope_cos_sin(positions[:, None], c.head_dim, c.rope_theta,
                             scaling=c.rope_scaling)
 
     def body(carry, inputs):
         x, aux = carry
-        lp, k_l, v_l = inputs
+        # Adapter banks carry a leading L axis (rollout/adapter_pool),
+        # so they ride the layer scan as xs; ``adapters is None`` scans
+        # as an empty pytree and unpacks back to None here.
+        lp, k_l, v_l, ad = inputs
         x, (k_l, v_l), layer_aux = _paged_layer(
             c, lp, x, cos, sin, k_l, v_l, tables, seq_row, positions,
-            write_block, write_off, use_kernel=use_kernel)
+            write_block, write_off, use_kernel=use_kernel,
+            adapters=ad, adapter_ids=adapter_ids)
         return (x, aux + layer_aux), (k_l, v_l)
 
     (x, _aux), (k_upd, v_upd) = jax.lax.scan(
         body, (x, jnp.zeros((), jnp.float32)),
-        (params["layers"], pool_k, pool_v), unroll=c.scan_unroll)
+        (params["layers"], pool_k, pool_v, adapters),
+        unroll=c.scan_unroll)
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")

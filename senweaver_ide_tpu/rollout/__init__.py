@@ -1,3 +1,5 @@
+from .adapter_pool import (AdapterBinding, AdapterPool, AdapterPoolConfig,
+                           AdapterPoolFull, StaleAdapterVersion)
 from .checkpoints import (CheckpointEntry, ConversationCheckpoints,
                           FileSnapshotter)
 from .engine import EngineConfig, PrefixImportError, QueueFull, RolloutEngine

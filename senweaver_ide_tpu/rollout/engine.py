@@ -241,7 +241,8 @@ def _paged_fused_step(params: Params, config: ModelConfig,
                       write_block: jax.Array, write_off: jax.Array,
                       pool_k: jax.Array, pool_v: jax.Array,
                       key: jax.Array, sample: SampleParams,
-                      use_kernel: bool):
+                      use_kernel: bool,
+                      adapters=None, adapter_ids=None):
     """One fused paged step over a flat token batch: decode rows and
     exact-size chunked-prefill segments share the same forward under a
     static token budget (``tokens.shape[0]``). Each entry writes its
@@ -250,12 +251,16 @@ def _paged_fused_step(params: Params, config: ModelConfig,
     scatter. Sampling happens in-jit for EVERY row; the host keeps only
     the rows it marked as samplers (decode rows, the final token of a
     completing prefill), so ONE batched device_get per step covers
-    first tokens and decode tokens alike."""
+    first tokens and decode tokens alike. With an adapter pool
+    attached, ``adapters`` (fixed-shape rank-ladder banks) and
+    ``adapter_ids`` (per-rung (T,) slot vectors, null slot 0 for base
+    rows) ride every call, so tenant churn reuses the same compiled
+    signatures."""
     logits, pool_k, pool_v = forward_paged(
         params, config, tokens, pool_k=pool_k, pool_v=pool_v,
         tables=tables, seq_row=seq_row, positions=positions,
         write_block=write_block, write_off=write_off,
-        use_kernel=use_kernel)
+        use_kernel=use_kernel, adapters=adapters, adapter_ids=adapter_ids)
     next_tok = sample_token(logits, key, temperature=sample.temperature,
                             top_k=sample.top_k, top_p=sample.top_p)
     logp = sampled_logprob(logits, next_tok)
@@ -489,6 +494,12 @@ class _Request:
     # times this request lost its blocks to preempt-by-recomputation;
     # at EngineConfig.max_preempts it becomes non-preemptible
     preempt_count: int = 0
+    # multi-tenant LoRA: the tenant key this request decodes under, and
+    # the pool binding (rung, slot, version) resolved at SUBMIT time —
+    # held for the request's whole life (incl. across preemption), so a
+    # mid-decode publish is picked up only by the NEXT request.
+    adapter: Optional[str] = None
+    adapter_binding: Optional[object] = None
 
 
 class RolloutEngine:
@@ -500,7 +511,8 @@ class RolloutEngine:
                  eos_id: Optional[int] = None, seed: int = 0,
                  mesh=None, max_prefixes: int = 8,
                  max_queue: Optional[int] = None,
-                 engine_config: Optional[EngineConfig] = None):
+                 engine_config: Optional[EngineConfig] = None,
+                 adapter_pool=None):
         self.config = config
         self.num_slots = num_slots
         # Sliding-window configs serve from a ring cache: the pool holds
@@ -545,6 +557,14 @@ class RolloutEngine:
         self.kv_layout = ("slots" if requested == "slots" or fallback
                           else "paged")
         self.kv_layout_fallback = fallback
+        # Multi-tenant LoRA (rollout/adapter_pool.py): the pool's banks
+        # + per-row slot ids ride the ONE jitted paged step. Paged-only:
+        # the slot path has no flat-token gather to hook.
+        if adapter_pool is not None and self.kv_layout != "paged":
+            raise ValueError(
+                "adapter_pool needs the paged KV layout"
+                + (f" (fell back to slots: {fallback})" if fallback else ""))
+        self.adapter_pool = adapter_pool
         if self.kv_layout == "slots":
             shape = (config.num_layers, num_slots, max_len,
                      config.num_kv_heads, config.head_dim)
@@ -802,6 +822,37 @@ class RolloutEngine:
             self._spec_reset_ema()
             sp.staleness_gauge.set(0.0)
 
+    # -- multi-tenant adapters ----------------------------------------------
+
+    def publish_adapter(self, adapter_id: str, lora, *,
+                        version: Optional[int] = None) -> int:
+        """No-drain per-tenant adapter publish: hand the pool a new
+        host copy under the tenant's monotonic ``adapter_version``.
+        Nothing resident changes — in-flight requests finish on the
+        binding they acquired at submit, the next submit for this
+        tenant uploads the new version on demand. Unlike
+        ``update_params`` this drops NO prefixes and stamps NO draft
+        stale: the base policy is untouched."""
+        if self.adapter_pool is None:
+            raise RuntimeError("engine has no adapter_pool")
+        return self.adapter_pool.publish(adapter_id, lora, version=version)
+
+    def has_adapter(self, adapter_id: Optional[str]) -> bool:
+        """True when a tenant adapter is published (host copy held);
+        submit(adapter_id=...) will decode under it."""
+        return (self.adapter_pool is not None
+                and self.adapter_pool.has(adapter_id))
+
+    def adapter_resident(self, adapter_id: str) -> bool:
+        """True when the tenant's CURRENT version occupies a device
+        slot (the router's warm-affinity signal)."""
+        return (self.adapter_pool is not None
+                and self.adapter_pool.resident(adapter_id))
+
+    def adapter_stats(self) -> Dict[str, object]:
+        return ({} if self.adapter_pool is None
+                else self.adapter_pool.stats())
+
     def spec_note_publish_begin(self) -> None:
         """Fleet hook (serve/weights.py WeightPublisher.begin): the
         policy is about to change — version-stamp the draft stale and
@@ -891,25 +942,33 @@ class RolloutEngine:
                prefix_id: Optional[int] = None,
                eos_id: Optional[int] = None,
                hold_slot: bool = False,
-               continue_from: Optional[int] = None) -> int:
+               continue_from: Optional[int] = None,
+               adapter_id: Optional[str] = None) -> int:
         with self._lock:
             return self._submit(prompt, max_new_tokens=max_new_tokens,
                                 prefix_id=prefix_id,
                                 eos_id=eos_id, hold_slot=hold_slot,
-                                continue_from=continue_from)
+                                continue_from=continue_from,
+                                adapter_id=adapter_id)
 
     def _submit(self, prompt: List[int], *, max_new_tokens: int,
                 eos_id: Optional[int],
                 prefix_id: Optional[int] = None,
                 hold_slot: bool = False,
-                continue_from: Optional[int] = None) -> int:
+                continue_from: Optional[int] = None,
+                adapter_id: Optional[str] = None) -> int:
         # guarded-by: caller
         if not prompt:
             raise ValueError("empty prompt")
         if continue_from is not None:
+            if adapter_id is not None:
+                raise ValueError("continuations inherit the held slot's "
+                                 "KV; submit adapter decodes fresh")
             return self._submit_continuation(
                 prompt, max_new_tokens=max_new_tokens, eos_id=eos_id,
                 hold_slot=hold_slot, continue_from=continue_from)
+        if adapter_id is not None and self.adapter_pool is None:
+            raise ValueError("engine has no adapter_pool")
         # Ring pools accept prompts past the window (chunked prefill
         # keeps only the trailing window, like the model itself);
         # absolute pools must hold the whole prompt. context_bound is
@@ -931,12 +990,21 @@ class RolloutEngine:
                 raise ValueError(
                     "prompt does not start with the registered prefix "
                     f"(prefix_id {prefix_id}, {len(p_tokens)} tokens)")
+        binding = None
+        if adapter_id is not None:
+            # Resolve the tenant's CURRENT adapter version to a device
+            # slot now, and hold it for the request's whole life: a
+            # publish that lands mid-decode is picked up only by the
+            # next request. Raises KeyError (unpublished tenant) or
+            # AdapterPoolFull before any engine state is touched.
+            binding = self.adapter_pool.acquire(adapter_id)
         rid = self._next_rid
         self._next_rid += 1
         req = _Request(rid=rid, prompt=list(prompt),
                        max_new_tokens=max_new_tokens,
                        eos_id=self.eos_id if eos_id is None else eos_id,
-                       prefix_id=prefix_id, hold_slot=hold_slot)
+                       prefix_id=prefix_id, hold_slot=hold_slot,
+                       adapter=adapter_id, adapter_binding=binding)
         self._requests[rid] = req
         # Enqueue only — scheduling happens at the next step() boundary,
         # so a BURST of submissions (concurrent agent threads, a GRPO
@@ -1037,6 +1105,11 @@ class RolloutEngine:
                                       / self._alloc.num_blocks)
                 out["kv_swapped_blocks"] = sum(
                     hp.num_blocks for hp in self._prefix_host.values())
+            if self.adapter_pool is not None:
+                ap = self.adapter_pool.stats()
+                out["adapters_published"] = len(ap["adapters"])
+                out["adapter_installs"] = int(ap["installs"])
+                out["adapter_evictions"] = int(ap["evictions"])
             return out
 
     @property
@@ -1108,6 +1181,12 @@ class RolloutEngine:
                        max_new_tokens=max_new_tokens,
                        eos_id=self.eos_id if eos_id is None else eos_id,
                        hold_slot=hold_slot, slot=slot)
+        # The held KV was computed under prev's adapter binding, so the
+        # continuation inherits it (ownership transfers; released when
+        # this request finishes without holding).
+        req.adapter = prev.adapter
+        req.adapter_binding = prev.adapter_binding
+        prev.adapter_binding = None
         self._requests[rid] = req
         self._slot_held[slot] = None
         self._slot_req[slot] = req
@@ -1411,6 +1490,12 @@ class RolloutEngine:
         self._slot_req[slot] = None
         if self.kv_layout == "paged":
             self._prefill_jobs.pop(req.rid, None)
+        # Held conversations keep their adapter binding (the resident
+        # KV was computed under it; a continuation inherits it).
+        if (req.adapter_binding is not None and not req.hold_slot
+                and self.adapter_pool is not None):
+            self.adapter_pool.release(req.adapter_binding)
+            req.adapter_binding = None
         if req.hold_slot:
             # The LAST sampled token's k/v is not yet written (tokens
             # are fed on the step AFTER they are sampled), so the
@@ -1431,8 +1516,12 @@ class RolloutEngine:
         rid = self._slot_held[slot]
         if rid is None:
             return
-        self._requests[rid].held_history = None
-        self._requests[rid].slot = None
+        prev = self._requests[rid]
+        prev.held_history = None
+        prev.slot = None
+        if prev.adapter_binding is not None and self.adapter_pool is not None:
+            self.adapter_pool.release(prev.adapter_binding)
+            prev.adapter_binding = None
         self._slot_held[slot] = None
         if self.kv_layout == "paged":
             self._release_row(slot)
@@ -2141,6 +2230,14 @@ class RolloutEngine:
         req.slot = row
         self._slot_req[row] = req
         self._stats["prefills"] += 1
+        if req.adapter_binding is not None and req.prefix_id is not None:
+            # Shared prefixes are BASE-policy KV: any adapter target
+            # perturbs the residual stream and hence every later
+            # layer's k/v, so grafting a base-computed prefix under an
+            # adapter would silently mix policies. Exactness first —
+            # adapter rows take the full adapter-aware prefill.
+            req.prefix_id = None
+            self._stats["prefix_cache_misses"] += 1
         if req.tokens:
             # preemption resume: recompute prompt + everything emitted
             # except the last token (whose k/v is written when it is
@@ -2305,14 +2402,30 @@ class RolloutEngine:
                 t = max(t, self._step_tokens)
         else:
             t = self.num_slots if not job_rows else self._step_tokens
+        n_real = len(toks_l)
         while len(toks_l) < t:
             toks_l.append(0)
             rows_l.append(0)
             pos_l.append(0)
             wb_l.append(nb)      # sentinel block: write dropped
             wo_l.append(0)
+        # Per-rung adapter slot ids, parallel to the token batch: each
+        # real entry gathers its request's bound slot (null slot 0 for
+        # base rows and all padding). Built on EVERY step when a pool
+        # is attached — the vectors' shapes track the existing t
+        # ladder, so tenant churn cannot mint a new jit signature.
+        aid = None
+        if self.adapter_pool is not None:
+            aid = [[0] * len(toks_l)
+                   for _ in range(self.adapter_pool.num_rungs)]
+            for i in range(n_real):
+                req = self._slot_req[rows_l[i]]
+                b = req.adapter_binding if req is not None else None
+                if b is not None:
+                    for j, s in enumerate(b.slot_ids):
+                        aid[j][i] = s
         return (toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows,
-                spec_rows, job_rows)
+                spec_rows, job_rows, aid)
 
     def _step_paged(self) -> Dict[int, List[int]]:
         # guarded-by: caller
@@ -2324,7 +2437,13 @@ class RolloutEngine:
         if plan is None:
             return emitted
         (toks_l, rows_l, pos_l, wb_l, wo_l, decode_rows, spec_rows,
-         job_rows) = plan
+         job_rows, aid) = plan
+        adapters = adapter_ids = None
+        if aid is not None:
+            # Fixed-shape banks + (T,)-ladder id vectors ride every
+            # call — the only adapter-dependent state the jit sees.
+            adapters = self.adapter_pool.banks()
+            adapter_ids = tuple(np.asarray(g, np.int32) for g in aid)
         tracer = get_tracer()
         n_active = len(decode_rows) + len(spec_rows) + len(job_rows)
         with tracer.span("engine.decode_step", active=n_active):
@@ -2341,7 +2460,8 @@ class RolloutEngine:
                 np.asarray(wb_l, np.int32),
                 np.asarray(wo_l, np.int32),
                 self.pool.k, self.pool.v, step_key, self.sample,
-                self._use_paged_kernel)
+                self._use_paged_kernel,
+                adapters=adapters, adapter_ids=adapter_ids)
             self.pool = PagedKVPool(k=pk, v=pv)
             self._stats["decode_steps"] += 1
             # ONE batched device→host transfer per fused step (the

@@ -45,6 +45,7 @@ REJECT_DEADLINE = "deadline"
 REJECT_REPLICA_FAILURE = "replica_failure"
 REJECT_NO_REPLICAS = "no_replicas"
 REJECT_KV_PRESSURE = "kv_pressure"
+REJECT_TENANT_RATE = "tenant_rate_limited"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +94,11 @@ class FleetRequest:
     eos_id: Optional[int] = None
     prefix_tokens: Optional[List[int]] = None
     hold_slot: bool = False
+    # Multi-tenant serving: the tenant this request decodes for. Drives
+    # per-tenant admission fairness, router adapter affinity, and —
+    # when the tenant has a published LoRA adapter — which adapter the
+    # engine binds at submit. None = anonymous/base traffic.
+    tenant_id: Optional[str] = None
     deadline: Optional[float] = None
     submitted_at: float = 0.0
     # -- dispatch state (owned by the fleet) --------------------------------
@@ -152,6 +158,13 @@ class AdmissionConfig:
     # blocks are already granted) always run to completion.
     kv_pressure_high: float = 0.92
     kv_pressure_low: float = 0.75
+    # Per-tenant fairness: every distinct ``tenant_id`` gets its own
+    # token bucket at these knobs (None = no per-tenant limiting), so
+    # one hot tenant is shed at the door instead of starving the fleet.
+    # Checked BEFORE the class bucket — a tenant-shed request must not
+    # burn a class token other tenants could have used.
+    tenant_rate: Optional[float] = None    # requests/sec per tenant
+    tenant_burst: Optional[float] = None   # bucket size (defaults rate)
 
     def policy(self, priority: str) -> ClassPolicy:
         if priority == INTERACTIVE:
@@ -202,6 +215,10 @@ class AdmissionQueue:
             self._buckets[p] = (
                 TokenBucket(pol.rate, pol.burst or pol.rate, now=now)
                 if pol.rate is not None else None)
+        # Per-tenant buckets, created lazily at first offer. Bounded in
+        # practice by the tenant population; a bucket is just two
+        # floats, so no eviction machinery.
+        self._tenant_buckets: Dict[str, TokenBucket] = {}
         if registry is None:
             from ..obs import get_registry
             registry = get_registry()
@@ -259,6 +276,21 @@ class AdmissionQueue:
                               f"kv pool pressure "
                               f"{self._kv_pressure:.2f} >= "
                               f"{self.config.kv_pressure_high:g}")
+        # Tenant fairness gate FIRST: a tenant over its budget must be
+        # shed before the class bucket is touched, or one hot tenant's
+        # rejections would still drain tokens from everyone else.
+        if req.tenant_id is not None and self.config.tenant_rate is not None:
+            tb = self._tenant_buckets.get(req.tenant_id)
+            if tb is None:
+                tb = TokenBucket(
+                    self.config.tenant_rate,
+                    self.config.tenant_burst or self.config.tenant_rate,
+                    now=now)
+                self._tenant_buckets[req.tenant_id] = tb
+            if not tb.try_take(now):
+                return self._shed(req, REJECT_TENANT_RATE,
+                                  f"tenant {req.tenant_id} over "
+                                  f"{self.config.tenant_rate:g} req/s")
         bucket = self._buckets[req.priority]
         if bucket is not None and not bucket.try_take(now):
             return self._shed(req, REJECT_RATE_LIMITED,

@@ -203,7 +203,8 @@ class ServingFleet:
                prefix_id: Optional[int] = None,
                eos_id: Optional[int] = None,
                hold_slot: bool = False,
-               continue_from: Optional[int] = None) -> int:
+               continue_from: Optional[int] = None,
+               tenant_id: Optional[str] = None) -> int:
         """Admit a generation request; returns a fleet ticket.
 
         Sheds (queue full / rate limit) are NOT exceptions: the ticket's
@@ -237,7 +238,7 @@ class ServingFleet:
                 ticket=ticket, prompt=list(prompt),
                 max_new_tokens=max_new_tokens, priority=priority,
                 eos_id=eos_id, prefix_tokens=prefix_tokens,
-                hold_slot=hold_slot,
+                hold_slot=hold_slot, tenant_id=tenant_id,
                 deadline=None if deadline_s is None else now + deadline_s,
                 submitted_at=now)
             self._requests[ticket] = req
@@ -490,6 +491,20 @@ class ServingFleet:
             return self.publisher.publish_draft(params, epoch=epoch,
                                                 version=version)
 
+    def publish_adapter(self, tenant_id: str, lora, *,
+                        epoch: Optional[int] = None,
+                        version: Optional[int] = None) -> int:
+        """Publish one tenant's LoRA adapter to every live replica
+        (the per-tenant learner's fleet entry point). Same
+        ``(epoch, version)`` fence as :meth:`begin_publish`, but
+        applied immediately with no drain: adapter versions bind at
+        submit time, so in-flight decodes — including this tenant's —
+        finish untouched and only the tenant's next requests see the
+        new version. Other tenants never notice."""
+        with self._lock:
+            return self.publisher.publish_adapter(
+                tenant_id, lora, epoch=epoch, version=version)
+
     @property
     def threaded(self) -> bool:
         """True when the dispatcher thread owns the pump (start()ed)."""
@@ -646,6 +661,7 @@ class ServingFleet:
                 "publish_epoch": self.publisher.epoch,
                 "weight_version_skew": self.publisher.skew(),
                 "publish_in_progress": self.publisher.in_progress,
+                "adapter_versions": dict(self.publisher.adapter_versions),
                 **self.prefix_store.stats(),
                 **self.timelines.stats(),
                 "slo": self.slo.summary(),
@@ -736,6 +752,12 @@ class ServingFleet:
                     "senweaver_serve_autoscale_actions_total"),
                 "learner_publishes": ctotal(
                     "senweaver_learner_publishes_total"),
+                "adapter_publishes": ctotal(
+                    "senweaver_serve_adapter_fleet_publishes_total"),
+                "adapter_install_failures": ctotal(
+                    "senweaver_serve_adapter_install_failures_total"),
+                "adapter_affinity_hits": ctotal(
+                    "senweaver_serve_adapter_affinity_hits_total"),
                 "ttft_by_priority": ttft_buckets(),
                 "slo_requests": ctotal(
                     "senweaver_serve_slo_requests_total"),

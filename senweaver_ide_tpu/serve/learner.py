@@ -150,6 +150,20 @@ class FleetPublishClient:
             idempotency_key=f"{self.name}:publish:e{epoch}:v{version}",
             timeout_s=timeout_s)
 
+    def publish_adapter(self, tenant_id: str, lora, *, epoch: int,
+                        version: Optional[int] = None,
+                        timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        # Adapter publishes are fenced by (epoch, per-tenant version);
+        # the key mirrors publish: a lost response replays the apply
+        # (idempotent — the per-tenant watermark rejects the re-stage).
+        return self._call(
+            "publish_adapter",
+            {"tenant_id": tenant_id, "lora": lora, "epoch": epoch,
+             "version": version},
+            idempotency_key=(f"{self.name}:publish_adapter:{tenant_id}"
+                             f":e{epoch}:v{version}"),
+            timeout_s=timeout_s)
+
     def publish_status(self) -> Dict[str, Any]:
         return self._call("publish_status")
 

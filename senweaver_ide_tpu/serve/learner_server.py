@@ -54,7 +54,7 @@ from .replica import DEAD
 # client whose request ids collide with a previous incarnation replay
 # that incarnation's lease grant and run at a zombie epoch, defeating
 # the fencing. Status/signals are reads and must see fresh state.
-LEARNER_MUTATING_METHODS = frozenset({"publish"})
+LEARNER_MUTATING_METHODS = frozenset({"publish", "publish_adapter"})
 
 
 class FleetRpcHandler(RpcHandlerBase):
@@ -101,6 +101,18 @@ class FleetRpcHandler(RpcHandlerBase):
         v = self.fleet.begin_publish(params, epoch=int(epoch),
                                      version=int(version))
         return {"version": v, "epoch": int(epoch), "staged": True}
+
+    def _m_publish_adapter(self, tenant_id, lora, epoch,
+                           version=None) -> Dict[str, Any]:
+        # Same double fencing as _m_publish (live lease here, per-
+        # tenant monotonic watermark in WeightPublisher), but the
+        # apply is immediate and no-drain: there is no roll to poll.
+        self.lease_store.validate(int(epoch), now=self.clock())
+        v = self.fleet.publish_adapter(
+            str(tenant_id), lora, epoch=int(epoch),
+            version=None if version is None else int(version))
+        return {"tenant_id": str(tenant_id), "version": v,
+                "epoch": int(epoch), "applied": True}
 
     def _m_publish_status(self) -> Dict[str, Any]:
         # Manual-pump fleets advance one step per poll so a loopback

@@ -246,6 +246,12 @@ class EngineReplica:
             kwargs = dict(max_new_tokens=req.max_new_tokens,
                           prefix_id=prefix_id, eos_id=req.eos_id,
                           hold_slot=req.hold_slot)
+            if req.tenant_id is not None and self.has_adapter(
+                    req.tenant_id):
+                # Tenant with a published adapter: the engine binds its
+                # current version at submit. An unpublished tenant
+                # decodes base-only — graceful, not an error.
+                kwargs["adapter_id"] = req.tenant_id
             if getattr(self.engine, "supports_idempotency", False):
                 # Stable per (ticket, dispatch attempt): an in-call
                 # retry after a lost response REPLAYS on the server
@@ -371,6 +377,40 @@ class EngineReplica:
                 return False
             update(params, version=int(version))
             return True
+
+    def install_adapter(self, tenant_id: str, lora, version: int) -> bool:
+        """Install one tenant's published LoRA adapter into the
+        engine's pool. Like :meth:`install_draft_weights` this never
+        waits for drain: the engine binds adapter versions at submit
+        time, so in-flight decodes (this tenant's included) are
+        untouched and only the tenant's NEXT requests see the new
+        version. Returns False when the engine has no adapter pool."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            publish = getattr(self.engine, "publish_adapter", None)
+            if publish is None:
+                return False
+            try:
+                publish(tenant_id, lora, version=int(version))
+            except RuntimeError:
+                return False    # engine without an adapter pool
+            return True
+
+    def has_adapter(self, tenant_id: Optional[str]) -> bool:
+        """True when this replica's engine can decode under the
+        tenant's adapter (a version is published to its pool)."""
+        fn = getattr(self.engine, "has_adapter", None)
+        return bool(fn(tenant_id)) if fn is not None else False
+
+    def has_adapter_resident(self, tenant_id: Optional[str]) -> bool:
+        """True when the tenant's CURRENT adapter version already
+        occupies a device slot here — the router's warm-affinity
+        signal (no upload on the next submit)."""
+        if tenant_id is None:
+            return False
+        fn = getattr(self.engine, "adapter_resident", None)
+        return bool(fn(tenant_id)) if fn is not None else False
 
     def stamp_version(self, version: int) -> None:
         """Record the fleet's current published version on a replica

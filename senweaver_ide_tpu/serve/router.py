@@ -55,6 +55,10 @@ class Router:
         self._affinity_hits = registry.counter(
             "senweaver_serve_prefix_affinity_hits_total",
             "Requests routed to a replica already holding their prefix.")
+        self._adapter_affinity_hits = registry.counter(
+            "senweaver_serve_adapter_affinity_hits_total",
+            "Tenant requests routed to a replica whose pool already "
+            "holds their current adapter version (no upload at submit).")
         self._retries_total = registry.counter(
             "senweaver_serve_retries_total",
             "Requests resubmitted after a replica death/fault.")
@@ -83,6 +87,19 @@ class Router:
                 self._affinity_hits.inc()
                 req.routed_by = "affinity"
                 return min(warm, key=load)
+        if req.tenant_id is not None:
+            # Tenant→adapter-slot affinity, below prefix affinity
+            # (prefix KV is the bigger transfer) but above raw load: a
+            # replica whose pool already holds the tenant's CURRENT
+            # adapter version skips the submit-time upload.
+            resident = [
+                r for r in accepting
+                if getattr(r, "has_adapter_resident", None) is not None
+                and r.has_adapter_resident(req.tenant_id)]
+            if resident:
+                self._adapter_affinity_hits.inc()
+                req.routed_by = "adapter_affinity"
+                return min(resident, key=load)
         req.routed_by = "load"
         return min(accepting, key=load)
 

@@ -86,6 +86,19 @@ class WeightPublisher:
             "Per-replica draft installs that failed (replica keeps "
             "serving with its previous draft; never quarantined — "
             "drafts cannot corrupt outputs).")
+        # Per-tenant LoRA adapter publishes: same no-drain shape as
+        # drafts (they change only that tenant's NEXT requests, never a
+        # decode in flight), same epoch fence, but one monotonic
+        # version watermark PER TENANT.
+        self.adapter_versions = {}              # guarded-by: _lock
+        self._adapter_publishes_total = registry.counter(
+            "senweaver_serve_adapter_fleet_publishes_total",
+            "Tenant adapter versions published to the fleet.")
+        self._adapter_install_failures_total = registry.counter(
+            "senweaver_serve_adapter_install_failures_total",
+            "Per-replica adapter installs that failed (replica keeps "
+            "the tenant's previous adapter; never quarantined — the "
+            "base policy is untouched).")
         # install_weights failures collected here for the fleet to turn
         # into proper deaths (orphan triage included); the publisher
         # itself never kills — it has no router.
@@ -220,6 +233,46 @@ class WeightPublisher:
                     install(params, new_version)
                 except Exception:
                     self._draft_install_failures_total.inc()
+            return new_version
+
+    def publish_adapter(self, tenant_id: str, lora, *,
+                        epoch: Optional[int] = None,
+                        version: Optional[int] = None) -> int:
+        """Publish one TENANT's LoRA adapter through the same
+        ``(epoch, version)`` fence as target publishes, but with no
+        drain/roll: an adapter publish changes only that tenant's NEXT
+        requests (engines bind (rung, slot, version) at submit time),
+        so it must never pause unrelated tenants' decodes, never stamp
+        speculation drafts stale, and never drop shared prefixes —
+        those belong to the BASE policy, which is untouched. The
+        version watermark is per-tenant monotonic. Per-replica install
+        failures are counted, not quarantined: the replica keeps
+        serving the tenant's previous adapter (or base-only)."""
+        with self._lock:
+            new_epoch = self.epoch if epoch is None else int(epoch)
+            cur = int(self.adapter_versions.get(tenant_id, 0))
+            new_version = cur + 1 if version is None else int(version)
+            if new_epoch < self.epoch or (
+                    new_epoch == self.epoch and new_version <= cur):
+                self._stale_total.inc()
+                raise StalePublishError(
+                    f"adapter publish (tenant={tenant_id!r}, "
+                    f"epoch={new_epoch}, version={new_version}) is "
+                    f"behind the fleet's high-water mark "
+                    f"(epoch={self.epoch}, adapter_version={cur})")
+            self.epoch = new_epoch
+            self.adapter_versions[tenant_id] = new_version
+            self._adapter_publishes_total.inc()
+            for r in self.replicas:
+                if r.state == DEAD:
+                    continue
+                install = getattr(r, "install_adapter", None)
+                if install is None:
+                    continue
+                try:
+                    install(tenant_id, lora, new_version)
+                except Exception:
+                    self._adapter_install_failures_total.inc()
             return new_version
 
     def advance(self) -> bool:

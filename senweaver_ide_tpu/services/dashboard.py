@@ -152,6 +152,7 @@ class DashboardService:
         out["serving"] = self._serving_summary()
         out["kv_pool"] = self._kv_pool_summary()
         out["speculation"] = self._speculation_summary()
+        out["adapters"] = self._adapter_summary()
         out["slo"] = self._slo_summary()
         out["runtime"] = self._runtime_summary()
         return out
@@ -425,6 +426,50 @@ class DashboardService:
                     "senweaver_serve_draft_install_failures_total"),
                 "draft_blocks_free":
                     total("senweaver_spec_draft_kv_blocks_free"),
+            }
+        except Exception as e:
+            return {"error": str(e)}
+
+    def _adapter_summary(self) -> Dict[str, Any]:
+        """Multi-tenant tile: adapter-pool occupancy and churn, publish
+        traffic (pool-level and fleet-level), tenant version skew, and
+        the gathered-step overhead — all off the registry (the pool and
+        WeightPublisher register these at construction)."""
+        def total(name: str) -> float:
+            m = self.registry.get(name)
+            if m is None:
+                return 0
+            return sum(float(v) for v in m.samples().values())
+
+        def gauge(name: str, pick=max) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            vals = [float(v) for v in m.samples().values()]
+            return pick(vals) if vals else None
+
+        try:
+            return {
+                "pool_slots":
+                    total("senweaver_serve_adapter_pool_slots"),
+                "pool_resident":
+                    total("senweaver_serve_adapter_pool_resident"),
+                "publishes":
+                    total("senweaver_serve_adapter_publishes_total"),
+                "fleet_publishes": total(
+                    "senweaver_serve_adapter_fleet_publishes_total"),
+                "installs":
+                    total("senweaver_serve_adapter_installs_total"),
+                "evictions":
+                    total("senweaver_serve_adapter_evictions_total"),
+                "install_failures": total(
+                    "senweaver_serve_adapter_install_failures_total"),
+                "affinity_hits": total(
+                    "senweaver_serve_adapter_affinity_hits_total"),
+                "version_skew":
+                    gauge("senweaver_serve_adapter_version_skew"),
+                "gather_overhead": gauge(
+                    "senweaver_serve_adapter_gather_overhead_ratio"),
             }
         except Exception as e:
             return {"error": str(e)}
@@ -744,6 +789,7 @@ input[type=text], input[type=password], textarea {
 <div id="guard-skips"></div></section>
 <section><h2>Serving</h2><div id="serving" class="tiles"></div></section>
 <section><h2>Speculation</h2><div id="speculation" class="tiles"></div></section>
+<section><h2>Multi-tenant</h2><div id="adapters" class="tiles"></div></section>
 <section><h2>SLO</h2>
 <div id="slo" class="tiles"></div>
 <div id="slo-exemplars"></div></section>
@@ -1017,6 +1063,18 @@ async function refresh() {
     ["draft publishes", spec.draft_publishes],
     ["draft install failures", spec.draft_install_failures],
     ["draft blocks free", spec.draft_blocks_free]]);
+  const ad = s.adapters || {};
+  tiles(document.getElementById("adapters"), [
+    ["pool slots", ad.pool_slots],
+    ["resident", ad.pool_resident],
+    ["publishes", ad.publishes],
+    ["fleet publishes", ad.fleet_publishes],
+    ["installs", ad.installs],
+    ["evictions", ad.evictions],
+    ["install failures", ad.install_failures],
+    ["affinity hits", ad.affinity_hits],
+    ["version skew", ad.version_skew],
+    ["gather overhead", ad.gather_overhead]]);
   const slo = s.slo || {};
   tiles(document.getElementById("slo"), [
     ["slo requests", slo.requests],

@@ -42,6 +42,7 @@ from ..resilience.faults import ResilienceConfig
 from ..resilience.guard import HealthMitigator, UpdateGuard
 from ..traces.collector import TraceCollector
 from .grpo import GRPOConfig
+from .lora import split_lora
 from .rl_loop import GroupSizeScheduler, grpo_round
 
 # Loop-id source (see OnlineImprovementLoop._loop_id): a process-unique
@@ -121,7 +122,8 @@ class OnlineImprovementLoop:
                  analyze_every: Optional[int] = None,
                  resilience: Optional[ResilienceConfig] = None,
                  checkpoint_manager=None,
-                 checkpoint_every: int = 1):
+                 checkpoint_every: int = 1,
+                 tenant_id: Optional[str] = None):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -178,6 +180,12 @@ class OnlineImprovementLoop:
         # restores the exact round.
         self.checkpoint_manager = checkpoint_manager
         self.checkpoint_every = checkpoint_every
+        # Per-tenant mode: the round trains ADAPTER deltas only (the
+        # caller sets up lora_base training so state.params is the
+        # adapter tree) and each round republishes through the no-drain
+        # publish_adapter path instead of the rolling base publish —
+        # one tenant's training loop never pauses the others' decodes.
+        self.tenant_id = tenant_id
         self._round = 0
         # Last weight version a versioned engine (ServingFleet) acked
         # for this loop's params; persisted so resume() can republish AT
@@ -293,8 +301,35 @@ class OnlineImprovementLoop:
         if (self._anchor is not None and self.anchor_every > 0
                 and (self._round + 1) % self.anchor_every == 0):
             self._anchor = self.state.params
-        if self.engine is not None and hasattr(self.engine,
-                                               "update_params"):
+        if self.tenant_id is not None and self.engine is not None \
+                and hasattr(self.engine, "publish_adapter"):
+            # Tenant rounds publish ONLY the adapter leaves (state.params
+            # is the adapter tree under lora_base training; a merged
+            # tree is split the same way) at the tenant's next monotonic
+            # adapter_version. In-flight requests keep their bound slot;
+            # the tenant's next request uploads the new version.
+            _, lora = split_lora(self.state.params)
+            if not lora["layers"]:
+                raise ValueError(
+                    "tenant_id is set but state.params has no *_lora_* "
+                    "leaves — per-tenant rounds train adapter deltas "
+                    "(init_lora + lora_base training), not base weights")
+            with get_tracer().span("online.publish_adapter",
+                                   tenant=self.tenant_id):
+                published = self.engine.publish_adapter(
+                    self.tenant_id, lora)
+            if isinstance(published, int):
+                self._published_version = published
+                if self.metrics_service is not None:
+                    self.metrics_service.capture("Adapter Published", {
+                        "round": self._round,
+                        "tenant_id": self.tenant_id,
+                        "adapter_version": published,
+                    })
+            if hasattr(self.engine, "record_snapshot"):
+                self.engine.record_snapshot()
+        elif self.engine is not None and hasattr(self.engine,
+                                                 "update_params"):
             with get_tracer().span("online.publish_params"):
                 published = self.engine.update_params(self.state.params)
             # A ServingFleet publish is VERSIONED (rolling drain→swap

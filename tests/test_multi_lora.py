@@ -1,0 +1,429 @@
+"""Multi-tenant LoRA serving: batched mixed-adapter decode is token-
+exact against per-tenant unbatched single-adapter decode (rank ladder
+mix, base-only rows, speculative verify), hot-swap publishes land only
+at the NEXT request, tenant churn mints zero new jit signatures after
+warmup, and a fleet adapter publish never disturbs other tenants'
+in-flight decodes (no drain, no prefix drops, no draft staleness) —
+ISSUE 14 acceptance."""
+
+import dataclasses
+
+import jax
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout import (AdapterPool, AdapterPoolConfig,
+                                       AdapterPoolFull, EngineConfig,
+                                       RolloutEngine, StaleAdapterVersion)
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import Completed, ServingFleet
+from senweaver_ide_tpu.training.lora import init_lora, merge_lora
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_lora(config, seed, rank, scale=0.05):
+    """A LoRA with NONZERO B (init_lora's B=0 would make every parity
+    test pass vacuously — the delta must actually perturb logits)."""
+    lora = init_lora(config, jax.random.PRNGKey(seed), rank=rank)
+    for k in list(lora["layers"]):
+        if k.endswith("_lora_b"):
+            lora["layers"][k] = jax.random.normal(
+                jax.random.PRNGKey(seed + 100), lora["layers"][k].shape,
+                lora["layers"][k].dtype) * scale
+    return lora
+
+
+def make_engine(params, config, *, pool=None, num_slots=4, max_len=96):
+    return RolloutEngine(
+        params, config, num_slots=num_slots, max_len=max_len,
+        sample=GREEDY, adapter_pool=pool,
+        engine_config=EngineConfig(kv_layout="paged", block_size=4))
+
+
+def ref_decode(model, prompt, lora, max_new=8):
+    """Unbatched single-adapter reference: a dedicated engine serving
+    merge_lora(base, adapter) — the swap-per-tenant baseline."""
+    params, config = model
+    p = merge_lora(params, lora) if lora is not None else params
+    eng = make_engine(p, config)
+    rid = eng.submit(prompt, max_new_tokens=max_new)
+    out = eng.run()
+    return out[rid]
+
+
+PROMPTS = [[1, 2, 3, 4], [5, 6, 7], [8, 9, 10, 11, 12], [3, 1, 2]]
+
+
+# ---- batched mixed-adapter parity ----------------------------------------
+
+def test_batched_mixed_rank_parity(model):
+    """One batch mixing a rank-4 adapter (pads to the 8 rung), a
+    rank-16 adapter, a base-only row, and a second row of the first
+    tenant decodes token-exactly vs per-tenant unbatched engines."""
+    params, config = model
+    l1 = make_lora(config, 1, rank=4)
+    l2 = make_lora(config, 2, rank=16)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(params, config, pool=pool)
+    eng.publish_adapter("t1", l1)
+    eng.publish_adapter("t2", l2)
+    rids = [eng.submit(PROMPTS[0], max_new_tokens=8, adapter_id="t1"),
+            eng.submit(PROMPTS[1], max_new_tokens=8, adapter_id="t2"),
+            eng.submit(PROMPTS[2], max_new_tokens=8),
+            eng.submit(PROMPTS[3], max_new_tokens=8, adapter_id="t1")]
+    out = eng.run()
+    batched = [out[r] for r in rids]
+    refs = [ref_decode(model, PROMPTS[0], l1),
+            ref_decode(model, PROMPTS[1], l2),
+            ref_decode(model, PROMPTS[2], None),
+            ref_decode(model, PROMPTS[3], l1)]
+    assert batched == refs
+    # The adapters really diverged from base — parity was not vacuous.
+    base = [ref_decode(model, PROMPTS[0], None),
+            ref_decode(model, PROMPTS[1], None)]
+    assert batched[0] != base[0] or batched[1] != base[1]
+    eng._alloc.check_leaks()
+
+
+@pytest.mark.parametrize("rank", [8, 16])
+def test_exact_at_every_ladder_rung(model, rank):
+    """Acceptance: token-exact at EVERY rank in the ladder, including
+    an exact-fit rank (no padding columns)."""
+    params, config = model
+    lora = make_lora(config, 10 + rank, rank=rank)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(params, config, pool=pool)
+    eng.publish_adapter("t", lora)
+    rids = [eng.submit(p, max_new_tokens=8, adapter_id="t")
+            for p in PROMPTS]
+    out = eng.run()
+    assert [out[r] for r in rids] == [
+        ref_decode(model, p, lora) for p in PROMPTS]
+
+
+def test_base_rows_identical_to_pool_less_engine(model):
+    """adapter_id=None rows in a pool engine gather the permanent null
+    slot — their tokens must equal a pool-less engine's exactly, even
+    sharing a batch with adapter-bearing rows."""
+    params, config = model
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(params, config, pool=pool)
+    eng.publish_adapter("t1", make_lora(config, 1, rank=4))
+    base_rids = [eng.submit(p, max_new_tokens=8) for p in PROMPTS[:2]]
+    eng.submit(PROMPTS[2], max_new_tokens=8, adapter_id="t1")
+    out = eng.run()
+    assert [out[r] for r in base_rids] == [
+        ref_decode(model, p, None) for p in PROMPTS[:2]]
+
+
+# ---- hot-swap contract ----------------------------------------------------
+
+def test_mid_decode_publish_lands_next_request_only(model):
+    """A publish while a tenant's request is mid-decode must not touch
+    that request (binding resolved at submit); the tenant's NEXT
+    request decodes under the new version."""
+    params, config = model
+    l_v1 = make_lora(config, 1, rank=4)
+    l_v2 = make_lora(config, 7, rank=4, scale=0.08)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(params, config, pool=pool)
+    eng.publish_adapter("t1", l_v1)
+    rid = eng.submit(PROMPTS[0], max_new_tokens=10, adapter_id="t1")
+    first = eng.run()[rid]
+    assert eng.adapter_stats()["adapters"]["t1"] == 1
+    v2 = eng.publish_adapter("t1", l_v2)
+    assert v2 == 2
+    rid2 = eng.submit(PROMPTS[0], max_new_tokens=10, adapter_id="t1")
+    second = eng.run()[rid2]
+    assert first == ref_decode(model, PROMPTS[0], l_v1, max_new=10)
+    assert second == ref_decode(model, PROMPTS[0], l_v2, max_new=10)
+    assert first != second
+
+
+def test_publish_during_flight_keeps_old_binding(model):
+    """Tighter in-flight variant: the publish happens while the request
+    still holds its slot (not between run() calls)."""
+    params, config = model
+    l_v1 = make_lora(config, 1, rank=4)
+    l_v2 = make_lora(config, 7, rank=4, scale=0.08)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(params, config, pool=pool)
+    eng.publish_adapter("t1", l_v1)
+    rid = eng.submit(PROMPTS[0], max_new_tokens=10, adapter_id="t1")
+    toks = []
+    toks.extend(eng.step().get(rid, []))     # at least one token on v1
+    eng.publish_adapter("t1", l_v2)          # mid-flight
+    while eng.has_work:
+        toks.extend(eng.step().get(rid, []))
+    assert toks == ref_decode(model, PROMPTS[0], l_v1, max_new=10)
+    # the stale slot freed at release; the pool reports only v2 now
+    assert pool.version("t1") == 2
+
+
+# ---- speculative decoding composition ------------------------------------
+
+def test_spec_verify_per_tenant_exact(model):
+    """Speculation depth > 0 over a mixed-adapter batch: drafts stay
+    base-only, verification runs under each row's adapter — outputs
+    byte-identical to non-speculative pool decode (which is itself
+    ref-exact)."""
+    params, config = model
+    draft_cfg = dataclasses.replace(config, num_layers=2,
+                                    name="tiny-draft")
+    draft = init_params(draft_cfg, jax.random.PRNGKey(9))
+    l1 = make_lora(config, 1, rank=4)
+    l2 = make_lora(config, 2, rank=16)
+
+    def run(spec_depth):
+        pool = AdapterPool(config, AdapterPoolConfig())
+        eng = make_engine(params, config, pool=pool)
+        if spec_depth:
+            eng.enable_speculation(draft, draft_cfg, depth=spec_depth)
+        eng.publish_adapter("t1", l1)
+        eng.publish_adapter("t2", l2)
+        rids = [eng.submit(PROMPTS[0], max_new_tokens=12, adapter_id="t1"),
+                eng.submit(PROMPTS[1], max_new_tokens=12, adapter_id="t2"),
+                eng.submit(PROMPTS[2], max_new_tokens=12)]
+        out = eng.run()
+        if spec_depth:
+            s = eng.spec_stats()
+            assert s["enabled"] and s["rounds"] > 0
+        return [out[r] for r in rids]
+
+    assert run(4) == run(0)
+
+
+# ---- retrace discipline ---------------------------------------------------
+
+def test_tenant_churn_zero_compiles_after_warmup(model):
+    """Acceptance: after warming each (token bucket, rank) signature,
+    churning through more tenants than the pool holds — forcing
+    evictions and re-uploads — adds ZERO fused-step compiles. A
+    distinctive vocab keeps this test's jit cache cold."""
+    from senweaver_ide_tpu.obs.runtime_profile import get_profiler
+
+    _, base_config = model
+    config = dataclasses.replace(base_config, vocab_size=89)
+    params = jax.block_until_ready(init_params(config,
+                                               jax.random.PRNGKey(0)))
+    pool = AdapterPool(config, AdapterPoolConfig(slots_per_rank=2))
+    eng = make_engine(params, config, pool=pool)
+    loras = {f"t{i}": make_lora(config, 20 + i, rank=4 if i % 2 else 16)
+             for i in range(6)}
+    for k, lora in loras.items():
+        eng.publish_adapter(k, lora)
+
+    def workload(tenants):
+        rids = [eng.submit([(i * 5 + j) % 80 + 2 for j in range(3 + i)],
+                           max_new_tokens=6, adapter_id=t)
+                for i, t in enumerate(tenants)]
+        eng.run()
+        return rids
+
+    workload(["t0", "t1", "t2", "t3"])       # warm every bucket, both rungs
+    snap = get_profiler().ledger().get("engine.fused_step", {})
+    before = snap.get("compiles", 0)
+    assert before > 0
+    # Churn: t4/t5 evict cold slots (slots_per_rank=2 per rung).
+    workload(["t4", "t5", "t0", "t1"])
+    workload(["t2", "t3", "t4", "t5"])
+    after = get_profiler().ledger()["engine.fused_step"]
+    assert after["compiles"] == before, (
+        "tenant churn minted new fused-step signatures: "
+        f"{after['signatures']}")
+    assert after["storms"] == 0
+    assert pool.stats()["evictions"] > 0     # churn actually evicted
+
+
+# ---- pool unit invariants -------------------------------------------------
+
+def test_pool_eviction_lru_and_full(model):
+    _, config = model
+    pool = AdapterPool(config, AdapterPoolConfig(slots_per_rank=2))
+    for i in range(3):
+        pool.publish(f"t{i}", make_lora(config, 30 + i, rank=8))
+    b0 = pool.acquire("t0")
+    b1 = pool.acquire("t1")
+    with pytest.raises(AdapterPoolFull):
+        pool.acquire("t2")                   # both slots pinned
+    pool.release(b0)
+    b2 = pool.acquire("t2")                  # evicts t0 (LRU, refs==0)
+    assert not pool.resident("t0")
+    assert pool.resident("t1") and pool.resident("t2")
+    assert pool.stats()["evictions"] == 1
+    pool.release(b1)
+    pool.release(b2)
+    b0b = pool.acquire("t0")                 # cold tenant re-uploads
+    assert pool.resident("t0")
+    pool.release(b0b)
+
+
+def test_pool_version_fencing(model):
+    _, config = model
+    pool = AdapterPool(config, AdapterPoolConfig())
+    lora = make_lora(config, 40, rank=8)
+    assert pool.publish("t", lora) == 1
+    assert pool.publish("t", lora, version=5) == 5
+    with pytest.raises(StaleAdapterVersion):
+        pool.publish("t", lora, version=5)   # not monotonic
+    with pytest.raises(KeyError):
+        pool.acquire("unknown")
+
+
+def test_pool_rejects_oversized_and_malformed(model):
+    _, config = model
+    pool = AdapterPool(config, AdapterPoolConfig(rank_ladder=(8,)))
+    with pytest.raises(ValueError):
+        pool.publish("t", make_lora(config, 41, rank=16))  # > ladder max
+    with pytest.raises(ValueError):
+        pool.publish("t", {"layers": {}})
+
+
+def test_pool_version_skew_stat(model):
+    """A republish while the old version is pinned shows up as skew;
+    the last release clears the stale slot and the skew."""
+    _, config = model
+    pool = AdapterPool(config, AdapterPoolConfig())
+    pool.publish("t", make_lora(config, 42, rank=8))
+    b = pool.acquire("t")
+    pool.publish("t", make_lora(config, 43, rank=8))
+    assert pool.stats()["version_skew"] == 1
+    pool.release(b)
+    assert pool.stats()["version_skew"] == 0
+
+
+def test_submit_guards(model):
+    params, config = model
+    eng = make_engine(params, config)        # no pool
+    with pytest.raises(ValueError):
+        eng.submit([1, 2, 3], max_new_tokens=4, adapter_id="t")
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng2 = make_engine(params, config, pool=pool)
+    with pytest.raises(KeyError):
+        eng2.submit([1, 2, 3], max_new_tokens=4, adapter_id="nope")
+
+
+# ---- fleet: no-drain adapter publish (satellite 1) ------------------------
+
+def _registry_total(name):
+    m = obs.get_registry().get(name)
+    return 0.0 if m is None else float(m.value())
+
+
+def test_fleet_adapter_publish_leaves_other_tenants_untouched(model):
+    """Regression (satellite 1): a tenant adapter publish during a
+    4-replica run is a NO-DRAIN event — zero continuation replays, zero
+    prefix-store drops, no draft staleness stamp, and every other
+    tenant's (and the publishing tenant's own in-flight) tokens are
+    identical to a run with no mid-flight publish."""
+    params, config = model
+    draft_cfg = dataclasses.replace(config, num_layers=2,
+                                    name="tiny-draft")
+    draft = init_params(draft_cfg, jax.random.PRNGKey(9))
+    lA = make_lora(config, 1, rank=4)
+    lA2 = make_lora(config, 7, rank=4, scale=0.08)
+    lB = make_lora(config, 2, rank=16)
+    prefix = [7] * 8
+
+    def run(publish_mid_flight):
+        engines = []
+        for _ in range(4):
+            pool = AdapterPool(config, AdapterPoolConfig())
+            e = make_engine(params, config, pool=pool, num_slots=2)
+            e.enable_speculation(draft, draft_cfg, depth=2)
+            engines.append(e)
+        fleet = ServingFleet(engines)
+        fleet.publish_adapter("tA", lA)
+        fleet.publish_adapter("tB", lB)
+        pid = fleet.register_prefix(prefix)
+        tickets = [
+            fleet.submit(PROMPTS[0], max_new_tokens=12, tenant_id="tA"),
+            fleet.submit(PROMPTS[1], max_new_tokens=12, tenant_id="tB"),
+            fleet.submit(PROMPTS[2], max_new_tokens=12, tenant_id="tB"),
+            fleet.submit(prefix + [3], max_new_tokens=12, prefix_id=pid),
+            fleet.submit(prefix + [5], max_new_tokens=12, prefix_id=pid),
+        ]
+        for _ in range(3):
+            fleet.step()
+        if publish_mid_flight:
+            fleet.publish_adapter("tA", lA2)
+        fleet.run()
+        outs = []
+        for t in tickets:
+            o = fleet.outcome(t)
+            assert isinstance(o, Completed), o
+            outs.append(list(o.tokens))
+        return fleet, engines, outs, pid
+
+    _, _, baseline, _ = run(publish_mid_flight=False)
+    obs._reset_for_tests()
+    fleet, engines, perturbed, pid = run(publish_mid_flight=True)
+
+    assert perturbed == baseline             # in-flight decodes untouched
+    assert _registry_total(
+        "senweaver_serve_continuation_replays_total") == 0
+    assert _registry_total(
+        "senweaver_serve_prefix_invalidations_total") == 0
+    assert fleet.publisher.adapter_versions["tA"] == 2
+    assert fleet.publisher.adapter_versions["tB"] == 1
+    for e in engines:
+        # no begin()-style stamp: drafts still track the base policy
+        assert e.spec_stats()["draft_staleness"] == 0
+        # NB: no block-leak check here — the registered shared prefix
+        # legitimately pins its KV blocks while the store holds it.
+    # the prefix KV survived the publish — next prefix request grafts
+    t = fleet.submit(prefix + [9], max_new_tokens=4, prefix_id=pid)
+    fleet.run()
+    assert isinstance(fleet.outcome(t), Completed)
+
+
+def test_fleet_tenant_rate_limit_and_affinity(model):
+    """Tenancy knobs end to end: per-tenant token buckets shed the
+    over-rate tenant without burning class tokens, and repeat tenant
+    requests route to the replica already holding the adapter."""
+    from senweaver_ide_tpu.serve import AdmissionConfig
+    from senweaver_ide_tpu.serve.admission import REJECT_TENANT_RATE
+
+    params, config = model
+    engines = []
+    for _ in range(2):
+        pool = AdapterPool(config, AdapterPoolConfig())
+        engines.append(make_engine(params, config, pool=pool,
+                                   num_slots=2))
+    fake_now = [0.0]
+    fleet = ServingFleet(
+        engines, clock=lambda: fake_now[0],
+        admission=AdmissionConfig(tenant_rate=1.0, tenant_burst=2.0))
+    fleet.publish_adapter("tA", make_lora(config, 1, rank=4))
+    tickets = [fleet.submit([1, 2, 3], max_new_tokens=2, tenant_id="tA")
+               for _ in range(4)]
+    outcomes = [fleet.outcome(t) for t in tickets]
+    shed = [o for o in outcomes if o is not None
+            and not isinstance(o, Completed)]
+    assert len(shed) == 2                    # burst=2 admitted, rest shed
+    assert all(o.reason == REJECT_TENANT_RATE for o in shed)
+    fleet.run()
+    # affinity: the tenant's adapter is resident on exactly the
+    # replica(s) that served it; new requests prefer those
+    fake_now[0] += 10.0                      # refill the bucket
+    t2 = fleet.submit([4, 5, 6], max_new_tokens=2, tenant_id="tA")
+    fleet.run()
+    assert isinstance(fleet.outcome(t2), Completed)
+    assert _registry_total(
+        "senweaver_serve_adapter_affinity_hits_total") >= 1
