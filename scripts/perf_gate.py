@@ -340,12 +340,62 @@ def _case_multi_lora() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_streaming_grpo() -> Dict[str, Any]:
+    """The streaming learner's hot loop (ISSUE 15): bounded-queue
+    intake with dedup and the staleness filter, batch assembly from
+    recorded behavior logps, and the importance-corrected grpo step
+    through the StreamingTrainerAdapter. Gates that episode-shaped
+    intake lands on a warm train signature — per-round group churn
+    must not retrace — and tracks the per-step time."""
+    import jax
+    import jax.numpy as jnp
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.training.experience import (
+        ExperienceQueue, StreamedEpisode, StreamingTrainerAdapter)
+    from senweaver_ide_tpu.training.trainer import (TrainState,
+                                                    make_optimizer)
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    opt = make_optimizer()
+    state = TrainState(params=params, opt_state=jax.jit(opt.init)(params),
+                       step=jnp.zeros((), jnp.int32), opt=opt)
+    adapter = StreamingTrainerAdapter(state, config, None, optimizer=opt,
+                                      max_len=32)
+    queue = ExperienceQueue(group_size=8, max_staleness=64)
+    rounds = {"n": 0}
+
+    def run():
+        r = rounds["n"] = rounds["n"] + 1
+        eps = [StreamedEpisode(
+            episode_id=f"pg/r{r}/i{i}", group_key=f"pg/r{r}",
+            prompt_ids=[(i * 7 + j) % 200 + 2 for j in range(8)],
+            completion_ids=[(i + j) % 200 + 2 for j in range(4)],
+            reward=float(i % 3) - 1.0, epoch=1, version=r,
+            behavior_logp=[-0.5, -0.25, -0.5, -0.25])
+            for i in range(8)]
+        queue.offer_many(eps, current_version=r)
+        batch = queue.take_batch(current_version=r)
+        assert batch is not None
+        adapter.train_on_batch(batch)
+        adapter.note_published(r)
+        jax.block_until_ready(adapter.params)
+
+    run()                                   # warmup
+    step_s, leaked = _timed_window(run, "trainer.grpo_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("trainer.grpo_step")}
+
+
 CASES = {
     "engine_decode": _case_engine_decode,
     "spec_decode": _case_spec_decode,
     "kv_pressure": _case_kv_pressure,
     "multi_lora": _case_multi_lora,
     "train_step": _case_train_step,
+    "streaming_grpo": _case_streaming_grpo,
     "reward_head": _case_reward_head,
 }
 

@@ -86,6 +86,7 @@ HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/rollout/speculative.py",
     "senweaver_ide_tpu/serve/replica.py",
     "senweaver_ide_tpu/training/draft_distill.py",
+    "senweaver_ide_tpu/training/experience.py",
 )
 
 # Attribute reads that are STATIC under tracing even on a tracer:

@@ -42,6 +42,11 @@ TRIGGER_GRAD_SPARSITY = "grad_sparsity"
 TRIGGER_NONFINITE = "nonfinite_rewards"
 TRIGGER_ENTROPY_FLOOR = "entropy_floor"
 TRIGGER_KL_DRIFT = "kl_drift"
+# Streaming-learner detector (PR 15): the mean versions-behind of the
+# episodes trained this round drifted past the configured bound — the
+# async pipeline is running too far off-policy and the mitigator can
+# veto it back to lockstep (resilience.MITIGATION_LOCKSTEP_FALLBACK).
+TRIGGER_STALENESS_DRIFT = "staleness_drift"
 
 # Gauge-published signals, in report order. Keys absent from a round's
 # health dict are simply skipped (e.g. grad_sparsity on a vetoed round).
@@ -50,7 +55,8 @@ HEALTH_KEYS = (
     "groups_present", "advantage_mean", "advantage_std",
     "effective_rank", "rank_fraction", "participation_ratio",
     "top_singular_value", "credit_entropy", "grad_sparsity",
-    "policy_entropy", "kl_to_anchor",
+    "policy_entropy", "kl_to_anchor", "staleness_mean",
+    "stale_drop_fraction",
 )
 
 RANK_FRACTION_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.5, 0.7, 0.9, 1.0)
@@ -68,6 +74,9 @@ class TrainingHealthConfig:
     nonfinite_max: Optional[float] = 0.0
     policy_entropy_min: Optional[float] = None
     kl_max: Optional[float] = None
+    # Streaming mode only: mean versions-behind of a trained batch
+    # (None for lockstep runs — the signal isn't even reported there).
+    staleness_mean_max: Optional[float] = None
     window: int = 256      # rolling per-round ring length
     worst_k: int = 8       # K-worst round capture
 
@@ -104,6 +113,8 @@ def evaluate_health(health: Dict[str, float],
     _check(TRIGGER_ENTROPY_FLOOR, "policy_entropy",
            cfg.policy_entropy_min, below=True)
     _check(TRIGGER_KL_DRIFT, "kl_to_anchor", cfg.kl_max, below=False)
+    _check(TRIGGER_STALENESS_DRIFT, "staleness_mean",
+           cfg.staleness_mean_max, below=False)
     return triggers
 
 
@@ -177,7 +188,8 @@ class TrainingHealthMonitor:
                           self.config.grad_sparsity_max,
                           self.config.nonfinite_max,
                           self.config.policy_entropy_min,
-                          self.config.kl_max)
+                          self.config.kl_max,
+                          self.config.staleness_mean_max)
             if lim is not None)
         score = 1.0 - (len(triggers) / n_detectors if n_detectors else 0.0)
         self._score_gauge.set(score)

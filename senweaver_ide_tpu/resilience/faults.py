@@ -87,6 +87,9 @@ class ResilienceConfig:
     mitigate_leave_one_out: bool = True     # RLOO on rank_collapse/zero_groups
     mitigate_token_level: bool = True       # token credit on credit_collapse
     mitigate_group_size: bool = False       # scheduler hook (rl_loop/online)
+    # Streaming learner → lockstep veto on staleness_drift (the async
+    # pipeline polls lockstep_fallback_active, like group_size).
+    mitigate_lockstep_fallback: bool = True
     # Hysteresis: a trigger must fire this many CONSECUTIVE rounds to
     # enable its mitigation, and stay quiet this many to disable it —
     # one noisy round shouldn't flip the objective back and forth.

@@ -39,6 +39,7 @@ from typing import Dict, Iterable, List, Optional, Tuple
 from ..obs.training_health import (TRIGGER_CREDIT_COLLAPSE,
                                    TRIGGER_GRAD_SPARSITY,
                                    TRIGGER_RANK_COLLAPSE,
+                                   TRIGGER_STALENESS_DRIFT,
                                    TRIGGER_ZERO_GROUPS)
 from .faults import ResilienceConfig
 
@@ -122,6 +123,7 @@ class UpdateGuard:
 MITIGATION_LEAVE_ONE_OUT = "leave_one_out"
 MITIGATION_TOKEN_LEVEL = "token_level_advantages"
 MITIGATION_GROUP_SIZE = "group_size"
+MITIGATION_LOCKSTEP_FALLBACK = "lockstep_fallback"
 
 _MITIGATION_TRIGGERS: Dict[str, Tuple[str, ...]] = {
     # Rank collapse / tied groups: std-normalization couples every
@@ -136,6 +138,13 @@ _MITIGATION_TRIGGERS: Dict[str, Tuple[str, ...]] = {
     # separate rewards — grow it (scheduler lives in training/rl_loop).
     MITIGATION_GROUP_SIZE: (TRIGGER_ZERO_GROUPS,
                             TRIGGER_GRAD_SPARSITY),
+    # Streaming learner running too far off-policy: drop back to
+    # lockstep (train only on current-version batches and block on
+    # publish convergence) until staleness quiets. No config field on
+    # GRPOConfig — the streaming learner polls
+    # :meth:`HealthMitigator.lockstep_fallback_active`, exactly the
+    # group_size pattern.
+    MITIGATION_LOCKSTEP_FALLBACK: (TRIGGER_STALENESS_DRIFT,),
 }
 
 
@@ -188,6 +197,8 @@ class HealthMitigator:
                 MITIGATION_LEAVE_ONE_OUT: config.mitigate_leave_one_out,
                 MITIGATION_TOKEN_LEVEL: config.mitigate_token_level,
                 MITIGATION_GROUP_SIZE: config.mitigate_group_size,
+                MITIGATION_LOCKSTEP_FALLBACK:
+                    config.mitigate_lockstep_fallback,
             },
             trigger_rounds=config.health_trigger_rounds,
             registry=registry)
@@ -261,3 +272,10 @@ class HealthMitigator:
     def group_size_active(self) -> bool:
         with self._lock:
             return self.active[MITIGATION_GROUP_SIZE]
+
+    def lockstep_fallback_active(self) -> bool:
+        """True while the staleness-drift streak holds — the streaming
+        learner polls this each step and runs lockstep (synchronous
+        publish, zero-staleness batches) until the detector quiets."""
+        with self._lock:
+            return self.active[MITIGATION_LOCKSTEP_FALLBACK]

@@ -16,9 +16,14 @@ from .admission import (AdmissionConfig, AdmissionQueue, ClassPolicy,
 from .autoscale import (ACTION_ADD, ACTION_DRAIN, AutoscaleConfig,
                         AutoscaleController)
 from .frontend import Completed, ServingFleet
-from .learner import (FleetPublishClient, LearnerConfig,
-                      LearnerPublishError, LearnerService)
-from .learner_server import FleetRpcHandler, serve_fleet_http
+from .learner import (EpisodeStreamer, ExperienceClient,
+                      FleetPublishClient, LearnerConfig,
+                      LearnerPublishError, LearnerService,
+                      StreamingLearnerConfig, StreamingLearnerService)
+from .learner_server import (ExperienceRpcHandler, FleetRpcHandler,
+                             LeaseRpcHandler, RemoteLeaseStore,
+                             serve_experience_http, serve_fleet_http,
+                             serve_lease_http)
 from .prefix_store import SharedPrefixStore
 from .remote import (PROBE_DEAD, PROBE_OK, PROBE_SLOW,
                      RemoteEngineClient, RemoteReplica)
@@ -36,18 +41,22 @@ __all__ = [
     "AdmissionConfig", "AdmissionQueue", "AutoscaleConfig",
     "AutoscaleController", "ClassPolicy", "Completed",
     "DEAD", "DRAINING", "EngineReplica", "EngineRpcHandler",
+    "EpisodeStreamer", "ExperienceClient", "ExperienceRpcHandler",
     "FleetPublishClient", "FleetRequest", "FleetRpcHandler",
     "HttpTransport", "INTERACTIVE",
     "LIVE", "LearnerConfig", "LearnerPublishError", "LearnerService",
-    "LoopbackTransport", "PRIORITY_CLASSES",
+    "LeaseRpcHandler", "LoopbackTransport", "PRIORITY_CLASSES",
     "PROBE_DEAD", "PROBE_OK", "PROBE_SLOW",
     "REJECT_DEADLINE", "REJECT_NO_REPLICAS",
     "REJECT_QUEUE_FULL", "REJECT_RATE_LIMITED", "REJECT_REPLICA_FAILURE",
-    "Rejected", "RemoteEngineClient", "RemoteReplica", "ReplicaDead",
+    "Rejected", "RemoteEngineClient", "RemoteLeaseStore", "RemoteReplica",
+    "ReplicaDead",
     "RequestRejected", "Router", "RpcApplicationError", "RpcCircuitOpen",
     "RpcError", "RpcHandlerBase", "RpcProtocolError", "RpcServerError",
     "RpcTimeout", "RpcTransportError", "ServingFleet",
     "SharedPrefixStore", "StalePublishError",
+    "StreamingLearnerConfig", "StreamingLearnerService",
     "TRAIN_ROLLOUT", "TokenBucket", "WeightPublisher",
-    "serve_engine_http", "serve_fleet_http", "serve_rpc_http",
+    "serve_engine_http", "serve_experience_http", "serve_fleet_http",
+    "serve_lease_http", "serve_rpc_http",
 ]

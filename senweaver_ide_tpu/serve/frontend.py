@@ -86,19 +86,29 @@ class ServingFleet:
                  metrics_service=None,
                  shared_prefix_broadcast: bool = True,
                  probe_interval_s: float = 1.0,
+                 host_groups: Optional[Sequence[Optional[str]]] = None,
                  slo: Optional[SLOConfig] = None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
+        if host_groups is not None and len(host_groups) != len(engines):
+            raise ValueError(
+                f"host_groups has {len(host_groups)} entries for "
+                f"{len(engines)} engines")
         if registry is None:
             from ..obs import get_registry
             registry = get_registry()
         self.registry = registry
         self.clock = clock
         self.metrics_service = metrics_service
+        # host_groups labels engines by rack/host for the prefix
+        # store's one-donor-per-host fanout; None entries (and wrapped
+        # EngineReplica instances, which carry their own label) keep
+        # the every-replica-its-own-host default.
         self.replicas: List[EngineReplica] = [
             e if isinstance(e, EngineReplica) else EngineReplica(
                 f"replica-{i}", e,
                 max_consecutive_faults=max_consecutive_faults,
+                host_group=(host_groups[i] if host_groups else None),
                 registry=registry)
             for i, e in enumerate(engines)]
         self.admission = AdmissionQueue(admission, registry=registry,
@@ -469,14 +479,18 @@ class ServingFleet:
         return v
 
     def begin_publish(self, params, *, epoch: Optional[int] = None,
-                      version: Optional[int] = None) -> int:
+                      version: Optional[int] = None,
+                      eager: bool = False) -> int:
         """Stage a fenced publish WITHOUT blocking on the roll — the
         learner-gateway path: the fleet's own pump (manual ``step()``
         or the dispatcher thread) rolls it forward while the learner
-        polls convergence over rpc."""
+        polls convergence over rpc. ``eager=True`` requests the
+        no-drain roll (replicas swap opportunistically at zero
+        in-flight; see :meth:`WeightPublisher.begin`) — the streaming
+        learner's default, so collection never pauses for a publish."""
         with self._lock:
             v = self.publisher.begin(params, epoch=epoch,
-                                     version=version)
+                                     version=version, eager=eager)
             self._track_publish_window(self.clock())
             return v
 
@@ -512,7 +526,8 @@ class ServingFleet:
 
     # -- chaos / operations --------------------------------------------------
     def add_replica(self, engine, *,
-                    replica_id: Optional[str] = None) -> EngineReplica:
+                    replica_id: Optional[str] = None,
+                    host_group: Optional[str] = None) -> EngineReplica:
         """Grow the fleet with a new (or resurrected) replica. The
         engine must already hold the CURRENT published params — the
         fleet stamps it with the publisher's version rather than
@@ -538,6 +553,7 @@ class ServingFleet:
                 self.prefix_store.forget_replica(replica_id)
             replica = (engine if isinstance(engine, EngineReplica)
                        else EngineReplica(replica_id, engine,
+                                          host_group=host_group,
                                           registry=self.registry))
             # Through the replica's own locked mutator: weight_version
             # is guarded by replica._lock, not ours (analysis LOCK102).

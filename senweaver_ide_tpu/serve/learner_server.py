@@ -93,14 +93,18 @@ class FleetRpcHandler(RpcHandlerBase):
                                                      int(epoch))}
 
     # -- publish saga --------------------------------------------------------
-    def _m_publish(self, params, epoch, version) -> Dict[str, Any]:
+    def _m_publish(self, params, epoch, version,
+                   eager=False) -> Dict[str, Any]:
         # Fencing check 1: the epoch must be the LIVE lease (raises
         # LeaseLost across the wire). Check 2 is the publisher's own
-        # monotonic high-water mark — both must pass.
+        # monotonic high-water mark — both must pass. ``eager``
+        # requests the no-drain roll (the streaming learner's default).
         self.lease_store.validate(int(epoch), now=self.clock())
         v = self.fleet.begin_publish(params, epoch=int(epoch),
-                                     version=int(version))
-        return {"version": v, "epoch": int(epoch), "staged": True}
+                                     version=int(version),
+                                     eager=bool(eager))
+        return {"version": v, "epoch": int(epoch), "staged": True,
+                "eager": bool(eager)}
 
     def _m_publish_adapter(self, tenant_id, lora, epoch,
                            version=None) -> Dict[str, Any]:
@@ -170,3 +174,186 @@ def serve_fleet_http(fleet_or_handler, *, host: str = "127.0.0.1",
                else FleetRpcHandler(fleet_or_handler))
     return serve_rpc_http(handler, host=host, port=port,
                           thread_name="serve-learner-http")
+
+
+# -- standalone lease authority (satellite: shared across fleets) ------------
+
+LEASE_MUTATING_METHODS = frozenset()
+# EMPTY on purpose — the PR-7 zombie-grant rule in its new topology:
+# idempotency-caching a lease grant would let a restarted client whose
+# request ids collide with a previous incarnation REPLAY that
+# incarnation's epoch and write as a zombie. Re-EXECUTING lease ops on
+# a retried request id is always safe (acquire grants a fresh higher
+# epoch; renew/release/validate act on live state), so nothing here is
+# cached.
+
+
+class LeaseRpcHandler(RpcHandlerBase):
+    """The learner lease as its OWN process: one
+    :class:`~..resilience.lease.LeaseStore` behind an rpc endpoint, so
+    several fleets can share a single learner (each fleet's
+    :class:`FleetRpcHandler` delegates through a
+    :class:`RemoteLeaseStore`) without any fleet being the authority.
+    Time is always THIS process's clock — lease validity must not
+    depend on N fleet clocks agreeing."""
+
+    mutating_methods = LEASE_MUTATING_METHODS
+    span_service = "lease"
+
+    def __init__(self, store: Optional[LeaseStore] = None, *,
+                 ttl_s: float = 30.0, clock=None,
+                 idempotency_cache_size: int = 256, registry=None):
+        super().__init__(idempotency_cache_size=idempotency_cache_size)
+        import time as _time
+        self.store = store or LeaseStore(ttl_s=ttl_s, registry=registry)
+        self.clock = clock if clock is not None else _time.monotonic
+
+    def _m_acquire_lease(self, holder, steal=False) -> Dict[str, Any]:
+        lease = self.store.acquire(str(holder), now=self.clock(),
+                                   steal=bool(steal))
+        return {"epoch": lease.epoch, "expires_at": lease.expires_at,
+                "ttl_s": self.store.ttl_s}
+
+    def _m_renew_lease(self, holder, epoch) -> Dict[str, Any]:
+        lease = self.store.renew(str(holder), int(epoch),
+                                 now=self.clock())
+        return {"epoch": lease.epoch, "expires_at": lease.expires_at}
+
+    def _m_release_lease(self, holder, epoch) -> Dict[str, Any]:
+        return {"released": self.store.release(str(holder), int(epoch))}
+
+    def _m_validate_lease(self, epoch) -> Dict[str, Any]:
+        # Raises LeaseLost across the wire when ``epoch`` isn't live —
+        # the fencing check a fleet runs before staging a publish.
+        self.store.validate(int(epoch), now=self.clock())
+        return {"valid": True, "epoch": int(epoch)}
+
+    def _m_lease_info(self) -> Dict[str, Any]:
+        cur = self.store.current()
+        return {"ttl_s": self.store.ttl_s,
+                "epoch": self.store.current_epoch,
+                "holder": cur.holder if cur is not None else None}
+
+    def _m_health(self) -> Dict[str, Any]:
+        return {"state": "ok", "epoch": self.store.current_epoch}
+
+
+class RemoteLeaseStore:
+    """Client-side duck of :class:`~..resilience.lease.LeaseStore` over
+    rpc — what a fleet injects as ``FleetRpcHandler(lease_store=...)``
+    when the lease authority runs in its own process. The surface
+    matches the in-memory store (acquire/renew/release/validate +
+    ``ttl_s``); callers' ``now=`` kwargs are accepted for signature
+    compatibility but IGNORED — the authority's clock is the only one
+    that counts. Typed lease errors (``LeaseLost``,
+    ``LeaseUnavailable``) rehydrate across the wire as themselves."""
+
+    def __init__(self, transport, *, name: Optional[str] = None,
+                 policy=None, clock=None, sleep=None, rng=None,
+                 registry=None):
+        from ..resilience.retry import RetryPolicy
+        from .learner import FleetPublishClient
+        import time as _time
+        self._rpc = FleetPublishClient(
+            transport, name=name,
+            policy=policy or RetryPolicy(max_retries=3,
+                                         base_delay_s=0.05,
+                                         max_delay_s=2.0),
+            clock=clock if clock is not None else _time.monotonic,
+            sleep=sleep, rng=rng, registry=registry)
+        self.name = self._rpc.name
+        self._ttl_s: Optional[float] = None
+
+    @property
+    def ttl_s(self) -> float:
+        if self._ttl_s is None:
+            self._ttl_s = float(self._rpc._call("lease_info")["ttl_s"])
+        return self._ttl_s
+
+    def acquire(self, holder: str, *, now: Optional[float] = None,
+                steal: bool = False):
+        from ..resilience.lease import Lease
+        out = self._rpc._call("acquire_lease",
+                              {"holder": str(holder),
+                               "steal": bool(steal)})
+        self._ttl_s = float(out.get("ttl_s", self._ttl_s or 30.0))
+        return Lease(holder=str(holder), epoch=int(out["epoch"]),
+                     expires_at=float(out["expires_at"]))
+
+    def renew(self, holder: str, epoch: int, *,
+              now: Optional[float] = None):
+        from ..resilience.lease import Lease
+        out = self._rpc._call("renew_lease",
+                              {"holder": str(holder),
+                               "epoch": int(epoch)})
+        return Lease(holder=str(holder), epoch=int(out["epoch"]),
+                     expires_at=float(out["expires_at"]))
+
+    def release(self, holder: str, epoch: int) -> bool:
+        out = self._rpc._call("release_lease",
+                              {"holder": str(holder),
+                               "epoch": int(epoch)})
+        return bool(out.get("released"))
+
+    def validate(self, epoch: int, *,
+                 now: Optional[float] = None) -> None:
+        self._rpc._call("validate_lease", {"epoch": int(epoch)})
+
+
+def serve_lease_http(store_or_handler=None, *, host: str = "127.0.0.1",
+                     port: int = 0, ttl_s: float = 30.0):
+    """Serve a standalone lease authority over real HTTP; returns
+    ``(server, port)``."""
+    handler = (store_or_handler
+               if isinstance(store_or_handler, LeaseRpcHandler)
+               else LeaseRpcHandler(store_or_handler, ttl_s=ttl_s))
+    return serve_rpc_http(handler, host=host, port=port,
+                          thread_name="serve-lease-http")
+
+
+# -- streaming experience intake (learner-side endpoint) ---------------------
+
+EXPERIENCE_MUTATING_METHODS = frozenset({"submit_episodes"})
+# submit_episodes IS idempotency-cached: a batch whose ack frame was
+# lost (drop_response chaos) must REPLAY the recorded acks, not
+# re-offer — the queue's seen-set would ack "duplicate" anyway, but
+# replaying keeps the collector's view of each episode's FIRST outcome
+# stable (an episode accepted then evicted must not flap to "stale" on
+# the retry of the same request).
+
+
+class ExperienceRpcHandler(RpcHandlerBase):
+    """Collector→learner episode intake over rpc. Wraps a
+    :class:`~.learner.StreamingLearnerService` (or any object with
+    ``intake(episodes)`` / ``stream_stats()``)."""
+
+    mutating_methods = EXPERIENCE_MUTATING_METHODS
+    span_service = "learner"
+
+    def __init__(self, learner, *, idempotency_cache_size: int = 1024):
+        super().__init__(idempotency_cache_size=idempotency_cache_size)
+        self.learner = learner
+
+    def _m_submit_episodes(self, episodes) -> Dict[str, Any]:
+        from ..training.experience import StreamedEpisode
+        eps = [e if isinstance(e, StreamedEpisode)
+               else StreamedEpisode.from_wire(dict(e))
+               for e in episodes]
+        return self.learner.intake(eps)
+
+    def _m_stream_stats(self) -> Dict[str, Any]:
+        return self.learner.stream_stats()
+
+    def _m_health(self) -> Dict[str, Any]:
+        return {"state": "ok"}
+
+
+def serve_experience_http(learner_or_handler, *,
+                          host: str = "127.0.0.1", port: int = 0):
+    """Serve a streaming learner's episode intake over real HTTP;
+    returns ``(server, port)``."""
+    handler = (learner_or_handler
+               if isinstance(learner_or_handler, ExperienceRpcHandler)
+               else ExperienceRpcHandler(learner_or_handler))
+    return serve_rpc_http(handler, host=host, port=port,
+                          thread_name="serve-experience-http")

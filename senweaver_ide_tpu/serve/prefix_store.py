@@ -38,6 +38,16 @@ mismatch → :class:`~..rollout.engine.PrefixImportError`, OOM) marks the
 entry failed and falls back to the pre-store behavior — each replica
 lazily prefills on first use (``EngineReplica.submit``). The store can
 make serving faster, never wedge it.
+
+Rack-aware fanout: when replicas carry ``host_group`` labels the eager
+broadcast installs into ONE replica per host group (the donor covers
+its own); the rest of each host backfills lazily from the NEAREST
+resident copy — a same-host peer re-exports its installed KV
+(``EngineReplica.export_shared_prefix``,
+``senweaver_serve_prefix_nearest_backfills_total``) so the donor
+buffer crosses each rack boundary once instead of once per replica.
+Unlabeled fleets default to one host per replica, which degrades both
+paths to the original broadcast-to-all behavior exactly.
 """
 
 from __future__ import annotations
@@ -111,6 +121,11 @@ class SharedPrefixStore:
             "Donor exports served from the engine's host-RAM KV tier "
             "(the prefix had been swapped out — the broadcast cost "
             "zero donor device traffic and no re-prefill).")
+        self._nearest_backfills_total = registry.counter(
+            "senweaver_serve_prefix_nearest_backfills_total",
+            "Late-replica prefix backfills served from a same-host "
+            "resident copy (peer re-export) instead of the original "
+            "donor buffer crossing the rack boundary again.")
         self._shared_gauge.set(0)
 
     # -- registry ------------------------------------------------------------
@@ -194,9 +209,40 @@ class SharedPrefixStore:
             self._donate(entry, replica)
             return ("donor" if entry.donor_id == replica.replica_id
                     else "lazy")
-        # Late joiner / resurrected replica / was DRAINING during
-        # the broadcast: backfill from the stored buffer.
+        # Late joiner / resurrected replica / was DRAINING during the
+        # broadcast / non-seed member of a labeled host group: backfill
+        # from the NEAREST resident copy — a same-host peer re-exports
+        # its installed KV so the donor buffer doesn't cross the rack
+        # boundary twice — falling back to the stored donor buffer.
+        nearest = self._nearest_source(entry, replica)
+        if nearest is not None:
+            try:
+                _, kv, last = nearest.export_shared_prefix(entry.tokens)
+            except Exception:
+                self._failures_total.inc()
+            else:
+                if self._install(entry, replica, kv=kv, last_logits=last):
+                    self._nearest_backfills_total.inc()
+                    return "import"
+                if entry.failed:
+                    return "lazy"
         return "import" if self._install(entry, replica) else "lazy"
+
+    def _nearest_source(self, entry: _SharedPrefix,
+                        replica: EngineReplica
+                        ) -> Optional[EngineReplica]:
+        """A LIVE same-host peer that already installed the entry (the
+        cheapest backfill source). None when the replica's host has no
+        resident copy — including every unlabeled fleet, where each
+        replica is its own host."""
+        for peer in self.replicas:
+            if (peer.replica_id == replica.replica_id
+                    or peer.state != LIVE
+                    or peer.replica_id not in entry.installed):
+                continue
+            if peer.host == replica.host:
+                return peer
+        return None
 
     def _donate(self, entry: _SharedPrefix,
                 replica: EngineReplica) -> None:
@@ -223,19 +269,31 @@ class SharedPrefixStore:
         entry.kv = kv
         entry.last_logits = last
         entry.installed.add(replica.replica_id)
+        # Rack-aware fanout: ONE eager install per host group (the
+        # donor already covers its own); the rest of each host
+        # backfills from its seeded peer via the nearest-copy path in
+        # :meth:`ensure`. Unlabeled replicas are each their own host,
+        # so this is broadcast-to-all exactly as before.
+        covered = {replica.host}
         for peer in self.replicas:
             if (peer.replica_id == replica.replica_id
-                    or peer.state != LIVE):
+                    or peer.state != LIVE
+                    or peer.host in covered):
                 continue
-            self._install(entry, peer)
+            if self._install(entry, peer):
+                covered.add(peer.host)
+            elif entry.failed:
+                break
 
     def _install(self, entry: _SharedPrefix,
-                 replica: EngineReplica) -> bool:
+                 replica: EngineReplica, *, kv=None,
+                 last_logits=None) -> bool:
         from ..rollout.engine import PrefixImportError
         t0 = time.perf_counter()
+        if kv is None:
+            kv, last_logits = entry.kv, entry.last_logits
         try:
-            replica.install_shared_prefix(entry.tokens, entry.kv,
-                                          entry.last_logits)
+            replica.install_shared_prefix(entry.tokens, kv, last_logits)
         except PrefixImportError:
             # Import refused: the buffer doesn't fit this pool's layout.
             # That's a fleet-config property, not a transient — it would

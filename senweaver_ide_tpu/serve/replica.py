@@ -45,9 +45,15 @@ class EngineReplica:
 
     def __init__(self, replica_id: str, engine, *,
                  max_consecutive_faults: int = 3,
+                 host_group: Optional[str] = None,
                  registry=None):
         self.replica_id = replica_id
         self.engine = engine
+        # Rack/host placement label for the shared-prefix store's
+        # one-donor-per-host fanout. None (the default) means "its own
+        # host", which degrades rack-awareness to the original
+        # broadcast-to-everyone behavior.
+        self.host_group = host_group
         self.state = LIVE                       # guarded-by: _lock
         self.weight_version = 0                 # guarded-by: _lock
         self.max_consecutive_faults = max(1, int(max_consecutive_faults))
@@ -136,6 +142,13 @@ class EngineReplica:
         with self._lock:
             return self.state == LIVE and len(self.inflight) < self.capacity
 
+    @property
+    def host(self) -> str:
+        """Host-group key for rack-aware fanout (falls back to the
+        replica id — every unlabeled replica is its own host)."""
+        return self.host_group if self.host_group is not None \
+            else self.replica_id
+
     def holds_prefix(self, tokens: Tuple[int, ...]) -> bool:
         with self._lock:
             return tokens in self._prefixes
@@ -165,6 +178,17 @@ class EngineReplica:
             if prefix_id is None:
                 prefix_id = self.engine.register_prefix(list(tokens))
                 self._prefixes[key] = prefix_id
+            return self.engine.export_prefix(prefix_id)
+
+    def export_shared_prefix(self, tokens: List[int]):
+        """Re-export an ALREADY-resident prefix (no prefill): the
+        nearest-copy backfill path reads a same-host peer's KV instead
+        of the store's original donor export. KeyError if this replica
+        never installed the prefix."""
+        with self._lock:
+            if self.state == DEAD:
+                raise ReplicaDead(self.replica_id)
+            prefix_id = self._prefixes[tuple(tokens)]
             return self.engine.export_prefix(prefix_id)
 
     def install_shared_prefix(self, tokens: List[int], kv,

@@ -54,6 +54,14 @@ class WeightPublisher:
         self._pending_params = None             # guarded-by: _lock
         self._roll_queue: List[EngineReplica] = []  # guarded-by: _lock
         self._current: Optional[EngineReplica] = None  # guarded-by: _lock
+        # Eager (no-drain) roll state: while True the pump swaps
+        # replicas opportunistically at zero in-flight instead of
+        # draining them; _eager_waits counts consecutive pump steps
+        # that swapped nothing, and past _eager_wait_limit the roll
+        # falls back to classic draining so it always converges.
+        self._eager = False                     # guarded-by: _lock
+        self._eager_wait_limit = 512            # guarded-by: _lock
+        self._eager_waits = 0                   # guarded-by: _lock
         self._lock = threading.RLock()
         if registry is None:
             from ..obs import get_registry
@@ -145,7 +153,9 @@ class WeightPublisher:
         return max(versions) - min(versions)
 
     def begin(self, params, *, epoch: Optional[int] = None,
-              version: Optional[int] = None) -> int:
+              version: Optional[int] = None,
+              eager: bool = False,
+              eager_wait_limit: int = 512) -> int:
         """Stage a new version for rolling install; returns it. A begin
         during an unfinished roll fast-forwards: the in-progress roll
         retargets to the newest params (replicas already swapped to the
@@ -160,7 +170,18 @@ class WeightPublisher:
         (:class:`StalePublishError`); at the SAME epoch the version
         must strictly increase; a HIGHER epoch may carry any version —
         that is the crash-resume republish, which deliberately rolls
-        the fleet back to the new leader's last durable weights."""
+        the fleet back to the new leader's last durable weights.
+
+        ``eager=True`` is the streaming learner's NO-DRAIN roll: the
+        pump swaps replicas opportunistically as each hits zero
+        in-flight on its own (requests keep finishing — the fleet
+        never pauses admission for the publish), falling back to a
+        classic drain for a replica that stays busy
+        ``eager_wait_limit`` consecutive pump steps so convergence
+        stays bounded under saturation. Generations still never mix
+        weight versions — a replica swaps only at zero in-flight
+        either way, which is what keeps every streamed episode's
+        behavior stamp exact."""
         with self._lock:
             new_epoch = self.epoch if epoch is None else int(epoch)
             new_version = (self.version + 1 if version is None
@@ -182,6 +203,9 @@ class WeightPublisher:
             self._roll_queue = [r for r in self.replicas
                                 if r.state != DEAD]
             self._current = None
+            self._eager = bool(eager)
+            self._eager_wait_limit = max(0, int(eager_wait_limit))
+            self._eager_waits = 0
             # Speculation drafts are distilled against the OLD policy:
             # stamp them stale on every replica now — mirroring the
             # prefix-refcount drop below via _on_begin — instead of
@@ -284,6 +308,8 @@ class WeightPublisher:
             if self._pending_params is None:
                 self._update_skew()
                 return True
+            if self._eager:
+                return self._advance_eager()
             if self._current is None:
                 # Next replica to roll; skip ones that died mid-roll.
                 while self._roll_queue:
@@ -336,6 +362,48 @@ class WeightPublisher:
                     return True
             self._update_skew()
             return False
+
+    def _advance_eager(self) -> bool:
+        # guarded-by: caller (advance() holds _lock). No-drain roll: swap
+        # every queued replica currently at zero in-flight; replicas
+        # stay LIVE throughout so fleet capacity never dips. A pump step
+        # that swaps nothing burns one unit of eager patience; past the
+        # limit the roll degrades to the classic draining machinery
+        # (self._eager = False) so a saturated replica can't wedge the
+        # publish forever.
+        self._roll_queue = [r for r in self._roll_queue
+                            if r.state != DEAD]
+        swapped = 0
+        remaining: List[EngineReplica] = []
+        for cand in self._roll_queue:
+            if cand.outstanding != 0:
+                remaining.append(cand)
+                continue
+            try:
+                cand.install_weights(self._pending_params,
+                                     self.version, epoch=self.epoch)
+            except Exception:
+                self._quarantined_total.inc()
+                self._quarantined.append(cand)
+                continue
+            if cand.state != LIVE:
+                cand.resume()
+            self._rolled_total.inc()
+            swapped += 1
+        self._roll_queue = remaining
+        if not self._roll_queue:
+            self._pending_params = None
+            self._update_skew()
+            self._fire_end()
+            return True
+        if swapped == 0:
+            self._eager_waits += 1
+            if self._eager_waits > self._eager_wait_limit:
+                self._eager = False     # fall back to draining rolls
+        else:
+            self._eager_waits = 0
+        self._update_skew()
+        return False
 
     def take_quarantined(self) -> List[EngineReplica]:
         """Drain the replicas whose install failed mid-roll; the fleet

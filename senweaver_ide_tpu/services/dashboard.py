@@ -325,6 +325,26 @@ class DashboardService:
                     "senweaver_learner_resume_republishes_total"),
                 "learner_lease_lost": total(
                     "senweaver_learner_lease_lost_total"),
+                "learner_idle_fraction": total(
+                    "senweaver_learner_idle_fraction"),
+                "learner_streaming_mode": total(
+                    "senweaver_learner_streaming_mode"),
+                "stream_steps_streaming": total_where(
+                    "senweaver_learner_stream_steps_total", 0,
+                    "streaming"),
+                "stream_steps_lockstep": total_where(
+                    "senweaver_learner_stream_steps_total", 0,
+                    "lockstep"),
+                "experience_queue_depth": total(
+                    "senweaver_learner_experience_queue_depth"),
+                "experience_ready_groups": total(
+                    "senweaver_learner_experience_ready_groups"),
+                "stale_episodes": total(
+                    "senweaver_learner_stale_episodes_total"),
+                "duplicate_episodes": total(
+                    "senweaver_learner_duplicate_episodes_total"),
+                "collector_stall_fraction": total(
+                    "senweaver_collector_stall_fraction"),
                 "autoscale_adds": total_where(
                     "senweaver_serve_autoscale_actions_total", 0, "add"),
                 "autoscale_drains": total_where(
@@ -795,6 +815,8 @@ input[type=text], input[type=password], textarea {
 <div id="slo-exemplars"></div></section>
 <section><h2>Learner &amp; autoscaler</h2>
 <div id="learner" class="tiles"></div></section>
+<section><h2>Streaming experience</h2>
+<div id="streaming" class="tiles"></div></section>
 <section><h2>Runtime</h2>
 <div id="runtime" class="tiles"></div>
 <div id="runtime-fns"></div></section>
@@ -1104,6 +1126,16 @@ async function refresh() {
     ["autoscale adds", sv.autoscale_adds],
     ["autoscale drains", sv.autoscale_drains],
     ["shed rate (1/s)", sv.autoscale_shed_rate]]);
+  tiles(document.getElementById("streaming"), [
+    ["mode (1=streaming)", sv.learner_streaming_mode],
+    ["learner idle fraction", sv.learner_idle_fraction],
+    ["steps (streaming)", sv.stream_steps_streaming],
+    ["steps (lockstep)", sv.stream_steps_lockstep],
+    ["queue depth", sv.experience_queue_depth],
+    ["ready groups", sv.experience_ready_groups],
+    ["stale episodes dropped", sv.stale_episodes],
+    ["duplicate episodes", sv.duplicate_episodes],
+    ["collector stall fraction", sv.collector_stall_fraction]]);
   const rt = s.runtime || {};
   tiles(document.getElementById("runtime"), [
     ["profiled calls", rt.calls],

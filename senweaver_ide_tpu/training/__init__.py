@@ -11,6 +11,10 @@ from .checkpoint import CheckpointManager
 from .data import (Trajectory, TrajectoryDataset, make_batch,
                    make_batch_logps)
 from .async_loop import AsyncGRPOTrainer, AsyncRoundResult
+from .experience import (BehaviorParamsCache, BehaviorParamsEvicted,
+                         ExperienceQueue, StreamedEpisode,
+                         StreamingTrainerAdapter, assemble_batch,
+                         trajectories_to_episodes)
 from .rl_loop import (CollectResult, EpisodeRecord, GroupSizeScheduler,
                       RoundResult, collect_group_trajectories, grpo_round)
 from .online import OnlineImprovementLoop, OnlineRoundResult

@@ -13,11 +13,15 @@ most ``prefetch`` updates behind the params that train on it. Two
 correction modes:
 
 - ``importance_correction=True`` (default): the behavior params that
-  collected each batch are kept (a pytree REFERENCE — no copy) and the
-  batch's ``old_logp`` is computed under them just before the update, so
-  the clipped objective's importance ratio is exact. Costs one extra
-  resident param set per in-flight batch — fine at 1.5B, not at 7B on a
-  16 GB chip.
+  collected each batch are held in a BOUNDED version-keyed LRU
+  (:class:`~.experience.BehaviorParamsCache`) and the batch's
+  ``old_logp`` is computed under them just before the update, so the
+  clipped objective's importance ratio is exact. Residency is
+  O(cache capacity) param trees no matter how far the collector runs
+  ahead; when a batch's behavior version has aged out, the step
+  degrades to the ratio-1 approximation under the current params —
+  counted (``senweaver_grpo_behavior_ratio_one_fallbacks_total``),
+  never crashed.
 - ``importance_correction=False``: ``old_logp = stop_grad(current)``
   (ratio 1), the standard 1-step-stale approximation.
 
@@ -47,6 +51,7 @@ import jax
 import jax.numpy as jnp
 
 from .data import make_batch, make_batch_logps, place_batch_for_mesh
+from .experience import BehaviorParamsCache, BehaviorParamsEvicted
 from .grpo import GRPOConfig, token_logprobs
 from .rl_loop import EpisodeRecord, collect_group_trajectories
 from .trainer import TrainState, train_step
@@ -90,8 +95,11 @@ class AsyncRoundResult:
 class _Collected:
     trajectories: list
     episodes: List[EpisodeRecord]
+    # Version stamp only — the params themselves live in the trainer's
+    # bounded BehaviorParamsCache, NOT on the queue item (an unbounded
+    # reference per in-flight batch was the old host-memory leak when
+    # the collector outran the trainer).
     behavior_version: int
-    behavior_params: object
     collect_s: float = field(default=0.0)
 
 
@@ -111,6 +119,7 @@ class AsyncGRPOTrainer:
                  ppo_epochs: int = 1,
                  prefetch: int = 1,
                  importance_correction: bool = True,
+                 behavior_cache_size: Optional[int] = None,
                  publish_params: Optional[Callable[[object], None]] = None,
                  metrics_service=None,
                  lora_base=None,
@@ -147,6 +156,15 @@ class AsyncGRPOTrainer:
 
         self._queue: "queue.Queue[_Collected]" = queue.Queue(
             maxsize=max(1, prefetch))
+        # Bounded behavior-params residency: every version the collector
+        # may still train against is cached here by version; anything
+        # older is evicted (typed, counted) and its batches degrade to
+        # ratio-1. Default capacity covers the pipeline depth plus the
+        # batch currently training and one staged publish.
+        self.behavior_cache = BehaviorParamsCache(
+            behavior_cache_size if behavior_cache_size is not None
+            else max(2, prefetch) + 2)
+        self.behavior_cache.put(0, self._merged_view(state.params))
         self._publish_lock = threading.Lock()
         # Staged (version, params) awaiting publication; the collector
         # applies it at round boundaries. _applied_behavior is the last
@@ -173,6 +191,9 @@ class AsyncGRPOTrainer:
             pending = (pending[0], self._folded_view(pending[1]))
             self.publish_params(pending[1])
             self._applied_behavior = pending
+            # The cache, not the queue item, is what _train_on reads
+            # the behavior params back from (bounded residency).
+            self.behavior_cache.put(pending[0], pending[1])
 
     def set_ref_params(self, ref_params) -> None:
         """Swap the KL anchor (rolling-anchor pattern); takes effect on
@@ -222,13 +243,18 @@ class AsyncGRPOTrainer:
                     version = self._version
                     # reference for full FT; zero-copy merge for LoRA
                     params = self._merged_view(self.state.params)
+                    self.behavior_cache.put(version, params)
                 t0 = time.monotonic()
                 trajectories, episodes = collect_group_trajectories(
                     self.make_session, self.tasks,
                     group_size=self.group_size,
                     reward_override=self.reward_override,
                     max_parallel=self.max_parallel)
-                item = _Collected(trajectories, episodes, version, params,
+                for ep in episodes:
+                    # (epoch, version) behavior stamp — the in-process
+                    # pipeline has no lease, so epoch stays 0.
+                    ep.behavior_version = version
+                item = _Collected(trajectories, episodes, version,
                                   collect_s=time.monotonic() - t0)
                 while not self._stop.is_set():
                     try:
@@ -301,7 +327,15 @@ class AsyncGRPOTrainer:
             # they are computed here regardless of the
             # importance_correction flag (which governs only the
             # 1-epoch staleness case). Microbatched like the update.
-            old_logp = behavior_logp_batched(item.behavior_params,
+            try:
+                behavior = self.behavior_cache.get(item.behavior_version)
+            except BehaviorParamsEvicted:
+                # Collector outran the trainer past the cache bound:
+                # degrade to ratio-1 under the CURRENT params (counted),
+                # instead of crashing or pinning unbounded param trees.
+                self.behavior_cache.note_ratio_one_fallback()
+                behavior = self._merged_view(self.state.params)
+            old_logp = behavior_logp_batched(behavior,
                                              self.model_config, tokens,
                                              self.accum_steps)
 

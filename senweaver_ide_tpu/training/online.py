@@ -37,7 +37,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..apo.eval import outcome_feedback
 from ..apo.service import APOService
-from ..obs import get_tracer
+from ..obs import get_registry, get_tracer
 from ..resilience.faults import ResilienceConfig
 from ..resilience.guard import HealthMitigator, UpdateGuard
 from ..traces.collector import TraceCollector
@@ -123,7 +123,8 @@ class OnlineImprovementLoop:
                  resilience: Optional[ResilienceConfig] = None,
                  checkpoint_manager=None,
                  checkpoint_every: int = 1,
-                 tenant_id: Optional[str] = None):
+                 tenant_id: Optional[str] = None,
+                 experience_sink=None):
         self.state = state
         self.model_config = model_config
         self.mesh = mesh
@@ -186,6 +187,15 @@ class OnlineImprovementLoop:
         # publish_adapter path instead of the rolling base publish —
         # one tenant's training loop never pauses the others' decodes.
         self.tenant_id = tenant_id
+        # Streaming async mode: when set, every round ALSO streams its
+        # collected episodes — stamped with the (epoch, version) that
+        # sampled them — into an experience sink (an
+        # ExperienceClient.submit or ExperienceQueue.offer_many duck),
+        # making this loop a collector for a streaming learner
+        # (serve/learner.py StreamingLearnerService) instead of the
+        # only trainer. Offers are fire-and-forget per round; the
+        # sink's idempotent episode ids make resubmits safe.
+        self.experience_sink = experience_sink
         self._round = 0
         # Last weight version a versioned engine (ServingFleet) acked
         # for this loop's params; persisted so resume() can republish AT
@@ -252,6 +262,33 @@ class OnlineImprovementLoop:
         with get_tracer().span("online.round", round=self._round):
             return self._run_round_impl()
 
+    def _stream_episodes(self, out) -> None:
+        """Async-mode side channel: offer the round's episodes to the
+        experience sink, stamped with the behavior version that sampled
+        them. Sink failures never fail the round — the deterministic
+        episode ids make the next round's resubmit a safe dedup."""
+        from .experience import trajectories_to_episodes
+        episodes = trajectories_to_episodes(
+            out.trajectories, epoch=0,
+            version=self._published_version or 0,
+            source=f"online-{_PROC_TAG}-{self._loop_id}",
+            round_idx=self._round)
+        sink = self.experience_sink
+        try:
+            submit = getattr(sink, "submit", None)
+            if submit is not None:             # ExperienceClient duck
+                submit(episodes)
+            else:                              # ExperienceQueue duck
+                sink.offer_many(
+                    episodes,
+                    current_version=self._published_version or 0)
+        except Exception:
+            get_registry().counter(
+                "senweaver_online_stream_offer_failures_total",
+                "Rounds whose episode stream offer failed (episodes "
+                "stay local; deterministic ids make the resubmit a "
+                "dedup).").inc()
+
     def _run_round_impl(self) -> OnlineRoundResult:
         rules = self.current_rules()
 
@@ -283,8 +320,11 @@ class OnlineImprovementLoop:
             ref_params=self._anchor, resilience=self.resilience,
             update_guard=self._update_guard,
             health_mitigator=self._health_mitigator,
-            round_idx=self._round)
+            round_idx=self._round,
+            behavior_stamp=(0, self._published_version or 0))
         self.state = out.state
+        if self.experience_sink is not None and out.trajectories:
+            self._stream_episodes(out)
         # Group-size mitigation tick: resize for the NEXT round while
         # its trigger streak is active; changes become round events.
         health_events = list(out.health_events)

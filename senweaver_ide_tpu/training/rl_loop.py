@@ -41,6 +41,13 @@ class EpisodeRecord:
     reward: float
     n_calls: int
     steps: int
+    # (epoch, version) of the weights that SAMPLED this episode — the
+    # behavior-policy stamp the streaming experience pipeline keys its
+    # staleness bound and importance correction on. Lockstep rounds
+    # stamp the round's published pair; 0/0 means "unstamped"
+    # (in-process session with no versioned publisher).
+    behavior_epoch: int = 0
+    behavior_version: int = 0
 
 
 @dataclasses.dataclass
@@ -359,6 +366,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                update_guard=None,
                health_mitigator=None,
                round_idx: int = 0,
+               behavior_stamp: Optional[Tuple[int, int]] = None,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -406,7 +414,7 @@ def grpo_round(state: TrainState, model_config, mesh,
             perf_monitor=perf_monitor, engine=engine, lora_base=lora_base,
             ref_params=ref_params, resilience=resilience,
             update_guard=update_guard, health_mitigator=health_mitigator,
-            round_idx=round_idx)
+            round_idx=round_idx, behavior_stamp=behavior_stamp)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
@@ -416,7 +424,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      perf_monitor=None, engine=None,
                      lora_base=None, ref_params=None, resilience=None,
                      update_guard=None, health_mitigator=None,
-                     round_idx=0) -> RoundResult:
+                     round_idx=0, behavior_stamp=None) -> RoundResult:
     import time as _time
     tracer = get_tracer()
     t0 = _time.monotonic()
@@ -426,6 +434,14 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             reward_override=reward_override, max_parallel=max_parallel,
             resilience=resilience, round_idx=round_idx)
     trajectories, episodes = collected.trajectories, collected.episodes
+    if behavior_stamp is not None:
+        # Lockstep sampling: every episode in the round was collected
+        # under ONE (epoch, version) pair — the publisher never swaps
+        # weights mid-round — so the caller's stamp applies uniformly.
+        b_epoch, b_version = int(behavior_stamp[0]), int(behavior_stamp[1])
+        for ep in episodes:
+            ep.behavior_epoch = b_epoch
+            ep.behavior_version = b_version
     failures = collected.failures
     dropped_groups = collected.dropped_groups
     collect_s = _time.monotonic() - t0

@@ -1,0 +1,567 @@
+"""Continuous-flow fleet GRPO: streaming experience pipeline tests.
+
+Covers ISSUE 15's acceptance invariants, hermetic on CPU (loopback
+transports, fake clock, tiny test model):
+
+- a streamed batch is TOKEN-EXACT against the lockstep reference: the
+  old_logp assembled from per-episode recorded behavior logps equals
+  the behavior forward pass at every masked position;
+- partial groups wait; the staleness bound drops (and counts) episodes
+  the importance correction can't fix;
+- a learner killed mid-stream and restarted loses no episode and
+  double-trains none (durable seen-ids + collector at-least-once
+  resubmit + queue dedup);
+- a ``drop_response`` on the episode submit replays server-side via
+  the idempotency cache — acked, never re-offered;
+- the ``staleness_drift`` health detector vetoes async back to
+  lockstep through mitigation hysteresis, and releases it after quiet
+  rounds;
+- eager publishes roll with NO replica ever entering DRAINING —
+  collection capacity never dips;
+- the lease authority promoted behind its own rpc endpoint serves two
+  fleets, fences the superseded learner across both, and (PR 7
+  regression, new topology) never replays a lease grant to a
+  restarted client with colliding request ids;
+- rack-aware prefix fanout: one eager install per host group, late
+  same-host replicas backfill from the nearest resident copy.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.resilience import (LeaseLost, NetworkFault,
+                                          NetworkFaultPlan, RetryPolicy)
+from senweaver_ide_tpu.resilience.guard import (HealthMitigator,
+                                                MITIGATION_LOCKSTEP_FALLBACK)
+from senweaver_ide_tpu.rollout import RolloutEngine
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import (DRAINING, EpisodeStreamer,
+                                     ExperienceClient, ExperienceRpcHandler,
+                                     FleetPublishClient, FleetRpcHandler,
+                                     LearnerConfig, LeaseRpcHandler,
+                                     LoopbackTransport, RemoteLeaseStore,
+                                     ServingFleet, StalePublishError,
+                                     StreamingLearnerConfig,
+                                     StreamingLearnerService)
+from senweaver_ide_tpu.obs.training_health import TrainingHealthConfig
+from senweaver_ide_tpu.training.experience import (ExperienceQueue,
+                                                   StreamedEpisode,
+                                                   assemble_batch)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+FAST = RetryPolicy(max_retries=2, base_delay_s=0.0, jitter=False)
+PREFIX = [5, 9, 2, 7, 4, 4, 8]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+def make_engine(model, num_slots=2, max_len=64):
+    params, config = model
+    return RolloutEngine(params, config, num_slots=num_slots,
+                         max_len=max_len, sample=GREEDY)
+
+
+def registry_total(name):
+    m = obs.get_registry().get(name)
+    if m is None:
+        return 0.0
+    return sum(float(v) for v in m.samples().values())
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class FakeStreamTrainer:
+    """The StreamingLearnerService trainer contract, instrumented: it
+    records every episode id it trained on (the exactly-once oracle)
+    and visibly changes params per batch."""
+
+    class _State:
+        def __init__(self, params):
+            self.params = params
+
+    def __init__(self, params):
+        self.state = self._State(params)
+        self.trained_ids = []
+        self.batches = 0
+        self.published = []
+
+    def train_on_batch(self, episodes):
+        self.batches += 1
+        self.trained_ids.extend(ep.episode_id for ep in episodes)
+        self.state.params = jax.tree_util.tree_map(
+            lambda x: x + 0.001, self.state.params)
+        return {"loss": 0.1}
+
+    def note_published(self, version):
+        self.published.append(version)
+
+
+def make_stream_stack(model, n_replicas=2, *, clock, plan=None,
+                      exp_plan=None, stream_config=None, state_path=None,
+                      holder="learner-0", health_config=None,
+                      mitigator=None):
+    """Fleet + gateway + streaming learner + experience endpoint +
+    collector-side streamer, all over loopback."""
+    params, _ = model
+    fleet = ServingFleet([make_engine(model) for _ in range(n_replicas)],
+                         clock=clock, probe_interval_s=0.0,
+                         retry_base_delay_s=0.0)
+    handler = FleetRpcHandler(fleet, lease_ttl_s=30.0, clock=clock)
+    transport = LoopbackTransport(handler, target="fleet-gw",
+                                  fault_plan=plan)
+    client = FleetPublishClient(transport, name=holder, policy=FAST,
+                                clock=clock, sleep=lambda s: None)
+    trainer = FakeStreamTrainer(params)
+    svc = StreamingLearnerService(
+        trainer, client,
+        stream_config=stream_config or StreamingLearnerConfig(
+            group_size=2, min_groups=1),
+        config=LearnerConfig(holder=holder, state_path=state_path),
+        health_config=health_config, mitigator=mitigator,
+        clock=clock, sleep=lambda s: None)
+    exp_handler = ExperienceRpcHandler(svc)
+    exp_transport = LoopbackTransport(exp_handler, target="learner-exp",
+                                      fault_plan=exp_plan)
+    exp_client = ExperienceClient(exp_transport, name="collector-0",
+                                  policy=FAST, clock=clock,
+                                  sleep=lambda s: None)
+    streamer = EpisodeStreamer(exp_client)
+    return fleet, handler, svc, trainer, streamer
+
+
+def eps(n, *, version, epoch=1, start=0, source="c0", group_size=2):
+    return [StreamedEpisode(
+        episode_id=f"{source}/r0/i{start + i}",
+        group_key=f"{source}/r0/g{(start + i) // group_size}",
+        prompt_ids=[1, 2, 3], completion_ids=[4, 5],
+        reward=float(i), epoch=epoch, version=version,
+        behavior_logp=[-0.5, -0.25])
+        for i in range(n)]
+
+
+def pump_to_convergence(svc, limit=32):
+    for _ in range(limit):
+        if svc.pump_publish():
+            return True
+    return False
+
+
+# ---- token-exact importance ratios ---------------------------------------
+
+def test_streamed_old_logp_token_exact_vs_lockstep(model):
+    """old_logp assembled from recorded per-episode behavior logps ==
+    the lockstep behavior forward pass, bitwise, at every masked
+    position — the ISSUE's token-exact importance-ratio claim."""
+    params, config = model
+    from senweaver_ide_tpu.training.async_loop import behavior_logp_batched
+    from senweaver_ide_tpu.training.data import Trajectory, make_batch
+
+    trajectories = [
+        Trajectory(prompt_ids=[1, 2, 3], completion_ids=[4, 5, 6],
+                   reward=1.0, group_id=0),
+        Trajectory(prompt_ids=[1, 2, 3], completion_ids=[7, 8],
+                   reward=0.0, group_id=0),
+        Trajectory(prompt_ids=[9, 8], completion_ids=[1, 2, 3, 4],
+                   reward=0.5, group_id=1),
+        Trajectory(prompt_ids=[9, 8], completion_ids=[5],
+                   reward=0.25, group_id=1),
+    ]
+    tokens, mask, _, _ = make_batch(trajectories, pad_id=0)
+    full = np.asarray(behavior_logp_batched(params, config, tokens, 1))
+
+    # Record what the engine would have captured at sample time: the
+    # behavior logp of each completion token (target index j-1).
+    episodes = []
+    for i, t in enumerate(trajectories):
+        pos = np.nonzero(mask[i])[0]
+        rec = [float(full[i, j - 1]) for j in pos]
+        episodes.append(StreamedEpisode(
+            episode_id=f"x/i{i}", group_key=f"x/g{t.group_id}",
+            prompt_ids=t.prompt_ids, completion_ids=t.completion_ids,
+            reward=t.reward, epoch=1, version=0, behavior_logp=rec))
+
+    _, s_tokens, s_mask, _, s_gids, s_old = assemble_batch(
+        episodes, pad_id=0)
+    assert s_old is not None
+    np.testing.assert_array_equal(s_tokens, tokens)
+    np.testing.assert_array_equal(s_mask, mask)
+    # group ids assigned by first appearance — identical to lockstep
+    np.testing.assert_array_equal(s_gids, [0, 0, 1, 1])
+    shifted = mask[:, 1:]
+    np.testing.assert_array_equal(s_old[shifted], full[shifted])
+    # positions outside the mask are never read; assembled holds 0.0
+    assert np.all(s_old[~shifted] == 0.0)
+
+
+def test_partial_groups_wait_then_release():
+    """A partial group never releases; completing it does — and the
+    released batch preserves arrival order (determinism that makes the
+    streamed batch equal the lockstep reference)."""
+    q = ExperienceQueue(group_size=4)
+    acks = q.offer_many(eps(3, version=0, group_size=4),
+                        current_version=0)["acks"]
+    assert set(acks.values()) == {"accepted"}
+    assert q.take_batch(current_version=0) is None
+    assert q.ready_groups() == 0
+    q.offer_many(eps(1, version=0, start=3, group_size=4),
+                 current_version=0)
+    batch = q.take_batch(current_version=0)
+    assert [ep.episode_id for ep in batch] == [
+        f"c0/r0/i{i}" for i in range(4)]
+    assert q.stats()["depth"] == 0
+
+
+def test_staleness_bound_drops_and_counts():
+    """Episodes older than max_staleness versions are dropped at take
+    time, counted, and never trained."""
+    q = ExperienceQueue(group_size=2, max_staleness=2)
+    q.offer_many(eps(2, version=0), current_version=0)
+    q.offer_many(eps(2, version=5, start=2), current_version=5)
+    batch = q.take_batch(current_version=5)
+    assert [ep.version for ep in batch] == [5, 5]
+    assert q.stats()["stale_dropped"] == 2
+    assert registry_total("senweaver_learner_stale_episodes_total") == 2
+    # an offer already past the bound is refused at the door
+    acks = q.offer_many(eps(2, version=1, start=4),
+                        current_version=9)["acks"]
+    assert set(acks.values()) == {"stale"}
+
+
+# ---- streaming service end to end ----------------------------------------
+
+def test_streaming_learner_end_to_end_no_drain(model):
+    """Stream → train → eager publish: versions advance on the fleet
+    with NO replica ever entering DRAINING (collection capacity never
+    dips), and the idle fraction accounts empty polls."""
+    clock = FakeClock()
+    fleet, handler, svc, trainer, streamer = make_stream_stack(model, clock=clock)
+    assert svc.start() == 1
+
+    streamer.offer(eps(4, version=svc.version))
+    assert streamer.flush() == {"retired": 4, "pending": 0}
+    clock.advance(1.0)
+    r = svc.run_step()
+    assert r["mode"] == "streaming" and r["version"] == 1
+    assert r["staleness_mean"] == 0.0
+
+    # run_step returned with the publish still outstanding (staged,
+    # not converged) — that is the no-drain overlap.
+    assert svc._outstanding_publish == 1
+    for _ in range(32):
+        assert all(rep.state != DRAINING for rep in fleet.replicas)
+        if svc.pump_publish():
+            break
+    assert svc._outstanding_publish is None
+    assert fleet.publisher.version == 1
+    assert not fleet.publisher.in_progress
+    assert trainer.published == [0, 1]
+
+    # empty poll → no train; waiting time lands in the idle fraction
+    assert svc.run_step() is None
+    svc.note_idle(1.0)
+    assert svc.idle_fraction() > 0.0
+    assert registry_total("senweaver_learner_stream_steps_total") == 1
+
+
+def test_streamed_episodes_survive_learner_crash(model, tmp_path):
+    """Kill the learner mid-stream, restart against the same fleet:
+    the collector's at-least-once resubmit plus the restored seen-ids
+    yields zero lost episodes and zero double-trains."""
+    clock = FakeClock()
+    state_path = str(tmp_path / "learner.json")
+    fleet, handler, svc, trainer, streamer = make_stream_stack(
+        model, clock=clock, state_path=state_path)
+    svc.start()
+
+    first = eps(4, version=svc.version)
+    streamer.offer(first)
+    streamer.flush()
+    assert svc.run_step()["episodes"] == 4
+    pump_to_convergence(svc)
+    assert sorted(trainer.trained_ids) == sorted(
+        ep.episode_id for ep in first)
+
+    # Crash: the process dies with acks recorded but the collector
+    # never hearing them — it MUST resubmit on reconnect.
+    del svc
+
+    # The fleet gateway (and its lease store) SURVIVES the learner
+    # crash — only the learner process restarts, against the same
+    # handler.
+    params, _ = model
+    client2 = FleetPublishClient(
+        LoopbackTransport(handler, target="fleet-gw"), name="learner-0b",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+    trainer2 = FakeStreamTrainer(params)
+    svc2 = StreamingLearnerService(
+        trainer2, client2,
+        stream_config=StreamingLearnerConfig(group_size=2, min_groups=1),
+        config=LearnerConfig(holder="learner-0",
+                             state_path=state_path),
+        clock=clock, sleep=lambda s: None)
+    assert svc2.start() == 2            # strictly higher lease epoch
+    assert svc2.version == 1            # durable version survived
+
+    exp_client2 = ExperienceClient(
+        LoopbackTransport(ExperienceRpcHandler(svc2),
+                          target="learner-exp"),
+        name="collector-0", policy=FAST, clock=clock,
+        sleep=lambda s: None)
+    streamer2 = EpisodeStreamer(exp_client2)
+    second = eps(4, version=svc2.version, start=4)
+    streamer2.offer(first)              # at-least-once replay
+    streamer2.offer(second)
+    out = streamer2.flush()
+    assert out == {"retired": 8, "pending": 0}
+    # the replayed four were deduped by the RESTORED seen-set
+    assert registry_total(
+        "senweaver_learner_duplicate_episodes_total") == 4
+
+    r = svc2.run_step()
+    assert r["episodes"] == 4
+    assert sorted(trainer2.trained_ids) == sorted(
+        ep.episode_id for ep in second)
+    # across both incarnations: every episode exactly once
+    all_trained = trainer.trained_ids + trainer2.trained_ids
+    assert len(all_trained) == len(set(all_trained)) == 8
+
+
+def test_submit_drop_response_replays_not_reoffers(model):
+    """The dangerous chaos: the learner EXECUTES the submit but the
+    ack frame is lost. The client's retry replays server-side via the
+    idempotency cache — episodes are acked, trained once, and the
+    queue's duplicate counter never moves (proving the replay came
+    from the cache, not from a re-offer hitting the seen-set)."""
+    clock = FakeClock()
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop_response", method="submit_episodes",
+                     times=1)])
+    fleet, handler, svc, trainer, streamer = make_stream_stack(
+        model, clock=clock, exp_plan=plan)
+    svc.start()
+    streamer.offer(eps(4, version=svc.version))
+    assert streamer.flush() == {"retired": 4, "pending": 0}
+    assert len(plan.injected) == 1
+    assert registry_total(
+        "senweaver_learner_duplicate_episodes_total") == 0
+    assert svc.run_step()["episodes"] == 4
+    assert len(set(trainer.trained_ids)) == 4
+
+
+def test_transport_down_keeps_episodes_pending(model):
+    """Total submit failure (every retry dropped): flush never raises,
+    everything stays pending, the stall gauge moves, and the next
+    healthy flush delivers."""
+    clock = FakeClock()
+    plan = NetworkFaultPlan([
+        NetworkFault(kind="drop", method="submit_episodes", times=8)])
+    fleet, handler, svc, trainer, streamer = make_stream_stack(
+        model, clock=clock, exp_plan=plan)
+    svc.start()
+    streamer.offer(eps(2, version=svc.version))
+    assert streamer.flush() == {"retired": 0, "pending": 2}
+    assert streamer.pending == 2
+    assert obs.get_registry().get(
+        "senweaver_collector_stall_fraction").value() == 1.0
+    plan.faults.clear()
+    assert streamer.flush() == {"retired": 2, "pending": 0}
+
+
+def test_staleness_veto_flips_to_lockstep_and_back(model):
+    """staleness_drift fires → the mitigator flips the learner to the
+    lockstep fallback (blocking publishes); quiet rounds release it."""
+    clock = FakeClock()
+    mitigator = HealthMitigator(
+        enabled=True, allow={MITIGATION_LOCKSTEP_FALLBACK: True},
+        trigger_rounds=1)
+    fleet, handler, svc, trainer, streamer = make_stream_stack(
+        model, clock=clock,
+        health_config=TrainingHealthConfig(staleness_mean_max=1.0),
+        mitigator=mitigator,
+        stream_config=StreamingLearnerConfig(group_size=2, min_groups=1,
+                                             max_staleness=100))
+    svc.start()
+
+    # Warm the version past the staleness threshold so old stamps hurt.
+    streamer.offer(eps(2, version=0))
+    streamer.flush()
+    r = svc.run_step()
+    assert r["mode"] == "streaming" and r["staleness_mean"] == 0.0
+    pump_to_convergence(svc)
+
+    for i in range(3):                   # drive version to 4
+        streamer.offer(eps(2, version=svc.version, start=2 + 2 * i))
+        streamer.flush()
+        svc.run_step()
+        pump_to_convergence(svc)
+    assert svc.version == 4
+
+    # Stale-stamped episodes: staleness_mean = 4 > 1.0 → trigger.
+    streamer.offer(eps(2, version=0, start=20))
+    streamer.flush()
+    r = svc.run_step()
+    assert r["staleness_mean"] == 4.0
+    assert "mitigation_enabled:lockstep_fallback" in r["events"]
+    assert mitigator.lockstep_fallback_active()
+    pump_to_convergence(svc)
+
+    # Next step runs LOCKSTEP: publish converges inside the step.
+    streamer.offer(eps(2, version=svc.version, start=22))
+    streamer.flush()
+    r = svc.run_step()
+    assert r["mode"] == "lockstep"
+    assert svc._outstanding_publish is None
+    assert fleet.publisher.version == svc.version
+    # ...and the quiet round releases the veto.
+    assert "mitigation_disabled:lockstep_fallback" in r["events"]
+
+    streamer.offer(eps(2, version=svc.version, start=24))
+    streamer.flush()
+    assert svc.run_step()["mode"] == "streaming"
+
+
+# ---- lease authority behind its own endpoint ------------------------------
+
+def make_remote_lease_fleet(model, lease_transport, *, clock, n=2):
+    store = RemoteLeaseStore(lease_transport, policy=FAST, clock=clock,
+                             sleep=lambda s: None)
+    fleet = ServingFleet([make_engine(model) for _ in range(n)],
+                         clock=clock, probe_interval_s=0.0,
+                         retry_base_delay_s=0.0)
+    handler = FleetRpcHandler(fleet, clock=clock, lease_store=store)
+    return fleet, handler
+
+
+def test_two_fleets_share_one_lease_authority(model):
+    """Lease authority promoted out of the fleet process: two fleets
+    point at ONE LeaseRpcHandler; a learner superseded through either
+    fleet is fenced on both."""
+    clock = FakeClock()
+    lease_handler = LeaseRpcHandler(ttl_s=30.0, clock=clock)
+
+    def lease_transport(target):
+        return LoopbackTransport(lease_handler, target=target)
+
+    fleet_a, handler_a = make_remote_lease_fleet(
+        model, lease_transport("lease-gw-a"), clock=clock)
+    fleet_b, handler_b = make_remote_lease_fleet(
+        model, lease_transport("lease-gw-b"), clock=clock)
+
+    client_a = FleetPublishClient(
+        LoopbackTransport(handler_a, target="fleet-a"), name="learner-a",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+    client_b = FleetPublishClient(
+        LoopbackTransport(handler_b, target="fleet-b"), name="learner-b",
+        policy=FAST, clock=clock, sleep=lambda s: None)
+
+    lease_a = client_a.acquire_lease("learner-a")
+    assert lease_a["epoch"] == 1
+    params, _ = model
+    client_a.publish(params, epoch=1, version=1)
+
+    # learner-b steals THROUGH FLEET B; the shared authority bumps the
+    # epoch, so learner-a is fenced on fleet A too.
+    lease_b = client_b.acquire_lease("learner-b", steal=True)
+    assert lease_b["epoch"] == 2
+    with pytest.raises((LeaseLost, StalePublishError)):
+        client_a.publish(params, epoch=1, version=2)
+    with pytest.raises(LeaseLost):
+        client_a.renew_lease("learner-a", 1)
+    client_b.publish(params, epoch=2, version=2)
+
+
+def test_restarted_client_never_replays_lease_grant_remote_authority(model):
+    """PR 7 zombie-grant regression in the new topology: lease RPCs on
+    the standalone authority are NOT idempotency-cached, so a restarted
+    client whose request ids collide with its predecessor's gets a
+    FRESH grant (higher epoch), never the dead incarnation's."""
+    clock = FakeClock()
+    lease_handler = LeaseRpcHandler(ttl_s=30.0, clock=clock)
+    transport = LoopbackTransport(lease_handler, target="lease-gw")
+
+    # Incarnation 1: same name AND the same request id sequence a
+    # restarted default-name client would reuse.
+    c1 = FleetPublishClient(transport, name="learner-z", policy=FAST,
+                            clock=clock, sleep=lambda s: None)
+    g1 = c1.acquire_lease("learner-z")
+    # Incarnation 2 restarts: seq resets to 0 → identical request id.
+    c2 = FleetPublishClient(transport, name="learner-z", policy=FAST,
+                            clock=clock, sleep=lambda s: None)
+    g2 = c2.acquire_lease("learner-z")
+    assert g2["epoch"] == g1["epoch"] + 1      # fresh grant, no replay
+    # the store's authority clock is truth: validate round-trips
+    store = RemoteLeaseStore(transport, policy=FAST, clock=clock,
+                             sleep=lambda s: None)
+    store.validate(g2["epoch"])
+    with pytest.raises(LeaseLost):
+        store.validate(g1["epoch"])
+    assert store.ttl_s == 30.0
+
+
+# ---- rack-aware prefix fanout ---------------------------------------------
+
+def test_rack_aware_fanout_and_nearest_backfill(model):
+    """host-grouped fleet: the donor broadcast installs ONE peer per
+    host group; late same-host replicas backfill from the nearest
+    resident copy (counted), paying zero extra prefills and zero extra
+    cross-host donor-buffer transfers."""
+    fleet = ServingFleet(
+        [make_engine(model) for _ in range(4)],
+        host_groups=["rackA", "rackA", "rackB", "rackB"])
+    store = fleet.prefix_store
+    fleet.register_prefix(PREFIX)
+
+    r = fleet.replicas
+    assert store.ensure(r[0], PREFIX) == "donor"
+    # fanout seeded exactly one install in rackB, none extra in rackA
+    assert r[2].holds_prefix(tuple(PREFIX))
+    assert not r[1].holds_prefix(tuple(PREFIX))
+    assert not r[3].holds_prefix(tuple(PREFIX))
+    assert registry_total("senweaver_serve_prefix_broadcasts_total") == 1
+
+    # late same-host replicas pull from their rack's resident copy
+    assert store.ensure(r[1], PREFIX) == "import"
+    assert store.ensure(r[3], PREFIX) == "import"
+    assert registry_total(
+        "senweaver_serve_prefix_nearest_backfills_total") == 2
+    assert r[1].holds_prefix(tuple(PREFIX))
+    assert r[3].holds_prefix(tuple(PREFIX))
+    # exactly ONE prefill fleet-wide, everything else imported
+    prefills = sum(rep.engine.stats()["prefix_prefills"] for rep in r)
+    imports = sum(rep.engine.stats()["prefix_imports"] for rep in r)
+    assert (prefills, imports) == (1, 3)
+
+    # unlabeled fleets keep the exact broadcast-to-all behavior
+    fleet2 = ServingFleet([make_engine(model) for _ in range(3)])
+    fleet2.register_prefix(PREFIX)
+    assert fleet2.prefix_store.ensure(fleet2.replicas[0],
+                                      PREFIX) == "donor"
+    assert all(rep.holds_prefix(tuple(PREFIX))
+               for rep in fleet2.replicas)
+    assert registry_total(
+        "senweaver_serve_prefix_nearest_backfills_total") == 2  # unchanged
