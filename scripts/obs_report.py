@@ -28,6 +28,15 @@ When the file contains cross-process rpc spans (``rpc.client.*`` /
 section follows the table: how many server spans attached under their
 client parent, traces spanning both sides of the wire, idempotent
 replays, and the worst observed clock skew.
+
+``--fleet`` appends a fleet-federation block from a fleet-obs JSONL:
+lines are either incident records (``IncidentCorrelator.
+export_jsonl``) or snapshot records carrying ``rollups`` (a
+``FleetMetricsStore.summary()``) and/or ``alerts`` (an
+``AlertManager.summary()``) — federated rollups with the worst replica
+named, currently-firing alerts, and the last K incidents as triage
+one-liners. The companion question across processes: "and how was the
+REST of the fleet doing while it ran?".
 """
 
 from __future__ import annotations
@@ -157,11 +166,99 @@ def render_runtime(rows: List[Dict]) -> str:
     return "\n".join(lines)
 
 
+def load_fleet_jsonl(path: str) -> Dict:
+    """Split a fleet-obs JSONL into {incidents, rollups, alerts}.
+
+    Incident lines come from ``IncidentCorrelator.export_jsonl`` (they
+    carry ``alert`` + ``candidates``); snapshot lines carry ``rollups``
+    (``FleetMetricsStore.summary()``) and/or ``alerts``
+    (``AlertManager.summary()``) — the LAST snapshot wins, incidents
+    accumulate. Torn/blank lines are skipped like the span loader."""
+    import json
+
+    incidents: List[Dict] = []
+    rollups: Dict = {}
+    peers: Dict = {}
+    alerts: Dict = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            if "candidates" in rec and "alert" in rec:
+                incidents.append(rec)
+                continue
+            if "rollups" in rec:
+                rollups = rec["rollups"] or {}
+                peers = rec.get("peers") or {}
+            if "alerts" in rec:
+                alerts = rec["alerts"] or {}
+    return {"incidents": incidents, "rollups": rollups,
+            "peers": peers, "alerts": alerts}
+
+
+def render_fleet(fleet: Dict, *, last_k: int = 5) -> str:
+    """Federated rollups + active alerts + last K incidents."""
+    lines = ["fleet federation:"]
+    peers = fleet.get("peers") or {}
+    if peers:
+        stale = sorted(p for p, e in peers.items() if e.get("stale"))
+        lines.append(f"  peers: {len(peers)}"
+                     + (f" ({len(stale)} stale: {', '.join(stale)})"
+                        if stale else " (none stale)"))
+    rollups = fleet.get("rollups") or {}
+    if rollups:
+        headers = ("metric", "sum", "min", "max", "worst replica")
+        table = [headers]
+        for metric, entry in sorted(rollups.items()):
+            worst = (f"{entry['worst_peer']}={entry['worst_value']:.3g}"
+                     if entry.get("worst_peer") is not None else "-")
+            table.append((metric,
+                          *(f"{entry[s]:.4g}" if s in entry else "-"
+                            for s in ("sum", "min", "max")), worst))
+        widths = [max(len(row[i]) for row in table)
+                  for i in range(len(headers))]
+        for i, row in enumerate(table):
+            lines.append("  " + "  ".join(
+                cell.ljust(widths[j]) if j in (0, 4) else
+                cell.rjust(widths[j]) for j, cell in enumerate(row)))
+            if i == 0:
+                lines.append("  " + "  ".join("-" * w for w in widths))
+    else:
+        lines.append("  no rollup snapshot in file")
+    alerts = fleet.get("alerts") or {}
+    firing = sorted(n for n, st in alerts.items() if st.get("firing"))
+    if alerts:
+        lines.append("  active alerts: " + (", ".join(
+            f"{n} (value {alerts[n].get('value', 0.0):.3g})"
+            for n in firing) if firing else "none"))
+    incidents = fleet.get("incidents") or []
+    if incidents:
+        lines.append(f"  incidents: {len(incidents)} total, last "
+                     f"{min(last_k, len(incidents))}:")
+        for rec in incidents[-last_k:]:
+            summary = rec.get("summary") or (
+                f"{rec.get('alert', '?')} fired")
+            lines.append(f"    #{rec.get('incident_id', '?')} {summary}")
+    else:
+        lines.append("  incidents: none recorded")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-stage latency summary of an obs span JSONL.")
-    parser.add_argument("path", help="span JSONL from obs.enable("
-                        "span_jsonl=...) or Tracer.export_jsonl()")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="span JSONL from obs.enable("
+                        "span_jsonl=...) or Tracer.export_jsonl(); "
+                        "optional when only companion sections "
+                        "(--health/--runtime/--fleet) are requested")
     parser.add_argument("--top", type=int, default=0,
                         help="show only the first N stages (0 = all)")
     parser.add_argument("--sort", choices=SORT_KEYS, default="total",
@@ -174,17 +271,34 @@ def main(argv=None) -> int:
                         help="runtime profile JSONL "
                              "(RuntimeProfiler.export_jsonl) to "
                              "summarize after the latency table")
+    parser.add_argument("--fleet", default=None,
+                        help="fleet-obs JSONL (incident records from "
+                             "IncidentCorrelator.export_jsonl and/or "
+                             "store/alert summary snapshots) to "
+                             "summarize after the latency table")
+    parser.add_argument("--incidents", type=int, default=5,
+                        help="incidents to show in the --fleet block "
+                             "(default: 5)")
     args = parser.parse_args(argv)
 
-    if not os.path.exists(args.path):
-        print(f"obs_report: no such file: {args.path}", file=sys.stderr)
+    if args.path is None and not (args.health or args.runtime
+                                  or args.fleet):
+        print("obs_report: need a span JSONL path or at least one of "
+              "--health/--runtime/--fleet", file=sys.stderr)
         return 2
-    spans = load_span_jsonl(args.path)
+    spans = []
+    if args.path is not None:
+        if not os.path.exists(args.path):
+            print(f"obs_report: no such file: {args.path}",
+                  file=sys.stderr)
+            return 2
+        spans = load_span_jsonl(args.path)
     rows = summarize_spans(spans)
     if not rows:
-        # Keep going: the --health/--runtime companion sections are
-        # still meaningful against an empty or torn span file.
-        print("obs_report: no spans found (empty or torn file)")
+        # Keep going: the --health/--runtime/--fleet companion
+        # sections are still meaningful without a span file.
+        if args.path is not None:
+            print("obs_report: no spans found (empty or torn file)")
     else:
         reverse = args.sort != "name"
         rows.sort(key=lambda r: r[args.sort], reverse=reverse)
@@ -218,6 +332,13 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         print("\n" + render_runtime(summarize_runtime(args.runtime)))
+    if args.fleet:
+        if not os.path.exists(args.fleet):
+            print(f"obs_report: no such file: {args.fleet}",
+                  file=sys.stderr)
+            return 2
+        print("\n" + render_fleet(load_fleet_jsonl(args.fleet),
+                                  last_k=args.incidents))
     return 0
 
 

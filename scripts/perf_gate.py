@@ -13,7 +13,11 @@ model, deterministically enough to run per-commit in CI (tier1.yml
 - ``train_step``: one GRPO update via training.trainer.train_step
   (ledger fn ``trainer.grpo_step``),
 - ``reward_head``: the jitted batch reward scorer
-  (ledger fn ``reward.head_batch``).
+  (ledger fn ``reward.head_batch``),
+- ``fleet_scrape``: the fleet observability plane's host-side hot loop
+  (scrape→ingest→rollup→alert-evaluate over loopback rpc). No ledger
+  fn — the case instead proves the WHOLE ledger stays frozen across
+  the timed window: federation must never touch a jitted path.
 
 Warmup/steady separation is PROVEN, not assumed: each case runs a
 warmup pass (compiles land there), then a timed steady pass; the
@@ -49,8 +53,10 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BASELINE_PATH = os.path.join(REPO_ROOT, "PERF_BASELINE.json")
 DEFAULT_BAND = 2.0
 # The reward head runs in ~100us on CPU — relative noise at that scale
-# dwarfs the other cases, so its band is wider by construction.
-CASE_BANDS = {"reward_head": 3.0}
+# dwarfs the other cases, so its band is wider by construction. The
+# fleet scrape sweep is pure host Python at sub-ms scale with the same
+# jitter profile.
+CASE_BANDS = {"reward_head": 3.0, "fleet_scrape": 3.0}
 STEADY_ITERS = 5
 
 
@@ -340,6 +346,87 @@ def _case_multi_lora() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _ledger_compiles_all() -> int:
+    from senweaver_ide_tpu.obs.runtime_profile import get_profiler
+    return sum(int(s["compiles"])
+               for s in get_profiler().ledger().values())
+
+
+def _case_fleet_scrape() -> Dict[str, Any]:
+    """The fleet observability plane's host hot loop (ISSUE 16): three
+    peers' registries keep moving, the federator delta-scrapes them
+    over loopback rpc, the store ingests and rolls up, and the alert
+    manager sweeps the stock rule set. Pure host Python by contract,
+    so there is no per-fn ledger name to bracket — instead the case
+    proves the ENTIRE profiler ledger stays frozen across the timed
+    window (federation must never touch a jitted path) and tracks the
+    per-sweep wall time."""
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.obs import MetricsScrapeMixin
+    from senweaver_ide_tpu.serve.remote_server import RpcHandlerBase
+    from senweaver_ide_tpu.serve.rpc import LoopbackTransport
+
+    class _ObsScrapeHandler(MetricsScrapeMixin, RpcHandlerBase):
+        mutating_methods = frozenset({"scrape"})
+        span_service = "obs"
+
+    clock = {"t": 0.0}
+
+    def now() -> float:
+        return clock["t"]
+
+    journal = obs.EventJournal(clock=now)
+    store = obs.FleetMetricsStore(clock=now)
+    peers = {}
+    instruments = []
+    for i in range(3):
+        reg = obs.MetricsRegistry()
+        peer_journal = obs.EventJournal(clock=now, registry=reg)
+        h = _ObsScrapeHandler()
+        h.scrape_peer = f"peer-{i}"
+        h.scrape_registry = reg
+        h.scrape_journal = peer_journal
+        h.scrape_clock = now
+        peers[f"peer-{i}"] = LoopbackTransport(h, target=f"peer-{i}")
+        instruments.append((
+            reg.gauge("senweaver_kv_pressure", ""),
+            reg.counter("senweaver_serve_slo_requests_total", "",
+                        labelnames=("priority",)),
+            reg.counter("senweaver_serve_slo_violations_total", "",
+                        labelnames=("priority",)),
+            reg.histogram("senweaver_learner_episode_staleness", "",
+                          buckets=(1.0, 2.0, 4.0, 8.0))))
+    fed = obs.MetricsFederator(store, peers, clock=now,
+                               journal=journal, interval_s=0.0)
+    mgr = obs.AlertManager(store, obs.default_alert_rules(),
+                           clock=now, journal=journal)
+    ticks = {"n": 0}
+
+    def run():
+        n = ticks["n"] = ticks["n"] + 1
+        clock["t"] += 1.0
+        for j, (kv, reqs, viols, staleness) in enumerate(instruments):
+            kv.set(0.3 + 0.05 * ((n + j) % 5))
+            reqs.inc(4, priority="interactive")
+            if (n + j) % 7 == 0:
+                viols.inc(priority="interactive")
+            staleness.observe(float((n + j) % 4))
+        fed.scrape_once(now())
+        mgr.evaluate(now())
+
+    base = _ledger_compiles_all()
+    run()                                   # warmup: full resync scrape
+    c0 = _ledger_compiles_all()
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    step_s = (time.perf_counter() - t0) / iters
+    leaked = _ledger_compiles_all() - c0
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles_all() - base}
+
+
 def _case_streaming_grpo() -> Dict[str, Any]:
     """The streaming learner's hot loop (ISSUE 15): bounded-queue
     intake with dedup and the staleness filter, batch assembly from
@@ -397,6 +484,7 @@ CASES = {
     "train_step": _case_train_step,
     "streaming_grpo": _case_streaming_grpo,
     "reward_head": _case_reward_head,
+    "fleet_scrape": _case_fleet_scrape,
 }
 
 

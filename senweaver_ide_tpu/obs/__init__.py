@@ -30,6 +30,11 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from .alerts import AlertManager, AlertRule, default_alert_rules
+from .federation import (FleetMetricsStore, MetricsFederator,
+                         MetricsScrapeMixin)
+from .incidents import (EventJournal, Incident, IncidentCorrelator,
+                        emit_event, get_event_journal, set_event_journal)
 from .metrics import (Counter, DEFAULT_MS_BUCKETS, Gauge, Histogram,
                       MetricsRegistry)
 from .propagation import (TraceContext, clock_skew_s, extract,
@@ -54,6 +59,10 @@ __all__ = [
     "inject", "extract", "clock_skew_s", "server_span",
     "RequestTimeline", "TimelineRecorder",
     "SLOConfig", "SLOTarget", "SLOTracker",
+    "FleetMetricsStore", "MetricsFederator", "MetricsScrapeMixin",
+    "AlertManager", "AlertRule", "default_alert_rules",
+    "EventJournal", "Incident", "IncidentCorrelator",
+    "emit_event", "get_event_journal", "set_event_journal",
     "StepTelemetry", "advantage_stats", "estimate_mfu",
     "ProfiledFunction", "RuntimeProfiler", "get_profiler",
     "profiled_device_get", "sample_memory", "set_profiler",
@@ -129,4 +138,5 @@ def _reset_for_tests() -> None:
         _registry = MetricsRegistry()
     set_health_monitor(None)   # next get_health_monitor() rebuilds
     set_profiler(None)         # next get_profiler() rebuilds
+    set_event_journal(None)    # next get_event_journal() rebuilds
     old.close()

@@ -251,3 +251,42 @@ class MetricsRegistry:
             out[name] = {"kind": m.kind, "labels": list(m.labelnames),
                          "values": cells}
         return out
+
+    def snapshot_delta(self, since: Optional[Dict[str, Any]] = None
+                       ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """``(delta, snapshot)`` against a prior :meth:`snapshot`.
+
+        Federation scrapes ship the delta and keep the snapshot as the
+        next cursor — both come from ONE snapshot pass, so the pair is
+        race-free under concurrent ``inc``. The delta carries only
+        changed cells: counter and histogram cells as INCREMENTS
+        (histograms ``{"sum": Δ, "count": Δ}``), gauge cells as absolute
+        values, metrics unseen in ``since`` whole. ``since=None``
+        degenerates to ``(snapshot, snapshot)`` — a full resync."""
+        snap = self.snapshot()
+        if since is None:
+            return snap, snap
+        delta: Dict[str, Any] = {}
+        for name, m in snap.items():
+            old = since.get(name)
+            if old is None:
+                delta[name] = m
+                continue
+            old_values = old.get("values", {})
+            changed: Dict[str, Any] = {}
+            for cell, v in m["values"].items():
+                ov = old_values.get(cell)
+                if v == ov:
+                    continue
+                if m["kind"] == "counter":
+                    changed[cell] = float(v) - float(ov or 0.0)
+                elif m["kind"] == "histogram":
+                    ov = ov or {"sum": 0.0, "count": 0}
+                    changed[cell] = {"sum": v["sum"] - ov["sum"],
+                                     "count": v["count"] - ov["count"]}
+                else:
+                    changed[cell] = v
+            if changed:
+                delta[name] = {"kind": m["kind"], "labels": m["labels"],
+                               "values": changed}
+        return delta, snap

@@ -74,8 +74,12 @@ class SLOTracker:
     """Folds finished request timelines into SLO metrics + exemplars."""
 
     def __init__(self, config: Optional[SLOConfig] = None, *,
-                 registry=None):
+                 registry=None, peer_id: Optional[str] = None):
         self.config = config or SLOConfig()
+        # Stamped into every exemplar record so federated incident
+        # stitching can attribute an exported timeline to the replica
+        # process whose tracker kept it.
+        self.peer_id = peer_id
         if registry is None:
             from . import get_registry
             registry = get_registry()
@@ -154,8 +158,11 @@ class SLOTracker:
             return
         badness = (1 if timeline.violations else 0,
                    float(timeline.derived.get("e2e_s", 0.0)))
+        record = timeline.to_dict()
+        if self.peer_id is not None and not record.get("peer_id"):
+            record["peer_id"] = self.peer_id
         heapq.heappush(self._exemplars,
-                       (badness, next(self._seq), timeline.to_dict()))
+                       (badness, next(self._seq), record))
         while len(self._exemplars) > k:
             heapq.heappop(self._exemplars)
 

@@ -52,6 +52,7 @@ class RequestTimeline:
     tokens: int = 0
     attempts: int = 0
     replica_id: Optional[str] = None
+    peer_id: Optional[str] = None            # recording process's identity
     derived: Dict[str, float] = dataclasses.field(default_factory=dict)
     violations: List[str] = dataclasses.field(default_factory=list)
 
@@ -114,9 +115,14 @@ class TimelineRecorder:
     raise into the fleet's dispatch path)."""
 
     def __init__(self, *, clock=time.monotonic, slo=None, registry=None,
-                 max_live: int = 4096, max_windows: int = 256):
+                 max_live: int = 4096, max_windows: int = 256,
+                 peer_id: Optional[str] = None):
         self.clock = clock
         self.slo = slo
+        # Stamped into every timeline so federated incident stitching
+        # can attribute exemplars to the process that recorded them
+        # (replica_id is where the request RAN; peer_id is who SAW it).
+        self.peer_id = peer_id
         self._live: Dict[int, RequestTimeline] = {}  # guarded-by: _lock
         self._windows: Deque[Tuple[float, float]] = \
             deque(maxlen=max_windows)                # guarded-by: _lock
@@ -151,7 +157,8 @@ class TimelineRecorder:
                 del self._live[evicted]
                 self._evicted_total.inc()
             self._live[ticket] = RequestTimeline(ticket=ticket,
-                                                 priority=priority)
+                                                 priority=priority,
+                                                 peer_id=self.peer_id)
             self._live_gauge.set(len(self._live))
         self.mark(ticket, "admitted", t)
 

@@ -34,6 +34,8 @@ from __future__ import annotations
 import collections
 import math
 import threading
+
+from ..obs.incidents import emit_event
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ..obs.training_health import (TRIGGER_CREDIT_COLLAPSE,
@@ -248,17 +250,23 @@ class HealthMitigator:
                         self.active[mit] = True
                         self._transitions.inc(mitigation=mit,
                                               action="enabled")
+                        emit_event("health_mitigation", action="enabled",
+                                   mitigation=mit)
                         events.append(f"mitigation_enabled:{mit}")
                     elif not self._vetoed_this_streak[mit]:
                         self._vetoed_this_streak[mit] = True
                         self._transitions.inc(mitigation=mit,
                                               action="vetoed")
+                        emit_event("health_mitigation", action="vetoed",
+                                   mitigation=mit)
                         events.append(f"mitigation_vetoed:{mit}")
                 elif (self.active[mit]
                         and self._streak_off[mit] >= self.trigger_rounds):
                     self.active[mit] = False
                     self._transitions.inc(mitigation=mit,
                                           action="disabled")
+                    emit_event("health_mitigation", action="disabled",
+                               mitigation=mit)
                     events.append(f"mitigation_disabled:{mit}")
             loo = self.active[MITIGATION_LEAVE_ONE_OUT]
             tok = self.active[MITIGATION_TOKEN_LEVEL]

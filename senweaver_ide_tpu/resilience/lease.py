@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from ..obs.incidents import emit_event
 from typing import Optional
 
 
@@ -98,6 +100,10 @@ class LeaseStore:
             self._current = lease
             self._acquires_total.inc()
             self._epoch_gauge.set(self._epoch)
+            stolen = bool(cur is not None and cur.expires_at > now
+                          and cur.holder != holder)
+            emit_event("lease_acquired", holder=holder,
+                       epoch=self._epoch, t=now, steal=stolen)
             return lease
 
     def renew(self, holder: str, epoch: int, *, now: float) -> Lease:

@@ -30,6 +30,8 @@ import dataclasses
 import threading
 from typing import Optional, Sequence, Tuple
 
+from ..obs.incidents import emit_event
+
 
 @dataclasses.dataclass(frozen=True)
 class SpecControllerConfig:
@@ -146,11 +148,14 @@ class SpecController:
             elif rung == self._pending:
                 self._streak += 1
                 if self._streak >= self.config.hysteresis_steps:
+                    old = self._depth
                     self._depth = rung
                     self._streak = 0
                     self._changes += 1
                     self._change_total.inc()
                     self._depth_gauge.set(self._depth)
+                    emit_event("spec_depth_change", depth=rung,
+                               from_depth=old, load=load)
             else:
                 self._pending, self._streak = rung, 1
             return self._depth
@@ -180,6 +185,8 @@ class SpecController:
             if depth != self._depth:
                 self._changes += 1
                 self._change_total.inc()
+                emit_event("spec_depth_change", depth=depth,
+                           from_depth=self._depth, forced=True)
             self._depth = self._pending = depth
             self._streak = 0
             self._depth_gauge.set(depth)

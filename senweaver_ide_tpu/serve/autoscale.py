@@ -35,6 +35,7 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Tuple
 
+from ..obs.incidents import emit_event
 from .replica import DEAD, LIVE
 
 ACTION_ADD = "add"
@@ -68,10 +69,15 @@ class AutoscaleController:
 
     def __init__(self, fleet, spawn_engine, *,
                  config: AutoscaleConfig = AutoscaleConfig(),
-                 registry=None):
+                 registry=None, fleet_store=None):
         self.fleet = fleet
         self.spawn_engine = spawn_engine
         self.config = config
+        # Optional FleetMetricsStore: when the fleet is federated the
+        # controller reads the FLEET-WIDE rollups (sum of sheds, max KV
+        # pressure across peers) instead of this process's local view —
+        # capacity decisions see remote replicas' pressure too.
+        self.fleet_store = fleet_store
         # All mutable state below is guarded-by: fleet._lock — evaluate()
         # only ever runs inside the fleet's pump, which holds it.
         self._last_eval_at: Optional[float] = None   # guarded-by: fleet._lock
@@ -99,6 +105,11 @@ class AutoscaleController:
 
     # -- signal plumbing -----------------------------------------------------
     def _shed_total(self) -> float:
+        if self.fleet_store is not None:
+            v = self.fleet_store.rollup_value(
+                "senweaver_serve_shed_total", "sum")
+            if v is not None:
+                return float(v)
         m = self._registry.get("senweaver_serve_shed_total")
         if m is None:
             return 0.0
@@ -108,6 +119,11 @@ class AutoscaleController:
         return [r for r in self.fleet.replicas if r.state != DEAD]
 
     def _kv_pressure(self) -> float:
+        if self.fleet_store is not None:
+            v = self.fleet_store.rollup_value(
+                "senweaver_kv_pressure", "max")
+            if v is not None:
+                return float(v)
         m = self._registry.get("senweaver_kv_pressure")
         if m is None:
             return 0.0
@@ -220,3 +236,4 @@ class AutoscaleController:
         self._idle_since = None
         self.actions.append((now, action))
         self._actions_total.inc(action=action)
+        emit_event("autoscale_action", t=now, action=action)

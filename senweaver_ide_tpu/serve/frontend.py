@@ -87,7 +87,8 @@ class ServingFleet:
                  shared_prefix_broadcast: bool = True,
                  probe_interval_s: float = 1.0,
                  host_groups: Optional[Sequence[Optional[str]]] = None,
-                 slo: Optional[SLOConfig] = None):
+                 slo: Optional[SLOConfig] = None,
+                 peer_id: Optional[str] = None):
         if not engines:
             raise ValueError("a fleet needs at least one engine")
         if host_groups is not None and len(host_groups) != len(engines):
@@ -180,9 +181,20 @@ class ServingFleet:
         # Request-level SLO layer: milestone timelines feeding the
         # per-priority seconds histograms, violation counters, and the
         # K-worst exemplar ring (always on — dict writes per request).
-        self.slo = SLOTracker(slo, registry=registry)
+        # peer_id names THIS process in the federated fleet — stamped
+        # into timelines/exemplars so incident stitching can attribute
+        # them, and used as the scrape identity when federated.
+        self.peer_id = peer_id
+        self.slo = SLOTracker(slo, registry=registry, peer_id=peer_id)
         self.timelines = TimelineRecorder(clock=clock, slo=self.slo,
-                                          registry=registry)
+                                          registry=registry,
+                                          peer_id=peer_id)
+        # Optional fleet observability plane (attach_federation):
+        # a MetricsFederator polled once per pump + an AlertManager
+        # evaluated right after, so federated rollups are fresh for
+        # both the alert rules and the autoscaler.
+        self.federation = None                       # guarded-by: _lock
+        self.alerts = None                           # guarded-by: _lock
         # Open publish-pause window (begin seen, roll not converged) —
         # closed windows are pushed onto the timeline recorder so a
         # finished request knows how much of its e2e was publish pause.
@@ -412,6 +424,7 @@ class ServingFleet:
             self._note_kv_pressure()
             for rej in self.admission.shed_expired(now):
                 self._record_rejection(rej)
+            self._pump_federation(now)
             if self.autoscaler is not None:
                 self.autoscaler.evaluate(now)
             self._dispatch(now)
@@ -575,14 +588,40 @@ class ServingFleet:
         hysteresis controller evaluated once per pump.
         ``spawn_engine()`` must return an engine already holding the
         CURRENT published params (``add_replica`` stamps the version);
-        it runs under the fleet lock, so keep it cheap or pre-built."""
+        it runs under the fleet lock, so keep it cheap or pre-built.
+        When federation is attached (before or after), the controller
+        reads FLEET-WIDE rollups instead of this process's gauges."""
         from .autoscale import AutoscaleConfig, AutoscaleController
         with self._lock:
             self.autoscaler = AutoscaleController(
                 self, spawn_engine,
                 config=config or AutoscaleConfig(),
-                registry=self.registry)
+                registry=self.registry,
+                fleet_store=(self.federation.store
+                             if self.federation is not None else None))
             return self.autoscaler
+
+    def attach_federation(self, federator, *, alert_manager=None):
+        """Wire the fleet observability plane into the pump: the
+        :class:`~..obs.federation.MetricsFederator` polls every peer on
+        its own cadence and an optional
+        :class:`~..obs.alerts.AlertManager` is evaluated right after,
+        both once per pump under the fleet lock. An already-attached
+        autoscaler is pointed at the federated store so capacity
+        decisions see fleet-wide pressure."""
+        with self._lock:
+            self.federation = federator
+            self.alerts = alert_manager
+            if self.autoscaler is not None:
+                self.autoscaler.fleet_store = federator.store
+            return federator
+
+    def _pump_federation(self, now: float) -> None:
+        # guarded-by: _lock
+        if self.federation is not None:
+            self.federation.poll(now)
+            if self.alerts is not None:
+                self.alerts.evaluate(now)
 
     def kill_replica(self, replica_id: str) -> None:
         """Declare a replica dead (chaos hook / operator action); its
@@ -615,6 +654,7 @@ class ServingFleet:
                     self._note_kv_pressure()
                     for rej in self.admission.shed_expired(now):
                         self._record_rejection(rej)
+                    self._pump_federation(now)
                     if self.autoscaler is not None:
                         self.autoscaler.evaluate(now)
                     self._dispatch(now)

@@ -42,22 +42,26 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+from ..obs.federation import MetricsScrapeMixin
 from ..resilience.lease import LeaseStore
 from .remote_server import RpcHandlerBase, serve_rpc_http
 from .replica import DEAD
 
-# Only publish staging consults the idempotency cache: a staged
-# publish whose response was lost must REPLAY, never double-stage.
+# Publish staging consults the idempotency cache (a staged publish
+# whose response was lost must REPLAY, never double-stage), as does
+# federation ``scrape`` (a retried scrape must replay the same delta,
+# its cursor already advanced).
 # Lease mutations are deliberately NOT cached — re-executing them on a
 # retry is safe (acquire grants a fresh higher epoch, renew/release
 # are idempotent on live state), whereas caching them lets a restarted
 # client whose request ids collide with a previous incarnation replay
 # that incarnation's lease grant and run at a zombie epoch, defeating
 # the fencing. Status/signals are reads and must see fresh state.
-LEARNER_MUTATING_METHODS = frozenset({"publish", "publish_adapter"})
+LEARNER_MUTATING_METHODS = frozenset({"publish", "publish_adapter",
+                                      "scrape"})
 
 
-class FleetRpcHandler(RpcHandlerBase):
+class FleetRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     """Lease + fenced-publish dispatch table over one ServingFleet."""
 
     mutating_methods = LEARNER_MUTATING_METHODS
@@ -178,17 +182,19 @@ def serve_fleet_http(fleet_or_handler, *, host: str = "127.0.0.1",
 
 # -- standalone lease authority (satellite: shared across fleets) ------------
 
-LEASE_MUTATING_METHODS = frozenset()
-# EMPTY on purpose — the PR-7 zombie-grant rule in its new topology:
+LEASE_MUTATING_METHODS = frozenset({"scrape"})
+# No LEASE op is cached, on purpose — the PR-7 zombie-grant rule in
+# its new topology:
 # idempotency-caching a lease grant would let a restarted client whose
 # request ids collide with a previous incarnation REPLAY that
 # incarnation's epoch and write as a zombie. Re-EXECUTING lease ops on
 # a retried request id is always safe (acquire grants a fresh higher
-# epoch; renew/release/validate act on live state), so nothing here is
-# cached.
+# epoch; renew/release/validate act on live state), so no lease op is
+# cached. ``scrape`` (federation delta shipping) is the one exception:
+# its per-scraper cursor makes replays the only safe retry.
 
 
-class LeaseRpcHandler(RpcHandlerBase):
+class LeaseRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     """The learner lease as its OWN process: one
     :class:`~..resilience.lease.LeaseStore` behind an rpc endpoint, so
     several fleets can share a single learner (each fleet's
@@ -313,7 +319,7 @@ def serve_lease_http(store_or_handler=None, *, host: str = "127.0.0.1",
 
 # -- streaming experience intake (learner-side endpoint) ---------------------
 
-EXPERIENCE_MUTATING_METHODS = frozenset({"submit_episodes"})
+EXPERIENCE_MUTATING_METHODS = frozenset({"submit_episodes", "scrape"})
 # submit_episodes IS idempotency-cached: a batch whose ack frame was
 # lost (drop_response chaos) must REPLAY the recorded acks, not
 # re-offer — the queue's seen-set would ack "duplicate" anyway, but
@@ -322,7 +328,7 @@ EXPERIENCE_MUTATING_METHODS = frozenset({"submit_episodes"})
 # the retry of the same request).
 
 
-class ExperienceRpcHandler(RpcHandlerBase):
+class ExperienceRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     """Collector→learner episode intake over rpc. Wraps a
     :class:`~.learner.StreamingLearnerService` (or any object with
     ``intake(episodes)`` / ``stream_stats()``)."""

@@ -25,15 +25,19 @@ import json
 import threading
 from typing import Any, Dict, Optional, Tuple
 
+from ..obs.federation import MetricsScrapeMixin
 from .rpc import RPC_PATH, RpcApplicationError, RpcProtocolError, decode, \
     encode
 
 # Methods that change engine state; only these consult/populate the
 # idempotency cache (reads are naturally idempotent and must see fresh
 # state — a cached ``step`` replay is correct, a cached ``health`` lie).
+# ``scrape`` is mutating on purpose: delta shipping advances a
+# per-scraper cursor, so a retried scrape must REPLAY the cached delta
+# (exactly-once) rather than compute a second one and skip a window.
 MUTATING_METHODS = frozenset({
     "submit", "step", "release_slot", "register_prefix", "import_prefix",
-    "release_prefix", "update_params"})
+    "release_prefix", "update_params", "scrape"})
 
 
 class RpcHandlerBase:
@@ -139,9 +143,10 @@ def _maybe_tracer():
         return None
 
 
-class EngineRpcHandler(RpcHandlerBase):
+class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     """The whole remote side of the cross-host fleet: a dispatch table
-    over one local engine (plus the idempotency cache from the base)."""
+    over one local engine (plus the idempotency cache from the base,
+    plus the federation ``scrape`` endpoint from the mixin)."""
 
     mutating_methods = MUTATING_METHODS
     span_service = "engine"

@@ -28,6 +28,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional, Sequence
 
+from ..obs.incidents import emit_event
 from .replica import DEAD, LIVE, EngineReplica
 
 
@@ -135,6 +136,7 @@ class WeightPublisher:
 
     def _fire_end(self) -> None:
         # guarded-by: _lock
+        emit_event("publish_end", version=self.version)
         for fn in self._on_end:
             fn(self.version)
 
@@ -198,6 +200,8 @@ class WeightPublisher:
             self.version = new_version
             self._pending_params = params
             self._publishes_total.inc()
+            emit_event("publish_begin", version=new_version,
+                       epoch=new_epoch, eager=bool(eager))
             # (Re)build the roll queue: every non-dead replica needs the
             # new version, including ones mid-drain from a previous roll.
             self._roll_queue = [r for r in self.replicas
@@ -247,6 +251,8 @@ class WeightPublisher:
             self.epoch = new_epoch
             self.draft_version = new_version
             self._draft_publishes_total.inc()
+            emit_event("draft_publish", version=new_version,
+                       epoch=new_epoch)
             for r in self.replicas:
                 if r.state == DEAD:
                     continue
@@ -287,6 +293,8 @@ class WeightPublisher:
             self.epoch = new_epoch
             self.adapter_versions[tenant_id] = new_version
             self._adapter_publishes_total.inc()
+            emit_event("adapter_publish", tenant=tenant_id,
+                       version=new_version, epoch=new_epoch)
             for r in self.replicas:
                 if r.state == DEAD:
                     continue

@@ -61,7 +61,7 @@ class DashboardService:
                  control=None, metrics_path: Optional[str] = None,
                  onboarding=None, title: str = "senweaver-tpu trainer",
                  control_socket: Optional[str] = None,
-                 tracer=None, registry=None, slo=None):
+                 tracer=None, registry=None, slo=None, incidents=None):
         self.collector = collector
         self.apo = apo
         self.engine = engine
@@ -73,6 +73,10 @@ class DashboardService:
         # histograms/counters either way, but exemplar timelines live
         # only on the tracker object — pass the fleet's to see them.
         self.slo = slo
+        # Optional IncidentCorrelator (obs/incidents.py): the fleet
+        # tile's counters/gauges are registry-read, but the last
+        # incident's one-liner lives only on the correlator object.
+        self.incidents = incidents
         # Observability plane: defaults to the process-global tracer +
         # registry (obs/), so an instrumented trainer's spans and
         # telemetry show up with zero wiring; tests pass their own.
@@ -155,6 +159,7 @@ class DashboardService:
         out["adapters"] = self._adapter_summary()
         out["slo"] = self._slo_summary()
         out["runtime"] = self._runtime_summary()
+        out["fleet"] = self._fleet_summary()
         return out
 
     def _resilience_summary(self) -> Dict[str, Any]:
@@ -557,6 +562,59 @@ class DashboardService:
         except Exception as e:
             return {"error": str(e)}
 
+    def _fleet_summary(self) -> Dict[str, Any]:
+        """Fleet-health tile: federation peer counts, worst-replica KV
+        pressure, per-window SLO burn, and alerting state — read
+        straight off the ``senweaver_fleet_*`` series any
+        FleetMetricsStore / AlertManager in the process publishes (zero
+        wiring). The last incident's one-liner needs the live
+        correlator object, so it appears when one was passed at
+        construction."""
+        def gauge(name: str) -> Optional[float]:
+            m = self.registry.get(name)
+            return float(m.value()) if m is not None else None
+
+        def cell(name: str, *labels: str) -> Optional[float]:
+            m = self.registry.get(name)
+            if m is None:
+                return None
+            v = m.samples().get(tuple(labels))
+            return float(v) if v is not None else None
+
+        try:
+            active = self.registry.get("senweaver_fleet_alert_active")
+            firing = sorted(
+                k[0] for k, v in (active.samples().items()
+                                  if active is not None else ())
+                if float(v) >= 1.0)
+            fired = self.registry.get(
+                "senweaver_fleet_alerts_fired_total")
+            out: Dict[str, Any] = {
+                "peers": gauge("senweaver_fleet_peers"),
+                "peers_stale": gauge("senweaver_fleet_peers_stale"),
+                "worst_kv_pressure": cell(
+                    "senweaver_fleet_rollup",
+                    "senweaver_kv_pressure", "max"),
+                "burn_fast": cell("senweaver_fleet_burn_ratio",
+                                  "slo_burn_fast", "fast"),
+                "burn_slow": cell("senweaver_fleet_burn_ratio",
+                                  "slo_burn_fast", "slow"),
+                "alerts_active": len(firing),
+                "alerts_firing": firing,
+                "alerts_fired": (sum(float(v) for v in
+                                     fired.samples().values())
+                                 if fired is not None else 0),
+                "incidents": None,
+                "last_incident": None,
+            }
+            if self.incidents is not None:
+                inc = self.incidents.summary()
+                out["incidents"] = inc.get("incidents")
+                out["last_incident"] = inc.get("last")
+            return out
+        except Exception as e:
+            return {"error": str(e)}
+
     def _runtime_summary(self) -> Dict[str, Any]:
         """Runtime observatory tile: compile/retrace ledger, transfer
         bytes, and HBM watermarks from the global
@@ -813,6 +871,9 @@ input[type=text], input[type=password], textarea {
 <section><h2>SLO</h2>
 <div id="slo" class="tiles"></div>
 <div id="slo-exemplars"></div></section>
+<section><h2>Fleet health</h2>
+<div id="fleet" class="tiles"></div>
+<div id="fleet-incident"></div></section>
 <section><h2>Learner &amp; autoscaler</h2>
 <div id="learner" class="tiles"></div></section>
 <section><h2>Streaming experience</h2>
@@ -1115,6 +1176,22 @@ async function refresh() {
                                     x.ttft_s, x.e2e_s, x.trace_id]),
     ["worst request", "priority", "violated", "ttft_s", "e2e_s",
      "trace"]);
+  const fl = s.fleet || {};
+  tiles(document.getElementById("fleet"), [
+    ["federated peers", fl.peers],
+    ["stale peers", fl.peers_stale],
+    ["worst kv pressure", fl.worst_kv_pressure],
+    ["burn (fast 5m)", fl.burn_fast],
+    ["burn (slow 1h)", fl.burn_slow],
+    ["active alerts", fl.alerts_active],
+    ["alerts fired", fl.alerts_fired],
+    ["incidents", fl.incidents]]);
+  document.getElementById("fleet-incident").innerHTML =
+    (fl.alerts_firing || []).length || fl.last_incident
+      ? table([[esc((fl.alerts_firing || []).join(", ") || "none"),
+                esc(fl.last_incident || "none")]],
+              ["firing", "last incident"])
+      : "";
   tiles(document.getElementById("learner"), [
     ["lease epoch", sv.lease_epoch],
     ["learner rounds", sv.learner_rounds],
