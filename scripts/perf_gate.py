@@ -56,7 +56,10 @@ DEFAULT_BAND = 2.0
 # dwarfs the other cases, so its band is wider by construction. The
 # fleet scrape sweep is pure host Python at sub-ms scale with the same
 # jitter profile.
-CASE_BANDS = {"reward_head": 3.0, "fleet_scrape": 3.0}
+CASE_BANDS = {"reward_head": 3.0, "fleet_scrape": 3.0,
+              # the handoff round trip is dominated by the host-side
+              # gather/scatter pair — ms-scale with CPU-copy jitter
+              "migration": 3.0}
 STEADY_ITERS = 5
 
 
@@ -295,6 +298,50 @@ def _case_kv_pressure() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_migration() -> Dict[str, Any]:
+    """The live-migration hot path (ISSUE 17): checkpoint a mid-flight
+    decode off engine A (one gathered device_get), install it on
+    engine B (one scatter), finish it there, release the source copy —
+    the full handoff round trip. Gates that migrating adds no
+    steady-state retraces (the install rides the same paged scatter
+    the prefix import uses) and tracks the end-to-end handoff time."""
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompts = [[(i * 7 + j) % 200 + 2 for j in range(12)]
+               for i in range(3)]
+
+    def run():
+        a = RolloutEngine(params, config, num_slots=4, max_len=128,
+                          sample=greedy,
+                          engine_config=EngineConfig(kv_layout="paged"))
+        b = RolloutEngine(params, config, num_slots=4, max_len=128,
+                          sample=greedy,
+                          engine_config=EngineConfig(kv_layout="paged"))
+        rids = [a.submit(p, max_new_tokens=16) for p in prompts]
+        for _ in range(6):
+            a.step()
+        for rid in rids:
+            ckpt = a.checkpoint_request(rid)
+            b.restore_request(ckpt)
+            a.release_request(rid)
+        b.run()
+        a._alloc.check_leaks()              # source fully released
+        b._alloc.check_leaks()
+
+    run()                                   # warmup: compiles land here
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")}
+
+
 def _case_multi_lora() -> Dict[str, Any]:
     """Batched multi-tenant LoRA decode (ISSUE 14): four tenants across
     both rank rungs ride one pool engine's fused step via the gathered
@@ -480,6 +527,7 @@ CASES = {
     "engine_decode": _case_engine_decode,
     "spec_decode": _case_spec_decode,
     "kv_pressure": _case_kv_pressure,
+    "migration": _case_migration,
     "multi_lora": _case_multi_lora,
     "train_step": _case_train_step,
     "streaming_grpo": _case_streaming_grpo,

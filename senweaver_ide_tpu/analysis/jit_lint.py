@@ -80,6 +80,7 @@ HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/rollout/adapter_pool.py",
     "senweaver_ide_tpu/rollout/engine.py",
     "senweaver_ide_tpu/rollout/kv_pressure.py",
+    "senweaver_ide_tpu/rollout/migration.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",
     "senweaver_ide_tpu/rollout/sampler.py",
     "senweaver_ide_tpu/rollout/spec_controller.py",

@@ -494,6 +494,11 @@ class _Request:
     # times this request lost its blocks to preempt-by-recomputation;
     # at EngineConfig.max_preempts it becomes non-preemptible
     preempt_count: int = 0
+    # live-migration freeze (rollout/migration.py): a paused request
+    # is skipped by the step assembler, the speculation planner, and
+    # the scheduler — its state cannot advance between the migration
+    # snapshot and the coordinator's release/resume decision.
+    paused: bool = False
     # multi-tenant LoRA: the tenant key this request decodes under, and
     # the pool binding (rung, slot, version) resolved at SUBMIT time —
     # held for the request's whole life (incl. across preemption), so a
@@ -645,7 +650,17 @@ class RolloutEngine:
                        "prefix_host_exports": 0,
                        "spec_rounds": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "spec_wasted": 0,
-                       "spec_feed_tokens": 0, "spec_rollbacks": 0}
+                       "spec_feed_tokens": 0, "spec_rollbacks": 0,
+                       "migrations_out": 0, "migrations_in": 0}
+        # Live migration (rollout/migration.py): when the fleet
+        # attaches a MigrationCoordinator it flips this on, and the
+        # pressure ladder OFFERS a capped request for migration (one
+        # preempt, rid surfaced via take_pressure_migrations) before
+        # falling back to truncate-finish. Default off: standalone
+        # engines keep the legacy ladder exactly.
+        self.migrate_on_pressure = False
+        self._pressure_migrations: List[int] = []  # guarded-by: _lock
+        self._migration_offered: set = set()       # guarded-by: _lock
         # Bounded admission (None = legacy unbounded): submit() raises
         # QueueFull past this many QUEUED requests — in-flight slots and
         # continuations (which bypass the queue) don't count.
@@ -1454,6 +1469,67 @@ class RolloutEngine:
                 self._alloc.set_swapped_blocks(
                     self._swapped_blocks_total())
 
+    # -- live migration (rollout/migration.py) -------------------------------
+
+    def checkpoint_request(self, rid: int, *, pause: bool = True):
+        """Snapshot an in-flight request into a portable
+        :class:`~.migration.DecodeCheckpoint` (non-destructive; the
+        request is left PAUSED so its state cannot advance between
+        snapshot and the coordinator's release/resume). The freeze +
+        snapshot happen atomically under the engine lock."""
+        from .migration import checkpoint_from_engine
+        with self._lock:
+            return checkpoint_from_engine(self, rid, pause=pause)
+
+    def restore_request(self, ckpt) -> int:
+        """Install a peer's checkpoint under a fresh rid and return
+        it: one install scatter when a free row + matching block
+        layout exist, otherwise a front-of-queue requeue that resumes
+        through the preemption-recompute replay. Either way the
+        resumed output is token-exact versus never migrating."""
+        from .migration import restore_into_engine
+        with self._lock:
+            rid = restore_into_engine(self, ckpt)
+            self._schedule()
+            return rid
+
+    def release_request(self, rid: int) -> bool:
+        """Forget a migrated-away request (post-ack cleanup): drop its
+        row/blocks, adapter binding, queue entry, and pending emits.
+        Idempotent — unknown rids return False."""
+        from .migration import release_from_engine
+        with self._lock:
+            out = release_from_engine(self, rid)
+            self._schedule()
+            return out
+
+    def pause_request(self, rid: int) -> None:
+        """Freeze one request (migration prepare): skipped by the step
+        assembler, the speculation planner, and the scheduler."""
+        from .migration import set_paused
+        with self._lock:
+            set_paused(self, rid, True)
+
+    def resume_request(self, rid: int) -> None:
+        """Unfreeze a paused request (migration aborted — the fence
+        tripped, the install failed, or the target died): it resumes
+        decoding HERE, token-exactly, as if never frozen."""
+        from .migration import set_paused
+        with self._lock:
+            set_paused(self, rid, False)
+
+    def take_pressure_migrations(self) -> List[int]:
+        """Drain the rids the pressure ladder offered for migration
+        instead of truncate-finishing (paused, blocks already freed).
+        The fleet coordinator either migrates each or resumes it
+        locally; a resumed request that caps out again truncates."""
+        with self._lock:
+            out = [rid for rid in self._pressure_migrations
+                   if rid in self._requests
+                   and not self._requests[rid].done]
+            self._pressure_migrations = []
+            return out
+
     # -- internals ----------------------------------------------------------
 
     def _emit_first_token(self, req: "_Request", slot: int,
@@ -1799,7 +1875,7 @@ class RolloutEngine:
         advanced = []                   # (row, n)
         for row in range(self.num_slots):
             req = self._slot_req[row]
-            if req is None or req.rid in self._prefill_jobs:
+            if req is None or req.paused or req.rid in self._prefill_jobs:
                 continue
             if budget <= 0:
                 break
@@ -1859,7 +1935,8 @@ class RolloutEngine:
         rows = []
         for row in range(self.num_slots):
             req = self._slot_req[row]
-            if (req is None or req.rid in self._prefill_jobs
+            if (req is None or req.paused
+                    or req.rid in self._prefill_jobs
                     or not req.tokens):
                 continue
             p = self._row_len[row]
@@ -2082,8 +2159,23 @@ class RolloutEngine:
                 # request already burned its preemption budget and
                 # every other row is capped too: truncate-finish
                 # instead of requeue-livelock — the request completes
-                # (short), it is never lost
-                self._finish_request(req, row)
+                # (short), it is never lost. With a fleet migrator
+                # attached, offer the request for migration FIRST
+                # (one preempt frees the blocks, tokens survive); a
+                # second trip through this branch — no replica took
+                # it — truncates as before, so no livelock.
+                if (self.migrate_on_pressure
+                        and req.rid not in self._migration_offered):
+                    self._migration_offered.add(req.rid)
+                    self._pressure_migrations.append(req.rid)
+                    # paused so the scheduler cannot bounce it straight
+                    # back into the freed row (and re-cap it) before
+                    # the coordinator's pump decides; the coordinator
+                    # resumes it if no replica has headroom
+                    req.paused = True
+                    self._preempt_row(row)
+                else:
+                    self._finish_request(req, row)
             else:
                 self._preempt_row(row)
         return False
@@ -2204,7 +2296,21 @@ class RolloutEngine:
         turn their prompts into chunked-prefill jobs. No device work
         happens here — prefix installs are table grafts, and all
         prefill compute is interleaved into the fused steps under the
-        step-token budget."""
+        step-token budget. Paused (migration-frozen) requests are
+        lifted out of the queue for the duration and put back at the
+        front — they keep their place but cannot be scheduled."""
+        paused = None
+        if any(r.paused for r in self._queue):
+            paused = [r for r in self._queue if r.paused]
+            self._queue = deque(r for r in self._queue if not r.paused)
+        try:
+            self._schedule_paged_inner()
+        finally:
+            if paused:
+                self._queue.extendleft(reversed(paused))
+
+    def _schedule_paged_inner(self) -> None:
+        # guarded-by: caller
         if self._queue and all(self._slot_held[s] is not None
                                for s in range(self.num_slots)):
             # same livelock guard as the slot scheduler: all slots held
@@ -2318,7 +2424,7 @@ class RolloutEngine:
         committed: set = set()
         for row in range(self.num_slots):
             req = self._slot_req[row]
-            if req is None or req.rid in self._prefill_jobs:
+            if req is None or req.paused or req.rid in self._prefill_jobs:
                 continue
             p = self._row_len[row]
             props = spec_plan.get(row) if spec_plan else None
@@ -2356,7 +2462,7 @@ class RolloutEngine:
         budget = max(0, self._step_tokens - len(toks_l))
         for row in range(self.num_slots):
             req = self._slot_req[row]
-            if req is None or budget <= 0:
+            if req is None or req.paused or budget <= 0:
                 continue
             job = self._prefill_jobs.get(req.rid)
             if job is None:

@@ -78,6 +78,12 @@ class AutoscaleController:
         # pressure across peers) instead of this process's local view —
         # capacity decisions see remote replicas' pressure too.
         self.fleet_store = fleet_store
+        # Optional MigrationCoordinator (serve/scheduler.py), wired by
+        # fleet.attach_migration(): when present, scale-down EVACUATES
+        # the retiring replica's in-flight decodes to live peers
+        # instead of waiting out a drain — retirement completes in one
+        # pump tick and no request ever runs on borrowed time.
+        self.migrator = None
         # All mutable state below is guarded-by: fleet._lock — evaluate()
         # only ever runs inside the fleet's pump, which holds it.
         self._last_eval_at: Optional[float] = None   # guarded-by: fleet._lock
@@ -217,6 +223,17 @@ class AutoscaleController:
                     if r.replica_id == self._retiring), None)
         if rep is None or rep.state == DEAD:
             self._retiring = None
+            return None
+        if rep.outstanding > 0 and self.migrator is not None:
+            # Live-migrate the stragglers off instead of draining them
+            # out: whatever the fleet can place moves now; any
+            # remainder keeps decoding here and the next tick retries.
+            self.migrator.evacuate(rep, reason="scale_down", now=now)
+        if (self.migrator is not None
+                and self.migrator.has_pending_on(rep)):
+            # Still the frozen SOURCE of an un-acked handoff: killing
+            # it now would strand the fallback copy the exactly-once
+            # guarantee depends on. Wait for the ack.
             return None
         if rep.state != DEAD and rep.outstanding == 0:
             # Drained dry — retire through the fleet's death path (no

@@ -178,6 +178,11 @@ class ServingFleet:
         # Optional admission-driven autoscaler (attach_autoscaler);
         # evaluated once per pump, inside the fleet lock.
         self.autoscaler = None                       # guarded-by: _lock
+        # Optional live-migration plane (attach_migration): a
+        # MigrationCoordinator pumped right after the autoscaler, so
+        # pressure offers and eager-publish relief act on this pump's
+        # signals.
+        self.migrator = None                         # guarded-by: _lock
         # Request-level SLO layer: milestone timelines feeding the
         # per-priority seconds histograms, violation counters, and the
         # K-worst exemplar ring (always on — dict writes per request).
@@ -427,6 +432,8 @@ class ServingFleet:
             self._pump_federation(now)
             if self.autoscaler is not None:
                 self.autoscaler.evaluate(now)
+            if self.migrator is not None:
+                self.migrator.pump(now)
             self._dispatch(now)
             emitted_by_ticket: Dict[int, List[int]] = {}
             for replica in list(self.replicas):
@@ -571,6 +578,9 @@ class ServingFleet:
             # Through the replica's own locked mutator: weight_version
             # is guarded by replica._lock, not ours (analysis LOCK102).
             replica.stamp_version(self.publisher.version)
+            if self.migrator is not None \
+                    and hasattr(replica.engine, "migrate_on_pressure"):
+                replica.engine.migrate_on_pressure = True
             # router and publisher hold their own list copies; the
             # prefix store shares self.replicas by reference.
             self.replicas.append(replica)
@@ -599,7 +609,41 @@ class ServingFleet:
                 registry=self.registry,
                 fleet_store=(self.federation.store
                              if self.federation is not None else None))
+            if self.migrator is not None:
+                self.autoscaler.migrator = self.migrator
             return self.autoscaler
+
+    def attach_migration(self, *, min_headroom: Optional[float] = None):
+        """Wire the live-migration plane (serve/scheduler.py): a
+        :class:`~.scheduler.MigrationCoordinator` pumped once per fleet
+        tick turns the three request-hurting degrade paths into
+        placement decisions — KV-pressure preempt caps migrate instead
+        of truncate-finishing, blocked eager publishes migrate work off
+        instead of degrading to a drain, and autoscale scale-down
+        evacuates instead of draining out. Local engines get
+        ``migrate_on_pressure`` flipped on; remote engines keep the
+        legacy truncate ladder (the flag is host-local — their own
+        fleet process flips it)."""
+        from .scheduler import GlobalScheduler, MigrationCoordinator
+        with self._lock:
+            store = (self.federation.store
+                     if self.federation is not None else None)
+            kwargs = {}
+            if min_headroom is not None:
+                kwargs["min_headroom"] = float(min_headroom)
+            # router.replicas by reference: add_replica appends there,
+            # so autoscaled joiners are migration targets immediately.
+            scheduler = GlobalScheduler(self.router.replicas,
+                                        fleet_store=store, **kwargs)
+            self.migrator = MigrationCoordinator(
+                self.router, self.publisher, scheduler=scheduler,
+                registry=self.registry)
+            if self.autoscaler is not None:
+                self.autoscaler.migrator = self.migrator
+            for r in self.replicas:
+                if hasattr(r.engine, "migrate_on_pressure"):
+                    r.engine.migrate_on_pressure = True
+            return self.migrator
 
     def attach_federation(self, federator, *, alert_manager=None):
         """Wire the fleet observability plane into the pump: the
@@ -657,6 +701,8 @@ class ServingFleet:
                     self._pump_federation(now)
                     if self.autoscaler is not None:
                         self.autoscaler.evaluate(now)
+                    if self.migrator is not None:
+                        self.migrator.pump(now)
                     self._dispatch(now)
                     self._reap_faulted(now)
                 time.sleep(dispatch_interval_s)
@@ -941,6 +987,12 @@ class ServingFleet:
                 # token reached the caller.
                 self.timelines.mark(req.ticket, "first_token", now,
                                     replica=replica.replica_id)
+            if toks and self.migrator is not None:
+                # First post-migration token = the handoff ack: the
+                # target demonstrably owns the decode, so the frozen
+                # source copy can be released (no-op for unmigrated
+                # requests).
+                self.migrator.note_progress(req, now)
         for req in done:
             self._complete(replica, req, now)
 
@@ -960,13 +1012,13 @@ class ServingFleet:
             self.timelines.event(req.ticket, "retry", now,
                                  reason="result_lost",
                                  replica=replica.replica_id)
-            req.attempts += 1
-            req.replica_id = None
-            req.engine_rid = None
-            req.version_at_dispatch = None
-            req.version_at_finish = None
-            req.first_token_at = None
-            req.emitted = 0
+            if self.migrator is not None \
+                    and self.migrator.rescue_request(req, now):
+                # The request was a pre-ack migration target whose
+                # result vanished — its frozen source copy resumed, so
+                # this is a zero-loss failover, not a retry.
+                return
+            self.router.on_request_departure(req)
             if not self.router.live_replicas():
                 self._record_rejection(Rejected(
                     ticket=req.ticket, priority=req.priority,
@@ -983,6 +1035,11 @@ class ServingFleet:
                     req.attempts)
                 self.admission.requeue(req)
             return
+        if self.migrator is not None:
+            # Defensive ack: a decode that finishes on its migration
+            # target in the very step it was installed never passes
+            # through _ingest with the pending entry open.
+            self.migrator.note_complete(req, now)
         e2e_ms = (now - req.submitted_at) * 1000.0
         self._outcomes[req.ticket] = Completed(
             ticket=req.ticket, priority=req.priority,
@@ -1024,6 +1081,13 @@ class ServingFleet:
             self._handle_death(replica, now)
 
     def _handle_death(self, replica: EngineReplica, now: float) -> None:
+        if self.migrator is not None:
+            # BEFORE orphan triage: pre-ack migration targets hand
+            # their requests back to the frozen source copies (token-
+            # exact, not a retry); pre-ack sources just drop out of the
+            # pending ledger. Either way the router below never sees
+            # those requests as orphans.
+            self.migrator.on_replica_death(replica, now)
         requeue, shed = self.router.on_replica_death(replica, now)
         self._replicas_live.set(
             sum(r.state != DEAD for r in self.replicas))

@@ -239,6 +239,36 @@ class RemoteEngineClient:
             call_params["epoch"] = 0 if epoch is None else int(epoch)
         self._call("update_params", call_params)
 
+    # -- live migration (serve/scheduler.py) ---------------------------------
+    def checkpoint_request(self, rid: int, *, pause: bool = True):
+        """Snapshot an in-flight decode on the remote host; returns the
+        decoded :class:`~..rollout.migration.DecodeCheckpoint`. The
+        snapshot also FREEZES the row (pause=True), so a lost-response
+        retry replays the cached checkpoint rather than cutting a
+        second, later one."""
+        from ..rollout.migration import DecodeCheckpoint
+        wire = self._call("checkpoint_request",
+                          {"rid": int(rid), "pause": bool(pause)})
+        return DecodeCheckpoint.from_wire(wire)
+
+    def restore_checkpoint(self, ckpt, *,
+                           idempotency_key: Optional[str] = None) -> int:
+        """Install a checkpoint on the remote host; returns the new
+        engine rid. The coordinator passes a stable idempotency key so
+        the install is at-least-once on the wire but exactly-once on
+        the engine (the server's idempotency cache replays the first
+        rid instead of double-installing)."""
+        if hasattr(ckpt, "to_wire"):
+            ckpt = ckpt.to_wire()
+        return int(self._call("restore_checkpoint", {"ckpt": ckpt},
+                              idempotency_key=idempotency_key))
+
+    def resume_request(self, rid: int) -> None:
+        self._call("resume_request", {"rid": int(rid)})
+
+    def release_request(self, rid: int) -> bool:
+        return bool(self._call("release_request", {"rid": int(rid)}))
+
     def stats(self) -> Dict[str, Any]:
         return dict(self._call("stats"))
 

@@ -37,7 +37,13 @@ from .rpc import RPC_PATH, RpcApplicationError, RpcProtocolError, decode, \
 # (exactly-once) rather than compute a second one and skip a window.
 MUTATING_METHODS = frozenset({
     "submit", "step", "release_slot", "register_prefix", "import_prefix",
-    "release_prefix", "update_params", "scrape"})
+    "release_prefix", "update_params", "scrape",
+    # Live migration (serve/scheduler.py). checkpoint_request mutates
+    # (it freezes the row) and a lost-response retry must replay the
+    # SAME snapshot; restore_checkpoint is the at-least-once install
+    # whose cache hit makes it exactly-once on the engine.
+    "checkpoint_request", "restore_checkpoint", "resume_request",
+    "release_request"})
 
 
 class RpcHandlerBase:
@@ -232,6 +238,23 @@ class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
                         f"{self._hw_epoch}, version={self._hw_version})")
                 self._hw_epoch, self._hw_version = e, v
         self.engine.update_params(params)
+
+    # -- live migration (serve/scheduler.py) ---------------------------------
+    def _m_checkpoint_request(self, rid, pause=True) -> Dict[str, Any]:
+        ckpt = self.engine.checkpoint_request(int(rid),
+                                              pause=bool(pause))
+        return ckpt.to_wire()
+
+    def _m_restore_checkpoint(self, ckpt) -> int:
+        from ..rollout.migration import DecodeCheckpoint
+        return int(self.engine.restore_request(
+            DecodeCheckpoint.from_wire(ckpt)))
+
+    def _m_resume_request(self, rid) -> None:
+        self.engine.resume_request(int(rid))
+
+    def _m_release_request(self, rid) -> bool:
+        return bool(self.engine.release_request(int(rid)))
 
     def _m_stats(self) -> Dict[str, Any]:
         return dict(self.engine.stats())

@@ -313,6 +313,21 @@ class EngineReplica:
                                      replica=self.replica_id)
             self._update_decode_gauge()
 
+    def detach(self, rid: int) -> Optional[FleetRequest]:
+        """Stop tracking an engine rid WITHOUT completing it — the
+        migration-out half of a live handoff (the engine-side state is
+        the coordinator's problem: checkpointed and, after the target
+        acks, released). Returns the FleetRequest, or None when the rid
+        isn't tracked here (already completed / already detached —
+        detach is idempotent so rescue paths can call it blindly)."""
+        with self._lock:
+            req = self.inflight.pop(rid, None)
+            if req is not None:
+                self._inflight_gauge.set(len(self.inflight),
+                                         replica=self.replica_id)
+                self._update_decode_gauge()
+            return req
+
     def step(self) -> Tuple[Dict[int, List[int]], List[FleetRequest]]:
         """One engine step. Returns (emitted {engine_rid: [tokens]},
         completed FleetRequests). Engine exceptions propagate — the

@@ -112,6 +112,30 @@ class Router:
                 for r in self.live_replicas()}
 
     # -- failure handling ----------------------------------------------------
+    def on_request_departure(self, req: FleetRequest, *,
+                             tokens_survive: bool = False) -> None:
+        """THE hook for a request leaving a replica without completing
+        — replica death, result-lost triage, or migration-out. Clears
+        the dispatch state in one place so the load accounting
+        (remaining decode tokens = ``max_new_tokens − emitted``) can
+        never go stale-high on a replica the request no longer
+        occupies.
+
+        ``tokens_survive=False`` (death / lost result): the partial
+        tokens died with the replica — the retry restarts from the
+        prompt, so ``emitted`` resets and the attempt is spent.
+        ``tokens_survive=True`` (live migration): the tokens moved
+        WITH the request — ``emitted`` and ``first_token_at`` are
+        real progress and a migration is not a retry."""
+        req.replica_id = None
+        req.engine_rid = None
+        req.version_at_dispatch = None
+        req.version_at_finish = None
+        if not tokens_survive:
+            req.attempts += 1
+            req.first_token_at = None
+            req.emitted = 0     # partial tokens died with the replica
+
     def on_replica_death(self, replica: EngineReplica, now: float
                          ) -> Tuple[List[FleetRequest], List[Rejected]]:
         """Kill ``replica`` and triage its orphans: (requeue, shed).
@@ -125,13 +149,7 @@ class Router:
         shed: List[Rejected] = []
         have_survivors = bool(self.live_replicas())
         for req in orphans:
-            req.attempts += 1
-            req.replica_id = None
-            req.engine_rid = None
-            req.version_at_dispatch = None
-            req.version_at_finish = None
-            req.first_token_at = None
-            req.emitted = 0     # partial tokens died with the replica
+            self.on_request_departure(req, tokens_survive=False)
             if not have_survivors:
                 shed.append(Rejected(
                     ticket=req.ticket, priority=req.priority,

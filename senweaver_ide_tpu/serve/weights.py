@@ -84,6 +84,10 @@ class WeightPublisher:
             "senweaver_serve_stale_publish_total",
             "Publishes rejected by (epoch, version) fencing — a stale "
             "or duplicate writer was denied.")
+        self._eager_degrades_total = registry.counter(
+            "senweaver_serve_eager_degrades_total",
+            "Eager (no-drain) publishes that exhausted their patience "
+            "and degraded to classic draining rolls.")
         # Draft (speculation) weight publishes share the epoch fence
         # with target publishes but keep their own version watermark.
         self.draft_version = 0                  # guarded-by: _lock
@@ -407,11 +411,32 @@ class WeightPublisher:
         if swapped == 0:
             self._eager_waits += 1
             if self._eager_waits > self._eager_wait_limit:
-                self._eager = False     # fall back to draining rolls
+                # Patience exhausted: fall back to draining rolls.
+                # LOUDLY — this is the no-drain guarantee degrading to
+                # the exact drain it promised to avoid, so the incident
+                # journal gets a first-class event (the correlator can
+                # name it as a cause) and a counter tracks the rate.
+                self._eager = False
+                self._eager_degrades_total.inc()
+                emit_event("eager_degrade", version=self.version,
+                           waits=self._eager_waits,
+                           blocked=len(self._roll_queue))
         else:
             self._eager_waits = 0
         self._update_skew()
         return False
+
+    def eager_pending(self) -> List[str]:
+        """Replica ids still BLOCKED on an in-progress eager roll
+        (queued for the new version, in-flight work > 0). The
+        migration coordinator reads this to move long decodes off
+        blocked replicas — onto peers still at the OLD version — so
+        eager patience never runs out in the first place."""
+        with self._lock:
+            if self._pending_params is None or not self._eager:
+                return []
+            return [r.replica_id for r in self._roll_queue
+                    if r.state != DEAD and r.outstanding > 0]
 
     def take_quarantined(self) -> List[EngineReplica]:
         """Drain the replicas whose install failed mid-roll; the fleet

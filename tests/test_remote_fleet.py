@@ -596,6 +596,19 @@ def test_chaos_acceptance_no_request_lost_or_doubled(model):
     counts = plan.injected_counts()
     assert counts.get("partition", 0) >= 2
 
+    # -- leak-free teardown: the server-side engines (loopback handlers
+    # hold the real ones) balance their block allocators, partitioned
+    # zombies included, once their stranded work is released ------------
+    plan.heal()
+    for h in handlers:
+        eng = h.engine
+        for rid, r in list(eng._requests.items()):
+            if not r.done:
+                eng.release_request(rid)
+            elif r.hold_slot and r.slot is not None:
+                eng.release_slot(rid)       # held KV is not a leak
+        eng._alloc.check_leaks()
+
 
 # ---- threaded fleet under the lock-order recorder ------------------------
 

@@ -394,6 +394,18 @@ def test_chaos_acceptance_overload_death_and_publish(model):
     assert s["rejected"] == len(rejected)
     assert s["weight_version"] == 1
 
+    # -- leak-free teardown: every live replica's block allocator
+    # balances; the dead one is audited after its janitor releases the
+    # rows stranded by the kill (a real dead host's memory is simply
+    # gone — locally we get to check nothing ELSE leaked) ---------------
+    for r in fleet.replicas:
+        if r.state == DEAD:
+            eng = r.engine
+            for rid, req in list(eng._requests.items()):
+                if not req.done:
+                    eng.release_request(rid)
+        r.engine._alloc.check_leaks()
+
 
 # ---- threaded stress under chaos (ROADMAP open item) ---------------------
 
