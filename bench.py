@@ -1404,6 +1404,82 @@ def _measure_multi_lora(*, n_tenants: int = 6, reqs_per_tenant: int = 2,
     return out
 
 
+def _measure_group_rollout(*, group_size: int = 8, prompt_len: int = 48,
+                           decode_tokens: int = 24) -> dict:
+    """Group-shared rollout economics (ISSUE 18): one GRPO group of G
+    completions over the same prompt decoded (a) via submit_group —
+    the donor prefills once and every follower grafts the forked KV
+    spine, paying a single-token rescore — vs (b) G independent
+    submits that each prefill the full prompt. Outputs are asserted
+    bitwise-exact across arms; the acceptance signals are prefill
+    tokens avoided (counter-backed) and aggregate tok/s uplift."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompt = [(i * 31 + 7) % 200 + 2 for i in range(prompt_len)]
+    max_len = prompt_len + decode_tokens + 8
+
+    def engine():
+        return RolloutEngine(
+            params, config, num_slots=group_size, max_len=max_len,
+            sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged", block_size=4))
+
+    def run_shared():
+        eng = engine()
+        t0 = _time.perf_counter()
+        rids = eng.submit_group(prompt, group_size,
+                                max_new_tokens=decode_tokens)
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        return [out[r] for r in rids], dt, eng.stats()
+
+    def run_independent():
+        eng = engine()
+        t0 = _time.perf_counter()
+        rids = [eng.submit(list(prompt), max_new_tokens=decode_tokens)
+                for _ in range(group_size)]
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        return [out[r] for r in rids], dt, eng.stats()
+
+    t_warm = _time.perf_counter()
+    run_shared(); run_independent()            # compile warmup
+    compile_s = _time.perf_counter() - t_warm
+    obs._reset_for_tests()
+    ind_out, ind_dt, ind_st = run_independent()
+    t0 = _time.perf_counter()
+    sh_out, sh_dt, sh_st = run_shared()
+    _stamp_timing("group_rollout", compile_s, _time.perf_counter() - t0)
+
+    exact = sh_out == ind_out
+    tokens = sum(len(t) for t in sh_out)
+    out = {
+        "group_size": group_size,
+        "prompt_len": prompt_len,
+        "outputs_exact": exact,
+        "shared_prefills": sh_st["prefills"],
+        "independent_prefills": ind_st["prefills"],
+        "prefill_tokens_avoided": sh_st["group_prefill_tokens_avoided"],
+        "cow_copies": sh_st["kv_cow_copies"],
+        "shared_tok_s": round(tokens / sh_dt, 1),
+        "independent_tok_s": round(tokens / ind_dt, 1),
+        "aggregate_speedup": round(ind_dt / sh_dt, 2),
+    }
+    obs._reset_for_tests()
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -1553,6 +1629,15 @@ def main() -> None:
         extra["multi_lora"] = _measure_multi_lora()
     except Exception as e:
         extra["multi_lora"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Group-shared rollout economics (one prefill per GRPO group via KV
+    # fork vs G independent prefills, same outputs). Protocol-level, so
+    # tiny-test covers it on every backend.
+    try:
+        _log("group rollout measure: group_rollout")
+        extra["group_rollout"] = _measure_group_rollout()
+    except Exception as e:
+        extra["group_rollout"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Cross-host dispatch economics (loopback remote fleet vs the same
     # engines in-process) plus held-slot continuation replay latency.

@@ -393,6 +393,45 @@ def _case_multi_lora() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_group_rollout() -> Dict[str, Any]:
+    """The group-shared rollout hot path (ISSUE 18): one G=8 GRPO
+    group decodes off a single donor prefill — followers graft the
+    forked KV spine and pay only the one-token dropped-write rescore —
+    then the whole group rides the fused step together. Gates that the
+    fork/graft plumbing adds no steady-state retraces (grafts reuse
+    the prefill and decode signatures) and tracks the group's
+    end-to-end time; each iteration asserts one prefill and a
+    leak-free drain, so a silent degrade to per-member prefills fails
+    the case, not just the perf band."""
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prompt = [(j * 11) % 200 + 2 for j in range(24)]
+
+    def run():
+        eng = RolloutEngine(
+            params, config, num_slots=8, max_len=128, sample=greedy,
+            engine_config=EngineConfig(kv_layout="paged", block_size=4))
+        eng.submit_group(prompt, 8, max_new_tokens=16)
+        eng.run()
+        st = eng.stats()
+        assert st["prefills"] == 1, \
+            f"group paid {st['prefills']} prefills (degrade leaked in)"
+        eng._alloc.check_leaks()            # drain must stay leak-free
+
+    run()                                   # warmup: compiles land here
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")}
+
+
 def _ledger_compiles_all() -> int:
     from senweaver_ide_tpu.obs.runtime_profile import get_profiler
     return sum(int(s["compiles"])
@@ -529,6 +568,7 @@ CASES = {
     "kv_pressure": _case_kv_pressure,
     "migration": _case_migration,
     "multi_lora": _case_multi_lora,
+    "group_rollout": _case_group_rollout,
     "train_step": _case_train_step,
     "streaming_grpo": _case_streaming_grpo,
     "reward_head": _case_reward_head,

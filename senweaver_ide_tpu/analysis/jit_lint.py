@@ -79,6 +79,7 @@ HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/obs/runtime_profile.py",
     "senweaver_ide_tpu/rollout/adapter_pool.py",
     "senweaver_ide_tpu/rollout/engine.py",
+    "senweaver_ide_tpu/rollout/group_tree.py",
     "senweaver_ide_tpu/rollout/kv_pressure.py",
     "senweaver_ide_tpu/rollout/migration.py",
     "senweaver_ide_tpu/rollout/paged_kv.py",

@@ -3,6 +3,7 @@ from .adapter_pool import (AdapterBinding, AdapterPool, AdapterPoolConfig,
 from .checkpoints import (CheckpointEntry, ConversationCheckpoints,
                           FileSnapshotter)
 from .engine import EngineConfig, PrefixImportError, QueueFull, RolloutEngine
+from .group_tree import BranchPolicy, GroupRollout, Leaf
 from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
                        PagedSeqKV, init_paged_pool)
 from .policy_client import EnginePolicyClient, render_chat_template

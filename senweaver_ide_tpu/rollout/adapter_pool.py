@@ -345,6 +345,27 @@ class AdapterPool:
             self._refresh_gauges_locked()
             return self._binding_locked(key, version, rung, slot)
 
+    def retain(self, binding: AdapterBinding) -> AdapterBinding:
+        """Pin ANOTHER reference to an existing binding's exact
+        (key, version, slot) — the version-exact sibling of
+        :meth:`acquire`. A forked child (group follower, tree branch)
+        must decode under its parent's PINNED adapter version even if
+        a newer publish has landed; plain ``acquire`` would resolve to
+        the new version and silently mix policies mid-tree. Raises
+        ``KeyError`` when the slot was recycled past the binding (the
+        parent already released it)."""
+        with self._lock:
+            s = self._slots[binding.rung][binding.slot - 1]
+            if s.key != binding.key or s.version != binding.version:
+                raise KeyError(
+                    f"adapter slot recycled past binding {binding.key!r} "
+                    f"v{binding.version}")
+            self._tick += 1
+            s.refs += 1
+            s.tick = self._tick
+            self._refresh_gauges_locked()
+            return binding
+
     def release(self, binding: AdapterBinding) -> None:
         with self._lock:
             s = self._slots[binding.rung][binding.slot - 1]

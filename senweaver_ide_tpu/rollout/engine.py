@@ -505,6 +505,41 @@ class _Request:
     # mid-decode publish is picked up only by the NEXT request.
     adapter: Optional[str] = None
     adapter_binding: Optional[object] = None
+    # Group-shared rollout (submit_group): followers of a GRPO group
+    # graft the donor's prefilled prompt spine instead of paying their
+    # own prefill. `group_grafted` latches once so a preempted follower
+    # cannot double-decrement the group's pending count on reschedule.
+    group: Optional["_GroupShare"] = None
+    group_grafted: bool = False
+    # Tree-structured rollout lineage (fork_request): the rid this
+    # request branched from, the parent's emitted-token count at the
+    # branch point, and the branch depth (root submits are depth 0).
+    parent_rid: Optional[int] = None
+    branch_pos: Optional[int] = None
+    branch_depth: int = 0
+
+
+@dataclasses.dataclass
+class _GroupShare:
+    """Shared-prefill bookkeeping for one GRPO group (guarded by the
+    engine lock). The donor request prefills the group's prompt ONCE;
+    when that prefill completes — before the donor's first sampled
+    token is written, so the block table is the pure prompt spine —
+    the engine captures an engine-retained fork of the table and
+    enqueues the waiting followers. Each follower grafts the spine
+    with a refcount bump (zero KV bytes moved) and rescores only the
+    last prompt token. ``degraded`` flips if the donor dies before
+    capture (preemption storm, migration release): followers fall back
+    to plain unshared prefills — slower, never inexact."""
+
+    gid: int
+    prompt_len: int
+    donor_rid: int
+    spine: Optional[List[int]] = None    # engine-retained table fork
+    spine_len: int = 0
+    waiters: List["_Request"] = dataclasses.field(default_factory=list)
+    pending: int = 0                     # followers not yet grafted
+    degraded: bool = False
 
 
 class RolloutEngine:
@@ -651,7 +686,10 @@ class RolloutEngine:
                        "spec_rounds": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "spec_wasted": 0,
                        "spec_feed_tokens": 0, "spec_rollbacks": 0,
-                       "migrations_out": 0, "migrations_in": 0}
+                       "migrations_out": 0, "migrations_in": 0,
+                       "group_prefills": 0, "group_forks": 0,
+                       "group_prefill_tokens_avoided": 0,
+                       "group_degrades": 0, "branch_forks": 0}
         # Live migration (rollout/migration.py): when the fleet
         # attaches a MigrationCoordinator it flips this on, and the
         # pressure ladder OFFERS a capped request for migration (one
@@ -668,6 +706,11 @@ class RolloutEngine:
         self._queue: Deque[_Request] = deque()  # guarded-by: _lock
         self._requests: Dict[int, _Request] = {}  # guarded-by: _lock
         self._next_rid = 0                      # guarded-by: _lock
+        # Group-shared rollout (submit_group): live groups by gid —
+        # entries drop once the last follower grafts or the group
+        # degrades to unshared prefills.
+        self._groups: Dict[int, _GroupShare] = {}  # guarded-by: _lock
+        self._next_gid = 0                      # guarded-by: _lock
         # Tokens sampled during prefill, to be surfaced by the next step().
         self._pending_emits: Dict[int, List[int]] = {}  # guarded-by: _lock
         # Prefix cache: shared prompt prefixes (the agent system prompt)
@@ -1028,6 +1071,169 @@ class RolloutEngine:
         # a slot solo.
         self._queue.append(req)
         return rid
+
+    def submit_group(self, prompt: List[int], group_size: int, *,
+                     max_new_tokens: int = 128,
+                     eos_id: Optional[int] = None,
+                     adapter_id: Optional[str] = None) -> List[int]:
+        """Submit a GRPO group of ``group_size`` decodes of one shared
+        ``prompt``, paying exactly ONE prefill. The first member (the
+        donor) takes the normal chunked prefill; when it completes —
+        before the donor's first sampled token is written, so the table
+        is the pure prompt spine — the engine captures a fork of the
+        table and each follower grafts it (refcount bump, zero KV bytes
+        moved) plus a one-token dropped-write rescore of the last
+        prompt token: the same logits the donor sampled its first token
+        from, so greedy outputs are bitwise-identical to ``group_size``
+        independent submits. Divergence into the shared boundary block
+        COW-splits on first write. If the donor dies before capture
+        (preemption with emitted tokens, migration release), followers
+        degrade to plain unshared prefills — exactness is never traded
+        for sharing.
+
+        Followers pin the donor's adapter binding (``retain``), so a
+        publish landing mid-group cannot mix policy versions across the
+        tree. Requires the paged KV layout. Returns the group's rids,
+        donor first."""
+        if group_size < 1:
+            raise ValueError(f"group_size {group_size} < 1")
+        if self.kv_layout != "paged":
+            raise ValueError("submit_group requires the paged KV layout")
+        with self._lock:
+            donor_rid = self._submit(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     eos_id=eos_id, adapter_id=adapter_id)
+            if group_size == 1:
+                return [donor_rid]
+            donor = self._requests[donor_rid]
+            gid = self._next_gid
+            self._next_gid += 1
+            group = _GroupShare(gid=gid, prompt_len=len(prompt),
+                                donor_rid=donor_rid,
+                                pending=group_size - 1)
+            self._groups[gid] = group
+            donor.group = group
+            rids = [donor_rid]
+            for _ in range(group_size - 1):
+                binding = None
+                if donor.adapter_binding is not None:
+                    # version-exact pin of the donor's binding: the
+                    # donor's ref keeps the slot alive under the engine
+                    # lock, so this cannot miss
+                    binding = self.adapter_pool.retain(
+                        donor.adapter_binding)
+                rid = self._next_rid
+                self._next_rid += 1
+                req = _Request(rid=rid, prompt=list(prompt),
+                               max_new_tokens=max_new_tokens,
+                               eos_id=(self.eos_id if eos_id is None
+                                       else eos_id),
+                               adapter=adapter_id,
+                               adapter_binding=binding,
+                               group=group)
+                self._requests[rid] = req
+                # NOT queued: a follower waits on the spine capture so
+                # its scheduling can never race the donor's prefill
+                group.waiters.append(req)
+                rids.append(rid)
+            return rids
+
+    def fork_request(self, rid: int, *, token: Optional[int] = None,
+                     max_new_tokens: Optional[int] = None) -> int:
+        """Branch a new decode off an in-flight request's current
+        position (tree-structured rollout). The child shares the
+        parent's KV spine via a refcounted table fork — zero bytes
+        copied; either side's next write into the shared boundary
+        block COW-splits it. Two modes:
+
+        * ``token=None`` — sampled continuation: the child adopts the
+          parent's last sampled token as its own first emission and
+          decodes an alternative suffix after that shared token.
+        * ``token=T`` — forced branch: ``T`` REPLACES the parent's
+          last sampled token in the child's stream (exploring an
+          alternative at a high-entropy position, or injecting a
+          tool-call boundary token); the child's first sampled token
+          comes from feeding ``T``.
+
+        Either way the child decodes under the parent's PINNED adapter
+        version, and its greedy output is bitwise-identical to
+        independently submitting the same stream as a fresh prompt.
+        When no free row exists the child enters the queue and builds
+        its context through the standard recompute path — unshared but
+        exact. Raises ``KeyError`` for unknown rids and ``ValueError``
+        for requests that are done, paused, or still prefilling."""
+        if self.kv_layout != "paged":
+            raise ValueError("fork_request requires the paged KV layout")
+        with self._lock:
+            parent = self._requests.get(rid)
+            if parent is None:
+                raise KeyError(f"unknown rid {rid}")
+            if parent.done or parent.paused:
+                raise ValueError(
+                    f"rid {rid} is not an active decode (done/paused)")
+            if rid in self._prefill_jobs or not parent.tokens:
+                raise ValueError(f"rid {rid} is still prefilling")
+            binding = None
+            if parent.adapter_binding is not None:
+                binding = self.adapter_pool.retain(parent.adapter_binding)
+            budget = (max_new_tokens if max_new_tokens is not None
+                      else parent.max_new_tokens)
+            crid = self._next_rid
+            self._next_rid += 1
+            # the shared spine is everything whose k/v is resident:
+            # prompt + tokens[:-1] (the last sampled token is written
+            # only when it is fed)
+            spine = list(parent.prompt) + parent.tokens[:-1]
+            if token is None:
+                child = _Request(rid=crid, prompt=spine,
+                                 max_new_tokens=budget,
+                                 eos_id=parent.eos_id,
+                                 tokens=[parent.tokens[-1]],
+                                 logps=[parent.logps[-1]],
+                                 adapter=parent.adapter,
+                                 adapter_binding=binding,
+                                 parent_rid=rid,
+                                 branch_pos=len(parent.tokens),
+                                 branch_depth=parent.branch_depth + 1)
+            else:
+                child = _Request(rid=crid, prompt=spine + [int(token)],
+                                 max_new_tokens=budget,
+                                 eos_id=parent.eos_id,
+                                 adapter=parent.adapter,
+                                 adapter_binding=binding,
+                                 parent_rid=rid,
+                                 branch_pos=len(parent.tokens),
+                                 branch_depth=parent.branch_depth + 1)
+            self._requests[crid] = child
+            row = parent.slot
+            free = self._free_slots()
+            if row is not None and self._tables[row] and free:
+                crow = free[0]
+                plen = self._row_len[row]
+                nblk = self._alloc.blocks_for(plen)
+                child.slot = crow
+                self._slot_req[crow] = child
+                self._tables[crow] = self._alloc.fork(
+                    self._tables[row][:nblk])
+                self._row_len[crow] = plen
+                self._stats["branch_forks"] += 1
+                self._stats["group_prefill_tokens_avoided"] += plen
+                if token is None:
+                    # immediately a decode row: feed the adopted token
+                    # next step (its write COW-splits the shared block)
+                    self._cur_tok_host[crow] = child.tokens[-1]
+                else:
+                    # rescore path with REAL writes: feed the forced
+                    # token at the branch position and sample from it
+                    self._stats["prefill_tokens"] += 1
+                    self._prefill_jobs[crid] = _PrefillJob(
+                        toks=[int(token)], pos=plen, sample_last=True)
+            else:
+                # no shareable row: queue the child; tokens non-empty
+                # takes the preemption-resume replay, a forced token
+                # takes a plain full prefill — both unshared and exact
+                self._queue.append(child)
+            return crid
 
     @property
     def has_work(self) -> bool:
@@ -1499,6 +1705,14 @@ class RolloutEngine:
         Idempotent — unknown rids return False."""
         from .migration import release_from_engine
         with self._lock:
+            req = self._requests.get(rid)
+            if req is not None:
+                # a group donor migrated away before the spine capture
+                # cannot deliver it here — its followers prefill
+                # locally; a released follower surrenders its graft
+                # slot so the retained spine cannot strand
+                self._group_degrade_if_uncaptured(req)
+                self._group_forget_follower(req)
             out = release_from_engine(self, rid)
             self._schedule()
             return out
@@ -1559,10 +1773,50 @@ class RolloutEngine:
                 or req.max_new_tokens <= 1):
             self._finish_request(req, slot)
 
+    def _group_degrade_if_uncaptured(self, req: "_Request") -> None:
+        # guarded-by: caller
+        """Group donor died before the spine was captured (preemption
+        with emitted tokens, storm truncate-finish, migration release):
+        enqueue the waiting followers as plain unshared prefills.
+        Slower, never inexact. No-op for non-donors and for groups
+        whose spine already landed (followers hold their own forks)."""
+        g = req.group
+        if (g is None or req.rid != g.donor_rid or g.degraded
+                or g.spine is not None or not g.waiters):
+            return
+        g.degraded = True
+        self._stats["group_degrades"] += 1
+        for w in g.waiters:
+            if not w.done:
+                self._queue.append(w)
+        g.waiters = []
+        self._groups.pop(g.gid, None)
+
+    def _group_forget_follower(self, req: "_Request") -> None:
+        # guarded-by: caller
+        """A follower left the group without grafting (migration
+        release while queued/waiting): count its graft slot down so
+        the engine-retained spine fork cannot be stranded, and drop it
+        from the waiter list so a later capture cannot re-enqueue a
+        dead request."""
+        g = req.group
+        if g is None or req.rid == g.donor_rid or req.group_grafted:
+            return
+        req.group_grafted = True
+        g.waiters = [w for w in g.waiters if w.rid != req.rid]
+        g.pending -= 1
+        if g.pending <= 0:
+            if g.spine is not None:
+                self._alloc.release(g.spine)
+                g.spine = None
+            self._groups.pop(g.gid, None)
+
     def _finish_request(self, req: "_Request", slot: int) -> None:
         # guarded-by: caller
         """Mark a request done and either hold or free its slot."""
         req.done = True
+        self._group_degrade_if_uncaptured(req)
+        self._group_forget_follower(req)
         self._slot_req[slot] = None
         if self.kv_layout == "paged":
             self._prefill_jobs.pop(req.rid, None)
@@ -2009,6 +2263,13 @@ class RolloutEngine:
         self._queue.appendleft(req)
         self._stats["kv_preemptions"] += 1
         req.preempt_count += 1
+        if req.tokens:
+            # an uncaptured group donor preempted AFTER emitting tokens
+            # resumes through the recompute replay and can never again
+            # present a pure-prompt spine — degrade the followers now.
+            # A donor preempted mid-prefill (no tokens) simply redoes
+            # the full prefill and the capture still fires.
+            self._group_degrade_if_uncaptured(req)
         if (req.preempt_count >= self.engine_config.max_preempts
                 and req.rid not in self._storm_rids):
             # starvation latch: this request is now non-preemptible
@@ -2335,7 +2596,12 @@ class RolloutEngine:
         # guarded-by: caller
         req.slot = row
         self._slot_req[row] = req
-        self._stats["prefills"] += 1
+        g = req.group
+        group_graft = (g is not None and g.spine is not None
+                       and not g.degraded and req.rid != g.donor_rid
+                       and not req.tokens)
+        if not group_graft:
+            self._stats["prefills"] += 1
         if req.adapter_binding is not None and req.prefix_id is not None:
             # Shared prefixes are BASE-policy KV: any adapter target
             # perturbs the residual stream and hence every later
@@ -2344,6 +2610,35 @@ class RolloutEngine:
             # adapter rows take the full adapter-aware prefill.
             req.prefix_id = None
             self._stats["prefix_cache_misses"] += 1
+        if group_graft:
+            # Group-shared rollout: graft the donor's pure-prompt spine
+            # (refcount bump, zero KV bytes moved) and rescore ONLY the
+            # last prompt token with writes DROPPED — its k/v is
+            # already resident, and these are the same logits the donor
+            # sampled its first token from, so greedy decode is
+            # bitwise-identical to an unshared prefill. The follower's
+            # first real write COW-splits the shared boundary block.
+            self._tables[row] = self._alloc.fork(g.spine)
+            self._row_len[row] = g.spine_len
+            self._stats["group_forks"] += 1
+            self._stats["group_prefill_tokens_avoided"] += g.spine_len - 1
+            self._stats["prefill_tokens"] += 1
+            self._prefill_jobs[req.rid] = _PrefillJob(
+                toks=[req.prompt[-1]], pos=g.spine_len - 1,
+                sample_last=True, drop_writes=True)
+            if not req.group_grafted:
+                # a preempted-then-rescheduled follower re-grafts but
+                # must not double-decrement the pending count
+                req.group_grafted = True
+                g.pending -= 1
+                if g.pending <= 0 and g.spine is not None:
+                    # last follower grafted: drop the engine's retained
+                    # spine fork — the followers' own forks keep the
+                    # blocks alive until each finishes
+                    self._alloc.release(g.spine)
+                    g.spine = None
+                    self._groups.pop(g.gid, None)
+            return
         if req.tokens:
             # preemption resume: recompute prompt + everything emitted
             # except the last token (whose k/v is written when it is
@@ -2660,6 +2955,23 @@ class RolloutEngine:
             if job.toks:
                 continue
             self._prefill_jobs.pop(req.rid, None)
+            g = req.group
+            if (g is not None and req.rid == g.donor_rid
+                    and g.spine is None and not g.degraded
+                    and job.sample_last and not req.tokens):
+                # Donor prefill just completed and its first sampled
+                # token is NOT yet written (tokens are fed the step
+                # after sampling): the table is the pure prompt spine.
+                # Capture an engine-retained fork (released when the
+                # last follower grafts) and wake the waiters — the
+                # donor's own next write COW-splits the boundary block.
+                g.spine = self._alloc.fork(self._tables[row])
+                g.spine_len = self._row_len[row]
+                self._stats["group_prefills"] += 1
+                for w in g.waiters:
+                    if not w.done:
+                        self._queue.append(w)
+                g.waiters = []
             if job.sample_last:
                 tok = int(toks[last_idx])
                 req.tokens.append(tok)

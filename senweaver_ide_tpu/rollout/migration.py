@@ -189,9 +189,19 @@ def checkpoint_from_engine(engine, rid: int, *,
     bs = engine._alloc.block_size
     kv_len = 0
     kv_k = kv_v = None
+    nblk = 0
     if kv_rows:
-        blocks = engine._tables[row]
+        # Gather ONLY the blocks covering live positions, and note that
+        # the gather itself materializes the payload into fresh host
+        # buffers: a row whose table shares forked blocks (group
+        # follower, tree branch) checkpoints an UNSHARED deep copy, so
+        # restoring it elsewhere can never splice a sibling leaf's
+        # later COW writes. The source row's refcounts are untouched
+        # until the coordinator's release.
         kv_len = engine._row_len[row]
+        nblk = min(len(engine._tables[row]),
+                   engine._alloc.blocks_for(kv_len))
+        blocks = engine._tables[row][:nblk]
         k, v = gather_blocks(engine.pool, np.asarray(blocks, np.int32))
         payload = (k, v, engine._key)
     else:
@@ -200,7 +210,7 @@ def checkpoint_from_engine(engine, rid: int, *,
     if kv_rows:
         k_h, v_h, key_h = host
         kv_k, kv_v = blockify_host(np.asarray(k_h), np.asarray(v_h),
-                                   len(engine._tables[row]), bs)
+                                   nblk, bs)
     else:
         (key_h,) = host
     sample = engine.sample

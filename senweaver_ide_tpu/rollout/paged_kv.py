@@ -190,13 +190,20 @@ class BlockAllocator:
 
     def check_leaks(self) -> None:
         """Assert the pool is fully free (every table released). Used
-        by tests as the refcount-leak tripwire."""
+        by tests as the refcount-leak tripwire. Fork-aware: the message
+        separates multiply-referenced (shared fork spine) blocks from
+        singly-held ones, so a leaked group fork reads differently from
+        a plain unreleased table."""
         with self._lock:
             if len(self._free) != self.num_blocks:
                 held = [i for i, r in enumerate(self._ref) if r > 0]
+                shared = [(i, r) for i, r in enumerate(self._ref)
+                          if r > 1]
+                detail = (f"; {len(shared)} shared (block, refs): "
+                          f"{shared[:8]}" if shared else "")
                 raise AssertionError(
                     f"KV block leak: {len(held)} block(s) still "
-                    f"referenced: {held[:16]}")
+                    f"referenced: {held[:16]}{detail}")
 
     # -- allocation ------------------------------------------------------
     def alloc(self, n: int) -> List[int]:
@@ -226,9 +233,13 @@ class BlockAllocator:
 
     def release(self, blocks: Sequence[int]) -> None:
         """Drop one reference per block; blocks reaching refcount 0
-        return to the free list."""
+        return to the free list. Ids at/above ``num_blocks`` are the
+        dropped-write sentinel (see the engine's rescore path) — never
+        refcounted, so they are skipped here, not a double-free."""
         with self._lock:
             for b in blocks:
+                if b >= self.num_blocks:
+                    continue                    # dropped-write sentinel
                 if self._ref[b] <= 0:
                     raise ValueError(f"release of free block {b}")
                 self._ref[b] -= 1
@@ -241,12 +252,36 @@ class BlockAllocator:
         """A new table aliasing every block of ``table`` — the
         **graft**: a shared prefix installs into a consumer with zero
         device bytes moved. Divergence is handled lazily by
-        :meth:`cow_target` at first write."""
+        :meth:`cow_target` at first write.
+
+        Sentinel-safe: a table can carry the ``write_block=num_blocks``
+        dropped-write sentinel (the out-of-range scatter target rescue
+        prefills aim at). The sentinel is preserved positionally in the
+        returned table but never refcounted — ``self._ref`` has exactly
+        ``num_blocks`` entries, so refcounting it would be an
+        IndexError (and a leak in spirit even if it weren't)."""
+        return self.fork_n(table, 1)[0]
+
+    def fork_n(self, table: Sequence[int], n: int) -> List[List[int]]:
+        """``n`` independent aliases of ``table`` in one lock pass —
+        the group-rollout fork: one shared prompt spine, ``n`` GRPO
+        completions. Each returned table is a separate list carrying
+        one reference per real block (``n`` refcount bumps total per
+        block, ``n`` grafts counted); sentinel ids are preserved but
+        never refcounted. All-or-nothing: a free block anywhere in the
+        table raises before any refcount moves."""
+        if n <= 0:
+            return []
         with self._lock:
-            self.retain(table)
-            self._counters["grafts"] += 1
-            self._graft_total.inc()
-            return list(table)
+            real = [b for b in table if b < self.num_blocks]
+            for b in real:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"fork of free block {b}")
+            for b in real:
+                self._ref[b] += n
+            self._counters["grafts"] += n
+            self._graft_total.inc(n)
+            return [list(table) for _ in range(n)]
 
     def cow_target(self, block: int) -> Optional[int]:
         """Copy-on-write check before writing into ``block``: None when

@@ -49,7 +49,7 @@ from .admission import (AdmissionConfig, AdmissionQueue, FleetRequest,
                         REJECT_NO_REPLICAS, REJECT_REPLICA_FAILURE,
                         Rejected, RequestRejected, TRAIN_ROLLOUT)
 from .prefix_store import SharedPrefixStore
-from .replica import DEAD, EngineReplica
+from .replica import DEAD, EngineReplica, ReplicaDead
 from .router import Router
 from .rpc import RpcError
 from .weights import WeightPublisher
@@ -166,6 +166,14 @@ class ServingFleet:
             "Fleet KV pool pressure (0..1): the least-pressured "
             "placeable replica's block-pool utilization.")
         self._kv_pressure_gauge.set(0.0)
+        self._group_submits = registry.counter(
+            "senweaver_serve_group_submits_total",
+            "GRPO groups dispatched through the replica-local "
+            "shared-prefill path (one prefill, KV forked on-replica).")
+        self._group_degrades = registry.counter(
+            "senweaver_serve_group_degrades_total",
+            "GRPO groups that fell back to independent per-member "
+            "submits (no live replica with a group-capable engine).")
         self._continuation_replays = registry.counter(
             "senweaver_serve_continuation_replays_total",
             "Held-slot turn continuations replayed on a survivor after "
@@ -276,6 +284,65 @@ class ServingFleet:
                 self.timelines.finish_rejected(ticket, now,
                                                reason=rejected.reason)
             return ticket
+
+    def submit_group(self, prompt: List[int], group_size: int, *,
+                     max_new_tokens: int = 128,
+                     priority: str = TRAIN_ROLLOUT,
+                     eos_id: Optional[int] = None,
+                     tenant_id: Optional[str] = None) -> List[int]:
+        """GRPO group submit: ``group_size`` decodes of one shared
+        prompt, dispatched to ONE router-picked replica so the engine's
+        shared-prefill path applies (one prefill, KV block tables
+        forked replica-locally — fork sharing never crosses a replica
+        boundary, and a migration checkpoint of any member gathers an
+        unshared payload, so per-leaf migration stays legal). Group
+        submits are the training plane's own rollouts and dispatch
+        immediately, like continuations; when no live replica offers a
+        group-capable engine, members degrade to ``group_size``
+        independent submits through normal admission — slower, never
+        inexact. Returns one fleet ticket per member, donor first."""
+        if group_size < 1:
+            raise ValueError(f"group_size {group_size} < 1")
+        with self._lock:
+            now = self.clock()
+            reqs: List[FleetRequest] = []
+            for _ in range(group_size):
+                ticket = self._next_ticket
+                self._next_ticket += 1
+                self._requests_total.inc(priority=priority)
+                req = FleetRequest(
+                    ticket=ticket, prompt=list(prompt),
+                    max_new_tokens=max_new_tokens, priority=priority,
+                    eos_id=eos_id, tenant_id=tenant_id,
+                    submitted_at=now)
+                self._requests[ticket] = req
+                self.timelines.begin(ticket, priority, now)
+                reqs.append(req)
+            tickets = [r.ticket for r in reqs]
+            replica = self.router.pick(reqs[0])
+            if (replica is not None
+                    and hasattr(replica, "submit_group")
+                    and hasattr(replica.engine, "submit_group")):
+                try:
+                    replica.submit_group(reqs)
+                except (ValueError, KeyError, RpcError, ReplicaDead):
+                    pass    # degrade below — members still dispatch
+                else:
+                    for req in reqs:
+                        req.dispatched_at = now
+                        self.timelines.mark(
+                            req.ticket, "dispatched", now,
+                            replica=replica.replica_id, group=True)
+                    self._group_submits.inc()
+                    return tickets
+            self._group_degrades.inc()
+            for req in reqs:
+                rejected = self.admission.offer(req, now)
+                if rejected is not None:
+                    self._outcomes[req.ticket] = rejected
+                    self.timelines.finish_rejected(
+                        req.ticket, now, reason=rejected.reason)
+            return tickets
 
     def _submit_continuation(self, ticket: int, prompt: List[int], *,
                              max_new_tokens: int, eos_id: Optional[int],

@@ -300,6 +300,40 @@ class EngineReplica:
             self._update_decode_gauge()
             return rid
 
+    def submit_group(self, reqs: List[FleetRequest]) -> List[int]:
+        """Dispatch one GRPO group onto this replica through the
+        engine's shared-prefill path (``engine.submit_group``: one
+        prefill, the followers fork the donor's KV spine — sharing is
+        strictly replica-local). All members land atomically or the
+        call raises and the fleet degrades to per-member dispatch.
+        Members are tracked individually, so completion, migration,
+        and fault handling stay per-leaf."""
+        with self._lock:
+            if self.state != LIVE:
+                raise ReplicaDead(
+                    f"{self.replica_id} is {self.state}, not accepting")
+            lead = reqs[0]
+            kwargs = dict(max_new_tokens=lead.max_new_tokens,
+                          eos_id=lead.eos_id)
+            if lead.tenant_id is not None and self.has_adapter(
+                    lead.tenant_id):
+                kwargs["adapter_id"] = lead.tenant_id
+            t0 = time.perf_counter()
+            rids = self.engine.submit_group(
+                list(lead.prompt), len(reqs), **kwargs)
+            ms = (time.perf_counter() - t0) * 1000.0
+            for rid, req in zip(rids, reqs):
+                req.submit_ms = ms
+                self.inflight[rid] = req
+                req.replica_id = self.replica_id
+                req.engine_rid = rid
+                req.version_at_dispatch = self.weight_version
+            self._consecutive_faults = 0
+            self._inflight_gauge.set(len(self.inflight),
+                                     replica=self.replica_id)
+            self._update_decode_gauge()
+            return rids
+
     def adopt(self, rid: int, req: FleetRequest) -> None:
         """Track an engine rid submitted outside :meth:`submit` (turn
         continuations pin themselves to the held slot's replica and call

@@ -32,6 +32,12 @@ class Trajectory:
     # GRPO step trains with exact importance ratios (no second forward,
     # no retained behavior params).
     behavior_logp: Optional[List[float]] = None
+    # Tree-rollout lineage (rollout/group_tree.py): 0-based positions
+    # WITHIN completion_ids where this trajectory's path through the
+    # rollout tree branched. make_branch_mask aligns them with a
+    # make_batch output so grpo_objective can sharpen credit at split
+    # points (GRPOConfig.branch_credit_boost). None/empty = unbranched.
+    branch_points: Optional[List[int]] = None
 
 
 def _bucket(n: int, minimum: int = 32) -> int:
@@ -101,6 +107,33 @@ def make_batch_logps(trajectories: Sequence[Trajectory],
         keep = pos >= 1
         old[i, pos[keep] - 1] = lps[keep]
     return old
+
+
+def make_branch_mask(trajectories: Sequence[Trajectory],
+                     tokens: np.ndarray,
+                     mask: np.ndarray) -> Optional[np.ndarray]:
+    """Align recorded tree branch points with a make_batch output.
+
+    Returns a (B, S) float32 mask with 1.0 at the completion tokens
+    where the trajectory's rollout-tree path branched, or None when no
+    trajectory carries branch points (the common unbranched batch adds
+    no operand to the train step). Points cropped away by an overlong
+    row's front-drop are silently outside the kept tail."""
+    if not any(t.branch_points for t in trajectories):
+        return None
+    b, s = tokens.shape
+    out = np.zeros((b, s), np.float32)
+    for i, t in enumerate(trajectories):
+        if not t.branch_points:
+            continue
+        pos = np.nonzero(mask[i])[0]
+        n = len(pos)
+        dropped = len(t.completion_ids) - n
+        for p in t.branch_points:
+            q = int(p) - dropped
+            if 0 <= q < n:
+                out[i, pos[q]] = 1.0
+    return out
 
 
 def pad_batch_for_mesh(

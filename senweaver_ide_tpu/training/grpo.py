@@ -43,6 +43,13 @@ class GRPOConfig(NamedTuple):
     # the loss scale is unchanged. gamma=1.0 is exactly uniform credit.
     token_level_advantages: bool = False
     token_adv_gamma: float = 0.98
+    # Tree-rollout credit sharpening (2606.29238: branch points are
+    # where per-token credit is sharpest): boost the credit weight of
+    # tokens AT recorded branch positions by (1 + boost), renormalized
+    # to mean 1 so the loss scale is unchanged. 0.0 = off (exact
+    # historical objective); only engages when the batch carries a
+    # branch mask (tree-planner trajectories).
+    branch_credit_boost: float = 0.0
 
 
 def group_relative_advantages(
@@ -99,6 +106,24 @@ def token_credit_weights(mask: jax.Array, gamma: float) -> jax.Array:
     return w * n_tok / jnp.maximum(norm, 1e-30)
 
 
+def branch_credit_weights(mask: jax.Array, branch_mask: jax.Array, *,
+                          gamma: float, boost: float) -> jax.Array:
+    """(B, S) credit weights for tree-planner trajectories: the
+    gamma-decay base of :func:`token_credit_weights`, with tokens at
+    recorded BRANCH positions scaled by ``1 + boost`` — the split
+    points are where sibling leaves actually diverged, so they carry
+    the sharpest group-relative credit signal. Renormalized to mean 1
+    over each row's masked tokens, so the loss scale (and ``boost=0``
+    behavior) is exactly the unboosted weighting."""
+    base = token_credit_weights(mask, gamma)
+    m = mask.astype(jnp.float32)
+    b = branch_mask.astype(jnp.float32) * m
+    w = base * (1.0 + jnp.float32(boost) * b)
+    n_tok = jnp.sum(m, axis=-1, keepdims=True)
+    norm = jnp.sum(w, axis=-1, keepdims=True)
+    return w * n_tok / jnp.maximum(norm, 1e-30)
+
+
 def token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """(B, S, V) fp32 logits + (B, S) targets → (B, S) log p(target)."""
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -113,6 +138,7 @@ def grpo_objective(
     mask: jax.Array,             # (B, S) True on completion tokens
     config: GRPOConfig = GRPOConfig(),
     ref_logp: Optional[jax.Array] = None,  # (B, S) frozen reference policy
+    branch_mask: Optional[jax.Array] = None,  # (B, S) 1 at branch points
 ) -> tuple:
     """Clipped surrogate + KL penalty. Returns (loss, metrics dict).
 
@@ -120,14 +146,23 @@ def grpo_objective(
     or already per-token (B, S). With ``config.token_level_advantages``
     a (B,) advantage is spread over the response mask with
     :func:`token_credit_weights` (gamma-decay toward the reward) instead
-    of broadcast uniformly."""
+    of broadcast uniformly; a ``branch_mask`` (tree-planner
+    trajectories) with ``config.branch_credit_boost > 0`` additionally
+    sharpens credit at the recorded split points via
+    :func:`branch_credit_weights`."""
     mask = mask.astype(jnp.float32)
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     if advantages.ndim == 2:
         adv = advantages
     else:
         adv = advantages[:, None]
-        if config.token_level_advantages:
+        if branch_mask is not None and config.branch_credit_boost > 0.0:
+            adv = adv * branch_credit_weights(
+                mask, branch_mask,
+                gamma=(config.token_adv_gamma
+                       if config.token_level_advantages else 1.0),
+                boost=config.branch_credit_boost)
+        elif config.token_level_advantages:
             adv = adv * token_credit_weights(mask, config.token_adv_gamma)
 
     ratio = jnp.exp(logp - old_logp)
@@ -183,4 +218,7 @@ def grpo_objective(
         / denom,
         "grad_sparsity": grad_sparsity,
     }
+    if branch_mask is not None:
+        bm = branch_mask.astype(jnp.float32) * mask
+        metrics["branch_token_frac"] = jnp.sum(bm) / denom
     return loss, metrics

@@ -30,7 +30,7 @@ from ..resilience.faults import (FailedEpisode, REASON_ERROR,
                                  episode_retry_delay_s)
 from ..rollout.session import RolloutSession
 from .data import (Trajectory, make_batch, make_batch_logps,
-                   place_batch_for_mesh)
+                   make_branch_mask, place_batch_for_mesh)
 from .grpo import GRPOConfig
 from .trainer import TrainState, train_step
 
@@ -81,6 +81,10 @@ class CollectResult:
     failures: List[FailedEpisode] = dataclasses.field(default_factory=list)
     dropped_groups: List[int] = dataclasses.field(default_factory=list)
     retries: int = 0
+    # Tree-planner shape summary (rollout.group_tree branch_stats) when
+    # collection went through the shared-KV planner; empty for the
+    # session path. Folded into round health as tree_* keys.
+    branch_stats: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     def __iter__(self):
         return iter((self.trajectories, self.episodes))
@@ -195,6 +199,61 @@ def _run_episode(make_session, task_idx: int, task: str, g: int,
         session.close()
 
 
+def collect_tree_trajectories(
+        planner, prompts: Sequence[Sequence[int]], *, group_size: int,
+        max_new_tokens: int = 128, eos_id: Optional[int] = None,
+        adapter_id: Optional[str] = None,
+        reward_fn: Optional[Callable[..., float]] = None,
+) -> CollectResult:
+    """Token-list collection through the shared-KV tree planner.
+
+    The session path below runs G INDEPENDENT episodes per task — G
+    prefills of the same prompt. This path routes token-list tasks
+    through :class:`rollout.group_tree.GroupRollout` instead: one
+    shared prefill per group (engine.submit_group block-table forks)
+    plus BranchPolicy-driven tree splits, so a group of G leaves costs
+    one prefill and only the divergent suffixes' decode. Each finished
+    leaf becomes one Trajectory whose ``branch_points`` (0-based
+    completion indices) carry the tree lineage into the batch
+    (data.make_branch_mask → grpo_objective branch-credit sharpening),
+    and the planner's ``branch_stats`` ride on the CollectResult for
+    the round-health fold.
+
+    ``reward_fn(task_idx, leaf_idx, record)`` scores a leaf record (the
+    planner ``collect()`` dict: spliced ``tokens``/``logps`` plus
+    lineage); without one every leaf gets reward 0.0 and the caller
+    stamps rewards on the returned trajectories afterwards."""
+    tracer = get_tracer()
+    trajectories: List[Trajectory] = []
+    episodes: List[EpisodeRecord] = []
+    with tracer.span("tree_collect", tasks=len(prompts),
+                     group_size=group_size):
+        gids = [planner.submit_group(
+                    list(p), group_size, max_new_tokens=max_new_tokens,
+                    eos_id=eos_id, adapter_id=adapter_id)
+                for p in prompts]
+        planner.run()
+        for ti, (prompt, gid) in enumerate(zip(prompts, gids)):
+            for li, rec in enumerate(planner.collect(gid)):
+                reward = (float(reward_fn(ti, li, rec))
+                          if reward_fn is not None else 0.0)
+                toks = list(rec["tokens"])
+                # Planner branch positions are group-relative emitted
+                # counts ("pos tokens out"); completion index = pos-1.
+                pts = sorted({int(p) - 1 for p in rec["branch_points"]
+                              if 1 <= int(p) <= len(toks)})
+                trajectories.append(Trajectory(
+                    prompt_ids=list(prompt), completion_ids=toks,
+                    reward=reward, group_id=ti,
+                    behavior_logp=list(rec["logps"]),
+                    branch_points=pts or None))
+                episodes.append(EpisodeRecord(
+                    task_idx=ti, reward=reward, n_calls=1, steps=1))
+    stats = {k: float(v) for k, v in planner.branch_stats().items()}
+    return CollectResult(trajectories=trajectories, episodes=episodes,
+                         branch_stats=stats)
+
+
 def collect_group_trajectories(
         make_session: Callable[[], RolloutSession],
         tasks: Sequence[str], *, group_size: int,
@@ -204,6 +263,7 @@ def collect_group_trajectories(
         resilience: Optional[ResilienceConfig] = None,
         round_idx: int = 0,
         retry_sleep: Callable[[float], None] = time.sleep,
+        planner=None,
 ) -> CollectResult:
     """Run group_size episodes per task; one Trajectory per LLM call.
 
@@ -230,7 +290,22 @@ def collect_group_trajectories(
     episodes are dropped whole (their advantages are degenerate), and a
     round losing every group returns empty — the caller's empty-batch
     path skips the update. Without a config the historical raise-on-
-    first-error semantics hold (but in-flight work is drained first)."""
+    first-error semantics hold (but in-flight work is drained first).
+
+    With a ``planner`` (rollout.group_tree.GroupRollout) and TOKEN-LIST
+    tasks, collection routes through :func:`collect_tree_trajectories`
+    instead — one shared prefill per group via KV fork, tree branching
+    per the planner's BranchPolicy; ``reward_override`` is then called
+    as ``reward_override(task_idx, leaf_idx, leaf_record)``."""
+    if planner is not None:
+        if any(isinstance(t, str) for t in tasks):
+            raise ValueError(
+                "planner routing needs token-list tasks (the tree "
+                "planner drives the engine directly; string tasks run "
+                "through sessions — drop the planner argument)")
+        return collect_tree_trajectories(
+            planner, tasks, group_size=group_size,
+            reward_fn=reward_override)
     import concurrent.futures as _fut
 
     # Span context must cross the pool explicitly (contextvars don't):
@@ -367,6 +442,7 @@ def grpo_round(state: TrainState, model_config, mesh,
                health_mitigator=None,
                round_idx: int = 0,
                behavior_stamp: Optional[Tuple[int, int]] = None,
+               planner=None,
                profile_dir: Optional[str] = None) -> RoundResult:
     """One on-policy round: collect → batch → GRPO update(s).
 
@@ -414,7 +490,8 @@ def grpo_round(state: TrainState, model_config, mesh,
             perf_monitor=perf_monitor, engine=engine, lora_base=lora_base,
             ref_params=ref_params, resilience=resilience,
             update_guard=update_guard, health_mitigator=health_mitigator,
-            round_idx=round_idx, behavior_stamp=behavior_stamp)
+            round_idx=round_idx, behavior_stamp=behavior_stamp,
+            planner=planner)
 
 
 def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
@@ -424,7 +501,8 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
                      perf_monitor=None, engine=None,
                      lora_base=None, ref_params=None, resilience=None,
                      update_guard=None, health_mitigator=None,
-                     round_idx=0, behavior_stamp=None) -> RoundResult:
+                     round_idx=0, behavior_stamp=None,
+                     planner=None) -> RoundResult:
     import time as _time
     tracer = get_tracer()
     t0 = _time.monotonic()
@@ -432,7 +510,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         collected = collect_group_trajectories(
             make_session, tasks, group_size=group_size,
             reward_override=reward_override, max_parallel=max_parallel,
-            resilience=resilience, round_idx=round_idx)
+            resilience=resilience, round_idx=round_idx, planner=planner)
     trajectories, episodes = collected.trajectories, collected.episodes
     if behavior_stamp is not None:
         # Lockstep sampling: every episode in the round was collected
@@ -475,6 +553,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         # Recorded behavior logps align on the UNPADDED batch (padding
         # appends rows/columns, leaving existing positions fixed).
         old_logp = make_batch_logps(trajectories, tokens, mask)
+        branch_np = make_branch_mask(trajectories, tokens, mask)
         # Training-health diagnostics: DISPATCH the jitted head on the
         # HOST arrays before placement (it computes asynchronously while
         # the batch is placed); the single device_get happens below,
@@ -494,6 +573,17 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
         tokens, mask, rewards, group_ids, old_logp = place_batch_for_mesh(
             mesh, tokens, mask, rewards, group_ids, old_logp,
             pad_id=pad_id, accum_steps=accum_steps)
+        branch_mask = None
+        if branch_np is not None:
+            # Tree-planner batches: pad the host branch mask to the
+            # placed grid (appended rows/columns are outside the
+            # completion mask, never read) and co-place it with tokens.
+            import jax as _jax
+            branch_np = _np.pad(
+                branch_np,
+                ((0, int(tokens.shape[0]) - branch_np.shape[0]),
+                 (0, int(tokens.shape[1]) - branch_np.shape[1])))
+            branch_mask = _jax.device_put(branch_np, tokens.sharding)
     batch_build_s = _time.monotonic() - t_b
     # The round's ONE health sync, then the pre-step detector pass; a
     # persistent trigger streak may reshape this round's objective
@@ -502,6 +592,11 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
     from ..obs.training_health import evaluate_health, get_health_monitor
     health = finalize_round_health(health_dev)
     health["groups"] = float(len(_uniq))
+    # Tree-planner lineage reaches the diagnostics surface here: the
+    # planner's shape summary rides the round health dict (tree_* keys)
+    # next to the advantage/credit detectors it informs.
+    for k, v in collected.branch_stats.items():
+        health[f"tree_{k}"] = float(v)
     monitor = get_health_monitor()
     pre_triggers = evaluate_health(health, monitor.config)
     health_events: List[str] = []
@@ -552,6 +647,7 @@ def _grpo_round_impl(state, model_config, mesh, make_session, tasks, *,
             state, metrics = train_step(
                 state, model_config, mesh, tokens, mask, rewards,
                 group_ids, old_logp=old, ref_logp=ref,
+                branch_mask=branch_mask,
                 grpo_config=grpo_config, accum_steps=accum_steps,
                 lora_base=lora_base)
             if update_guard is not None:

@@ -150,6 +150,7 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                      rewards: jax.Array, group_ids: jax.Array,
                      old_logp: Optional[jax.Array],
                      ref_logp: Optional[jax.Array],
+                     branch_mask: Optional[jax.Array],
                      grpo_config: GRPOConfig,
                      num_groups: int,
                      accum_steps: int,
@@ -193,12 +194,17 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     # and the static has_ref closure keeps the KL term genuinely off.
     has_ref = ref_logp is not None
     has_old = old_logp is not None
+    has_branch = branch_mask is not None
     zeros_f32 = jnp.zeros_like(micro(targets), dtype=jnp.float32)
     scan_xs = (micro(inputs), micro(targets), micro(tgt_mask), micro(adv),
                micro(ref_logp) if has_ref else zeros_f32,
-               micro(old_logp) if has_old else zeros_f32)
+               micro(old_logp) if has_old else zeros_f32,
+               # branch mask is (B, S) like completion_mask; the shift
+               # to target layout mirrors tgt_mask above.
+               micro(branch_mask[:, 1:].astype(jnp.float32))
+               if has_branch else zeros_f32)
 
-    def loss_fn(params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old):
+    def loss_fn(params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old, m_branch):
         if lora_base is not None:
             # LoRA: `params` is the adapter tree; the frozen base rides
             # as a closed-over constant — gradients and optimizer state
@@ -211,8 +217,10 @@ def _grpo_step(state: TrainState, config: ModelConfig,
                                      with_aux=True, mesh=mesh)
         logp = token_logprobs(logits, m_tgt)
         olp = m_old if has_old else jax.lax.stop_gradient(logp)
-        loss, metrics = grpo_objective(logp, olp, m_adv, m_mask, grpo_config,
-                                       ref_logp=m_ref if has_ref else None)
+        loss, metrics = grpo_objective(
+            logp, olp, m_adv, m_mask, grpo_config,
+            ref_logp=m_ref if has_ref else None,
+            branch_mask=m_branch if has_branch else None)
         if config.num_experts > 0:
             loss = loss + grpo_config.moe_aux_coef * moe_aux
         return loss, (metrics, moe_aux)
@@ -226,12 +234,15 @@ def _grpo_step(state: TrainState, config: ModelConfig,
     # loss does.
     acc_keys = ("pg_loss", "kl", "entropy", "ratio_mean", "clip_frac",
                 "grad_sparsity")
+    if has_branch:
+        acc_keys = acc_keys + ("branch_token_frac",)
 
     def body(carry, m):
         grads_acc, loss_acc, metr_acc = carry
-        m_in, m_tgt, m_mask, m_adv, m_ref, m_old = m
+        m_in, m_tgt, m_mask, m_adv, m_ref, m_old, m_branch = m
         (loss, (metrics, moe_aux)), grads = grad_fn(
-            state.params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old)
+            state.params, m_in, m_tgt, m_mask, m_adv, m_ref, m_old,
+            m_branch)
         w = jnp.maximum(jnp.sum(m_mask), 0.0) / total_denom
         grads_acc = jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32) * w, grads_acc, grads)
@@ -282,6 +293,7 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
                rewards: jax.Array, group_ids: jax.Array, *,
                old_logp: Optional[jax.Array] = None,
                ref_logp: Optional[jax.Array] = None,
+               branch_mask: Optional[jax.Array] = None,
                grpo_config: GRPOConfig = GRPOConfig(),
                optimizer: Optional[optax.GradientTransformation] = None,
                num_groups: Optional[int] = None,
@@ -310,7 +322,8 @@ def train_step(state: TrainState, config: ModelConfig, mesh: Optional[Mesh],
     opt = optimizer or state.opt or _DEFAULT_OPT
     n_groups = num_groups or int(tokens.shape[0])
     args = (state, config, opt, tokens, completion_mask, rewards, group_ids,
-            old_logp, ref_logp, grpo_config, n_groups, accum_steps)
+            old_logp, ref_logp, branch_mask, grpo_config, n_groups,
+            accum_steps)
     # Span measures DISPATCH of the jitted step (results are async);
     # callers wanting completion time force with float()/block_until_ready
     # inside their own enclosing span (rl_loop does).

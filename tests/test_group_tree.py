@@ -1,0 +1,474 @@
+"""Group-shared rollout and tree-structured branching (ISSUE 18).
+
+The acceptance invariants:
+
+- **one prefill per group**: a GRPO group of G decodes of one shared
+  prompt pays exactly ONE prefill (counter-asserted) — followers graft
+  the donor's block-table spine with refcount bumps and a one-token
+  dropped-write rescore;
+- **leaf exactness**: every leaf of a rollout tree — group followers
+  and mid-trajectory branches, at every depth, with speculation on or
+  off, and under an active LoRA adapter — produces greedy output
+  bitwise-identical to an unshared, independently-prefilled decode of
+  the same stream;
+- **never trade exactness for sharing**: donor death before spine
+  capture degrades followers to plain prefills; block exhaustion
+  preempts through the standard recompute path; a mid-roll adapter
+  publish cannot mix policy versions across a tree (children pin the
+  parent's binding). Every scenario ends leak-free.
+
+Everything is hermetic on CPU with the tiny test model.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from senweaver_ide_tpu import obs
+from senweaver_ide_tpu.models import init_params, tiny_test
+from senweaver_ide_tpu.rollout import (AdapterPool, AdapterPoolConfig,
+                                       BranchPolicy, EngineConfig,
+                                       GroupRollout, RolloutEngine)
+from senweaver_ide_tpu.rollout.paged_kv import BlockAllocator
+from senweaver_ide_tpu.rollout.sampler import SampleParams
+from senweaver_ide_tpu.serve import Completed, ServingFleet
+from senweaver_ide_tpu.training.lora import init_lora, merge_lora
+from senweaver_ide_tpu.training.rl_loop import (collect_group_trajectories,
+                                                collect_tree_trajectories)
+
+GREEDY = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+PROMPT = [5, 9, 2, 7, 1, 3]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs._reset_for_tests()
+    yield
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def model():
+    config = tiny_test()
+    params = init_params(config, jax.random.PRNGKey(0))
+    return params, config
+
+
+@pytest.fixture(scope="module")
+def draft(model):
+    _, config = model
+    draft_cfg = dataclasses.replace(config, num_layers=2,
+                                    name="tiny-draft")
+    return init_params(draft_cfg, jax.random.PRNGKey(1)), draft_cfg
+
+
+def make_lora(config, seed, rank=4, scale=0.05):
+    lora = init_lora(config, jax.random.PRNGKey(seed), rank=rank)
+    for k in list(lora["layers"]):
+        if k.endswith("_lora_b"):
+            lora["layers"][k] = jax.random.normal(
+                jax.random.PRNGKey(seed + 100), lora["layers"][k].shape,
+                lora["layers"][k].dtype) * scale
+    return lora
+
+
+def make_engine(model, *, num_slots=8, max_len=96, num_blocks=None,
+                pool=None):
+    params, config = model
+    return RolloutEngine(
+        params, config, num_slots=num_slots, max_len=max_len,
+        sample=GREEDY, adapter_pool=pool,
+        engine_config=EngineConfig(kv_layout="paged", block_size=4,
+                                   num_blocks=num_blocks))
+
+
+def independent(model, prompt, max_new, *, lora=None):
+    """The unshared reference: a fresh engine, a plain prefill."""
+    params, config = model
+    p = merge_lora(params, lora) if lora is not None else params
+    eng = RolloutEngine(p, config, num_slots=2, max_len=96, sample=GREEDY,
+                        engine_config=EngineConfig(kv_layout="paged",
+                                                   block_size=4))
+    rid = eng.submit(list(prompt), max_new_tokens=max_new)
+    return eng.run()[rid]
+
+
+def counter_value(name, **labels):
+    m = obs.get_registry().get(name)
+    return 0.0 if m is None else m.value(**labels)
+
+
+# ---- allocator-level fork (satellite 1) ----------------------------------
+
+def test_fork_skips_dropped_write_sentinel():
+    """A table carrying the write_block=num_blocks sentinel forks
+    positionally intact, the sentinel never refcounted."""
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    table = alloc.alloc(2)
+    forked = alloc.fork(table + [alloc.num_blocks])
+    assert forked == table + [alloc.num_blocks]
+    alloc.release(forked)
+    alloc.release(table)
+    alloc.check_leaks()
+
+
+def test_fork_n_all_or_nothing():
+    """fork_n of a table containing a freed block raises before ANY
+    refcount moves — no partial group graft to unwind."""
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    table = alloc.alloc(3)
+    alloc.release([table[1]])
+    with pytest.raises(ValueError):
+        alloc.fork_n(table, 4)
+    alloc.release([table[0], table[2]])
+    alloc.check_leaks()
+
+
+def test_fork_n_refcounts_and_release():
+    alloc = BlockAllocator(num_blocks=8, block_size=4)
+    table = alloc.alloc(2)
+    tables = alloc.fork_n(table, 3)
+    assert len(tables) == 3 and all(t == table for t in tables)
+    for t in tables:
+        alloc.release(t)
+    alloc.release(table)
+    alloc.check_leaks()
+
+
+# ---- group-shared prefill: one prefill, bitwise-exact --------------------
+
+def test_group_of_8_pays_exactly_one_prefill(model):
+    """The acceptance headline: G=8 shared submit == 8 independent
+    decodes bitwise, with the prefill counter at exactly 1 and zero
+    leaked blocks after drain."""
+    ref = independent(model, PROMPT, 12)
+    eng = make_engine(model)
+    rids = eng.submit_group(PROMPT, 8, max_new_tokens=12)
+    assert len(rids) == 8
+    out = eng.run()
+    for r in rids:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    s = eng.stats()
+    assert s["prefills"] == 1
+    assert s["group_prefills"] == 1
+    assert s["group_forks"] == 7
+    assert s["group_degrades"] == 0
+    assert s["group_prefill_tokens_avoided"] >= 7 * (len(PROMPT) - 1)
+    eng._alloc.check_leaks()
+
+
+def test_group_exact_with_speculation(model, draft):
+    """Spine grafts under a speculating engine: outputs stay identical
+    to the unspeculated unshared reference."""
+    ref = independent(model, PROMPT, 12)
+    draft_params, draft_cfg = draft
+    eng = make_engine(model)
+    eng.enable_speculation(draft_params, draft_cfg, depth=4)
+    rids = eng.submit_group(PROMPT, 4, max_new_tokens=12)
+    out = eng.run()
+    for r in rids:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    assert eng.stats()["group_prefills"] == 1
+    eng._alloc.check_leaks()
+    eng.spec_check_leaks()
+
+
+def test_group_exact_under_active_adapter(model):
+    """A group submitted under a LoRA tenant matches the merged-params
+    unshared reference — the graft shares adapter-conditioned KV."""
+    params, config = model
+    lora = make_lora(config, seed=3)
+    ref = independent(model, PROMPT, 10, lora=lora)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(model, pool=pool)
+    eng.publish_adapter("t1", lora)
+    rids = eng.submit_group(PROMPT, 4, max_new_tokens=10,
+                            adapter_id="t1")
+    out = eng.run()
+    for r in rids:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    assert eng.stats()["group_prefills"] == 1
+    eng._alloc.check_leaks()
+
+
+def test_group_more_members_than_slots_queues_exactly(model):
+    """G larger than the slot pool: surplus followers wait in the
+    queue and still decode the exact reference when rows free up."""
+    ref = independent(model, PROMPT, 8)
+    eng = make_engine(model, num_slots=3)
+    rids = eng.submit_group(PROMPT, 6, max_new_tokens=8)
+    out = eng.run()
+    for r in rids:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    eng._alloc.check_leaks()
+
+
+# ---- tree branching: exact at every depth --------------------------------
+
+def _step_until(eng, rid, n):
+    while len(eng.result(rid)) < n and not eng.is_done(rid):
+        eng.step()
+
+
+@pytest.mark.parametrize("spec", [False, True])
+@pytest.mark.parametrize("with_lora", [False, True])
+def test_tree_fork_exact_at_every_depth(model, draft, spec, with_lora):
+    """Depth-1 sampled and forced branches plus a depth-2 fork of a
+    fork, each bitwise-equal to an independent decode of its stream —
+    crossed with speculation on/off and an active adapter."""
+    params, config = model
+    lora = make_lora(config, seed=5) if with_lora else None
+    pool = AdapterPool(config, AdapterPoolConfig()) if with_lora else None
+    eng = make_engine(model, pool=pool)
+    if with_lora:
+        eng.publish_adapter("t1", lora)
+    if spec:
+        draft_params, draft_cfg = draft
+        eng.enable_speculation(draft_params, draft_cfg, depth=4)
+    root = eng.submit(PROMPT, max_new_tokens=14,
+                      adapter_id="t1" if with_lora else None)
+    _step_until(eng, root, 4)
+
+    c_sampled = eng.fork_request(root)               # depth 1, sampled
+    c_forced = eng.fork_request(root, token=7)       # depth 1, forced
+    _step_until(eng, c_sampled, len(eng.result(c_sampled)) + 3)
+    c_deep = eng.fork_request(c_sampled, token=2)    # depth 2
+    eng.run()
+
+    for rid in (root, c_sampled, c_forced, c_deep):
+        stream = eng._requests[rid].prompt
+        got = eng.result(rid)
+        ref = independent(model, stream, len(got), lora=lora)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    assert eng.stats()["branch_forks"] >= 1
+    eng._alloc.check_leaks()
+    if spec:
+        eng.spec_check_leaks()
+
+
+def test_fork_validation_errors(model):
+    eng = make_engine(model)
+    rid = eng.submit(PROMPT, max_new_tokens=6)
+    with pytest.raises(ValueError):
+        eng.fork_request(rid)            # still prefilling
+    with pytest.raises(KeyError):
+        eng.fork_request(12345)
+    eng.run()
+    with pytest.raises(ValueError):
+        eng.fork_request(rid)            # done
+    eng._alloc.check_leaks()
+
+
+# ---- chaos: degrade paths never trade exactness --------------------------
+
+def test_donor_death_before_capture_degrades_group(model):
+    """Release the donor before its prefill completes: followers fall
+    back to plain unshared prefills — slower, still exact."""
+    ref = independent(model, PROMPT, 8)
+    eng = make_engine(model)
+    rids = eng.submit_group(PROMPT, 3, max_new_tokens=8)
+    assert eng.release_request(rids[0])
+    out = eng.run()
+    for r in rids[1:]:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    s = eng.stats()
+    assert s["group_degrades"] == 1
+    assert s["group_prefills"] == 0
+    eng._alloc.check_leaks()
+
+
+def test_donor_leaf_death_mid_decode_releases_refcounts(model):
+    """Killing the donor AFTER grafts only drops its refcounts; the
+    forked leaves keep decoding the exact reference."""
+    ref = independent(model, PROMPT, 10)
+    eng = make_engine(model)
+    rids = eng.submit_group(PROMPT, 4, max_new_tokens=10)
+    _step_until(eng, rids[0], 2)        # donor captured, grafts landed
+    assert eng.release_request(rids[0])
+    out = eng.run()
+    for r in rids[1:]:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    assert eng.stats()["group_prefills"] == 1
+    eng._alloc.check_leaks()
+
+
+def test_block_exhaustion_mid_group_preempts_not_corrupts(model):
+    """A pool too small for the whole group at once: members preempt
+    through the recompute path (worst case truncate-finish at the
+    storm cap) — every emitted token is still an exact prefix of the
+    unshared reference, and the allocator ends leak-free."""
+    ref = list(independent(model, PROMPT, 12))
+    eng = make_engine(model, num_slots=4, num_blocks=12)
+    rids = eng.submit_group(PROMPT, 4, max_new_tokens=12)
+    out = eng.run()
+    assert any(len(out[r]) == len(ref) for r in rids)
+    for r in rids:
+        got = list(out[r])
+        assert got == ref[:len(got)]     # never inexact, only shorter
+    eng._alloc.check_leaks()
+
+
+def test_branch_under_mid_roll_publish_pins_version(model):
+    """An adapter publish landing mid-tree must not mix policies: the
+    group and its branches stay pinned to the submit-time version and
+    match the v1 merged reference end to end."""
+    params, config = model
+    l_v1 = make_lora(config, seed=11)
+    l_v2 = make_lora(config, seed=12, scale=0.2)
+    ref = independent(model, PROMPT, 12, lora=l_v1)
+    pool = AdapterPool(config, AdapterPoolConfig())
+    eng = make_engine(model, pool=pool)
+    eng.publish_adapter("t1", l_v1)
+    rids = eng.submit_group(PROMPT, 3, max_new_tokens=12,
+                            adapter_id="t1")
+    _step_until(eng, rids[0], 3)
+    eng.publish_adapter("t1", l_v2)     # mid-roll publish
+    child = eng.fork_request(rids[0])   # fork AFTER the publish
+    v1 = eng._requests[rids[0]].adapter_binding.version
+    assert eng._requests[child].adapter_binding.version == v1
+    out = eng.run()
+    for r in rids:
+        np.testing.assert_array_equal(np.asarray(out[r]), np.asarray(ref))
+    # the child continues the donor's v1 stream, not a v2 one
+    stream = eng._requests[child].prompt
+    cref = independent(model, stream, len(out[child]), lora=l_v1)
+    np.testing.assert_array_equal(np.asarray(out[child]),
+                                  np.asarray(cref))
+    eng._alloc.check_leaks()
+
+
+# ---- the GroupRollout planner --------------------------------------------
+
+def test_planner_branches_on_trigger_token(model):
+    """BranchPolicy(branch_tokens=...) splits exactly when the trigger
+    appears; every leaf (root or branched) matches its independent
+    reference and carries honest lineage metadata."""
+    ref = independent(model, PROMPT, 12)
+    trigger = int(ref[3])
+    eng = make_engine(model)
+    gr = GroupRollout(eng, policy=BranchPolicy(
+        max_leaves=6, max_depth=2, branch_width=2,
+        min_tokens_between=1, branch_tokens=(trigger,)))
+    gid = gr.submit_group(PROMPT, 2, max_new_tokens=12)
+    gr.run()
+    recs = gr.collect(gid)
+    assert len(recs) > 2                 # branches actually spawned
+    assert any(r["depth"] > 0 for r in recs)
+    for rec in recs:
+        leaf = gr._leaves[rec["rid"]]
+        assert len(rec["logps"]) == len(rec["tokens"])
+        if rec["depth"] == 0:
+            assert rec["parent_rid"] is None
+            np.testing.assert_array_equal(np.asarray(rec["tokens"]),
+                                          np.asarray(ref))
+        else:
+            assert rec["parent_rid"] in gr._leaves
+            assert rec["branch_pos"] in rec["branch_points"]
+            stream = list(PROMPT) + list(leaf.inherited)
+            own = eng.result(rec["rid"])
+            iref = independent(model, stream, len(own))
+            np.testing.assert_array_equal(np.asarray(own),
+                                          np.asarray(iref))
+    assert counter_value("senweaver_rollout_group_prefills_total") == 1.0
+    assert counter_value("senweaver_rollout_group_branch_events_total") >= 1
+    assert counter_value("senweaver_rollout_group_forks_total") >= 2
+    eng._alloc.check_leaks()
+
+
+def test_planner_respects_leaf_and_depth_caps(model):
+    eng = make_engine(model, num_slots=8)
+    gr = GroupRollout(eng, policy=BranchPolicy(
+        max_leaves=4, max_depth=1, branch_width=2,
+        min_tokens_between=1, logp_threshold=0.0))   # always trigger
+    gid = gr.submit_group(PROMPT, 2, max_new_tokens=10)
+    gr.run()
+    recs = gr.collect(gid)
+    assert len(recs) <= 4
+    assert max(r["depth"] for r in recs) <= 1
+    stats = gr.branch_stats()
+    assert stats["leaves"] == len(recs)
+    assert stats["max_depth"] <= 1
+    eng._alloc.check_leaks()
+
+
+def test_planner_forced_tokens_spawn_alternative_children(model):
+    """forced_tokens children replace the parent's last sampled token
+    and carry a pinned 0.0 logp at the forced position."""
+    eng = make_engine(model)
+    gr = GroupRollout(eng, policy=BranchPolicy(
+        max_leaves=4, max_depth=1, min_tokens_between=2,
+        logp_threshold=0.0, forced_tokens=(7,)))
+    gid = gr.submit_group(PROMPT, 1, max_new_tokens=10)
+    gr.run()
+    recs = gr.collect(gid)
+    forced = [r for r in recs if r["forced_token"] == 7]
+    assert forced
+    for rec in forced:
+        pos = rec["branch_pos"]
+        assert rec["tokens"][pos - 1] == 7
+        assert rec["logps"][pos - 1] == 0.0
+    eng._alloc.check_leaks()
+
+
+# ---- training-plane routing ----------------------------------------------
+
+def test_collect_tree_trajectories_shapes_and_lineage(model):
+    eng = make_engine(model)
+    gr = GroupRollout(eng, policy=BranchPolicy(
+        max_leaves=4, max_depth=1, min_tokens_between=2,
+        logp_threshold=0.0))
+    res = collect_tree_trajectories(
+        gr, [PROMPT], group_size=2, max_new_tokens=8,
+        reward_fn=lambda ti, li, rec: float(li))
+    assert len(res.trajectories) == len(res.episodes) >= 2
+    assert res.branch_stats["groups"] == 1.0
+    for t in res.trajectories:
+        assert t.prompt_ids == list(PROMPT)
+        assert len(t.behavior_logp) == len(t.completion_ids)
+        if t.branch_points:
+            assert all(0 <= p < len(t.completion_ids)
+                       for p in t.branch_points)
+    rewards = {t.reward for t in res.trajectories}
+    assert len(rewards) > 1              # reward_fn reached every leaf
+    eng._alloc.check_leaks()
+
+
+def test_collect_group_trajectories_planner_routing(model):
+    eng = make_engine(model)
+    gr = GroupRollout(eng)
+    res = collect_group_trajectories(None, [PROMPT], group_size=3,
+                                     planner=gr)
+    assert len(res.trajectories) == 3
+    with pytest.raises(ValueError):
+        collect_group_trajectories(None, ["a string task"], group_size=2,
+                                   planner=gr)
+
+
+# ---- fleet integration ---------------------------------------------------
+
+def test_fleet_group_submit_is_replica_local(model):
+    """ServingFleet.submit_group lands the whole group on ONE replica
+    (fork sharing never crosses a replica boundary): every member
+    completes the exact reference and the host engine shows one group
+    prefill."""
+    ref = independent(model, PROMPT, 8)
+    fleet = ServingFleet([make_engine(model, num_slots=6)
+                          for _ in range(2)])
+    tickets = fleet.submit_group(PROMPT, 4, max_new_tokens=8)
+    assert len(tickets) == 4
+    fleet.run()
+    homes = set()
+    for t in tickets:
+        out = fleet.outcome(t)
+        assert isinstance(out, Completed)
+        np.testing.assert_array_equal(np.asarray(out.tokens),
+                                      np.asarray(ref))
+        homes.add(fleet._requests[t].replica_id)
+    assert len(homes) == 1
+    host = fleet._replica_by_id(homes.pop())
+    assert host.engine.stats()["group_prefills"] == 1
+    assert counter_value("senweaver_serve_group_submits_total") == 1.0
+    for r in fleet.replicas:
+        r.engine._alloc.check_leaks()

@@ -697,3 +697,66 @@ def test_remote_checkpoint_retry_replays_snapshot(model):
     source.engine.resume_request(req.engine_rid)
     run_fleet(fleet, clock)
     assert isinstance(fleet.outcome(t), Completed)
+
+
+# ---- forked-row checkpoints (group-shared rollout, ISSUE 18) -------------
+
+def test_forked_row_checkpoint_is_unshared_deep_copy(model):
+    """Migrating one leaf of a KV-shared GRPO group: the checkpoint's
+    payload must be an UNSHARED copy of the spine (gather materializes
+    it), so the migrated leaf is token-exact on the target, the
+    sibling keeps decoding untouched on the source, and the source
+    release only drops refcounts on the shared blocks."""
+    ref = reference(model)
+    a = make_engine(model, num_slots=4,
+                    engine_config=EngineConfig(kv_layout="paged",
+                                               block_size=4))
+    b = make_engine(model, engine_config=EngineConfig(kv_layout="paged",
+                                                      block_size=4))
+    donor, leaf = a.submit_group(PROMPT, 2, max_new_tokens=12)
+    for _ in range(4):
+        a.step()
+    assert a.stats()["group_prefills"] == 1     # spine really shared
+    ckpt = a.checkpoint_request(leaf)
+    new_rid = b.restore_request(ckpt)
+    assert a.release_request(leaf)              # refcount drop only
+    # the sibling's decode on the source must be untouched by the
+    # departure, and the migrated leaf exact on the target
+    out_a = a.run()
+    out_b = b.run()
+    np.testing.assert_array_equal(np.asarray(out_a[donor]),
+                                  np.asarray(ref))
+    np.testing.assert_array_equal(np.asarray(out_b[new_rid]),
+                                  np.asarray(ref))
+    a._alloc.check_leaks()
+    b._alloc.check_leaks()
+
+
+def test_forked_branch_child_checkpoint_midstream(model):
+    """A tree-branch child (fork_request) checkpoints mid-decode like
+    any row: restored output equals the unmigrated reference of its
+    full stream, and the parent keeps its shared blocks."""
+    a = make_engine(model, num_slots=4,
+                    engine_config=EngineConfig(kv_layout="paged",
+                                               block_size=4))
+    root = a.submit(PROMPT, max_new_tokens=12)
+    while len(a.result(root)) < 4:
+        a.step()
+    child = a.fork_request(root, token=7)
+    for _ in range(3):
+        a.step()
+    ckpt = a.checkpoint_request(child)
+    stream = list(a._requests[child].prompt)
+    b = make_engine(model, engine_config=EngineConfig(kv_layout="paged",
+                                                      block_size=4))
+    new_rid = b.restore_request(ckpt)
+    a.release_request(child)
+    out_a = a.run()
+    out_b = b.run()
+    cref = reference(model, prompt=stream, max_new=len(out_b[new_rid]))
+    np.testing.assert_array_equal(np.asarray(out_b[new_rid]),
+                                  np.asarray(cref))
+    np.testing.assert_array_equal(np.asarray(out_a[root]),
+                                  np.asarray(reference(model)))
+    a._alloc.check_leaks()
+    b._alloc.check_leaks()
