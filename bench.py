@@ -744,6 +744,105 @@ def _measure_kv_pressure(*, num_requests: int = 6, prefix_len: int = 16,
     }
 
 
+def _measure_kv_quant(*, num_requests: int = 8, prefix_len: int = 16,
+                      decode_tokens: int = 12) -> dict:
+    """The quantized KV ladder's capacity payoff (ISSUE 19): the same
+    2x-over-capacity shared-prefix workload against the same DEVICE
+    BYTE budget, bf16 vs int8. Quantized blocks are ~3x smaller, so the
+    int8 pool holds ~3x the blocks in the same bytes — the pressure
+    ladder (evictions, preemption-recompute) fires less, and aggregate
+    tok/s rises. The acceptance gate: int8 strictly fewer
+    evictions + preemptions, higher tok/s, token streams within the
+    declared divergence budget, and a leak-free drain on both rungs."""
+    import time as _time
+
+    import jax
+
+    from senweaver_ide_tpu import obs
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.paged_kv import (init_paged_pool,
+                                                    pool_bytes_per_block)
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prefix = [(j * 11) % 200 + 2 for j in range(prefix_len)]
+    prompts = [prefix + [(i * 7 + j) % 200 + 2 for j in range(4)]
+               for i in range(num_requests)]
+
+    # Equalize the DEVICE BYTE budget, not the block count: a bf16 pool
+    # of 10 blocks sets the budget; the int8 pool gets however many
+    # blocks fit in the same bytes (scales included — the ratio is
+    # honest about the f32 scale overhead).
+    block_size = 4
+    bf16_blocks = 10
+    budget = pool_bytes_per_block(
+        init_paged_pool(config, bf16_blocks, block_size)) * bf16_blocks
+    int8_blocks = budget // pool_bytes_per_block(
+        init_paged_pool(config, bf16_blocks, block_size,
+                        kv_dtype="int8"))
+
+    def run(kv_dtype: str, num_blocks: int) -> dict:
+        obs._reset_for_tests()
+        eng = RolloutEngine(
+            params, config, num_slots=2, max_len=128, sample=greedy,
+            engine_config=EngineConfig(
+                kv_layout="paged", block_size=block_size,
+                num_blocks=num_blocks, kv_dtype=kv_dtype,
+                host_tier=False))
+        pid = eng.register_prefix(prefix)
+        rids = [eng.submit(p, max_new_tokens=decode_tokens,
+                           prefix_id=pid) for p in prompts]
+        t0 = _time.perf_counter()
+        out = eng.run()
+        dt = _time.perf_counter() - t0
+        st = eng.stats()
+        if pid in eng._prefixes:
+            eng.release_prefix(pid)
+        eng._alloc.check_leaks()    # leak-free drain or the case errors
+        return {"tok_s": sum(len(out[r]) for r in rids) / dt,
+                "tokens": [out[r] for r in rids], "stats": st}
+
+    t_warm = _time.perf_counter()
+    run("bf16", bf16_blocks)        # compile warmup, both rungs
+    run("int8", int8_blocks)
+    compile_s = _time.perf_counter() - t_warm
+    bf16 = run("bf16", bf16_blocks)
+    t0 = _time.perf_counter()
+    q8 = run("int8", int8_blocks)
+    _stamp_timing("kv_quant", compile_s, _time.perf_counter() - t0)
+    obs._reset_for_tests()
+
+    total = sum(len(s) for s in bf16["tokens"])
+    match = sum(int(a == b)
+                for s1, s2 in zip(bf16["tokens"], q8["tokens"])
+                for a, b in zip(s1, s2))
+    press = lambda st: (st.get("prefix_evictions", 0)
+                        + st.get("kv_preemptions", 0))
+    return {
+        "num_requests": num_requests,
+        "kv_bytes_budget": int(budget),
+        "bf16_blocks": bf16_blocks,
+        "int8_blocks": int(int8_blocks),
+        "bf16_tok_s": round(bf16["tok_s"], 1),
+        "int8_tok_s": round(q8["tok_s"], 1),
+        "int8_over_bf16": round(
+            q8["tok_s"] / max(1e-9, bf16["tok_s"]), 3),
+        "evictions_bf16": bf16["stats"].get("prefix_evictions", 0),
+        "evictions_int8": q8["stats"].get("prefix_evictions", 0),
+        "preemptions_bf16": bf16["stats"].get("kv_preemptions", 0),
+        "preemptions_int8": q8["stats"].get("kv_preemptions", 0),
+        "pressure_events_bf16": press(bf16["stats"]),
+        "pressure_events_int8": press(q8["stats"]),
+        "token_match_rate": round(match / max(1, total), 3),
+        "bytes_per_block_bf16": bf16["stats"]["kv_bytes_per_block"],
+        "bytes_per_block_int8": q8["stats"]["kv_bytes_per_block"],
+    }
+
+
 def _measure_fleet_remote(*, n_replicas: int = 4,
                           n_requests: int = 8) -> dict:
     """Cross-host dispatch economics: a loopback remote fleet
@@ -1611,6 +1710,16 @@ def main() -> None:
         extra["kv_pressure"] = _measure_kv_pressure()
     except Exception as e:
         extra["kv_pressure"] = f"error: {type(e).__name__}: {e}"[:200]
+
+    # Quantized KV ladder economics (int8 vs bf16 blocks against the
+    # same device byte budget at 2x over-capacity;
+    # rollout/paged_kv.py kv_dtype). Ladder-level, so tiny-test covers
+    # it on every backend.
+    try:
+        _log("kv quant measure: kv_quant")
+        extra["kv_quant"] = _measure_kv_quant()
+    except Exception as e:
+        extra["kv_quant"] = f"error: {type(e).__name__}: {e}"[:200]
 
     # Concurrency-adaptive speculation economics (fixed depth-8 vs the
     # depth controller under an overloaded fleet). Protocol-level, so

@@ -298,6 +298,46 @@ def _case_kv_pressure() -> Dict[str, Any]:
             "compiles_total": _ledger_compiles("engine.fused_step")}
 
 
+def _case_kv_quant() -> Dict[str, Any]:
+    """The quantized KV ladder (ISSUE 19): the pressured shared-prefix
+    workload on an int8 pool. Gates that quantize-at-write rides the
+    ONE fused-step signature — scale scatter, COW, preemption replay
+    and prefix grafts must add no steady-state retraces — and tracks
+    the quantized end-to-end time run over run."""
+    import jax
+
+    from senweaver_ide_tpu.models import init_params, tiny_test
+    from senweaver_ide_tpu.rollout import EngineConfig, RolloutEngine
+    from senweaver_ide_tpu.rollout.sampler import SampleParams
+
+    config = tiny_test()
+    params = jax.block_until_ready(
+        init_params(config, jax.random.PRNGKey(0)))
+    greedy = SampleParams(temperature=0.0, top_k=0, top_p=1.0)
+    prefix = [(j * 11) % 200 + 2 for j in range(16)]
+    prompts = [prefix + [(i * 7 + j) % 200 + 2 for j in range(4)]
+               for i in range(6)]
+
+    def run():
+        eng = RolloutEngine(
+            params, config, num_slots=2, max_len=128, sample=greedy,
+            engine_config=EngineConfig(
+                kv_layout="paged", block_size=4, num_blocks=10,
+                kv_dtype="int8", host_tier=False))
+        pid = eng.register_prefix(prefix)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=12, prefix_id=pid)
+        eng.run()
+        if pid in eng._prefixes:
+            eng.release_prefix(pid)
+        eng._alloc.check_leaks()            # drain must stay leak-free
+
+    run()                                   # warmup: compiles land here
+    step_s, leaked = _timed_window(run, "engine.fused_step", iters=3)
+    return {"step_s": step_s, "steady_compiles": leaked,
+            "compiles_total": _ledger_compiles("engine.fused_step")}
+
+
 def _case_migration() -> Dict[str, Any]:
     """The live-migration hot path (ISSUE 17): checkpoint a mid-flight
     decode off engine A (one gathered device_get), install it on
@@ -566,6 +606,7 @@ CASES = {
     "engine_decode": _case_engine_decode,
     "spec_decode": _case_spec_decode,
     "kv_pressure": _case_kv_pressure,
+    "kv_quant": _case_kv_quant,
     "migration": _case_migration,
     "multi_lora": _case_multi_lora,
     "group_rollout": _case_group_rollout,

@@ -77,6 +77,7 @@ RULES: Dict[str, str] = {
 # Host modules whose decode/step drivers get the JIT110 sync budget.
 HOT_MODULES: Tuple[str, ...] = (
     "senweaver_ide_tpu/obs/runtime_profile.py",
+    "senweaver_ide_tpu/ops/paged_attention.py",
     "senweaver_ide_tpu/rollout/adapter_pool.py",
     "senweaver_ide_tpu/rollout/engine.py",
     "senweaver_ide_tpu/rollout/group_tree.py",
@@ -94,7 +95,8 @@ HOT_MODULES: Tuple[str, ...] = (
 # Attribute reads that are STATIC under tracing even on a tracer:
 # metadata JAX resolves at trace time, not device data.
 _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding",
-                 "quantized", "device", "devices", "itemsize"}
+                 "quantized", "hi_layers", "device", "devices",
+                 "itemsize"}
 
 # Annotation substrings that mark a parameter as (containing) arrays.
 _ARRAYISH = ("jax.Array", "jnp.ndarray", "ndarray", "Array", "KVCache",

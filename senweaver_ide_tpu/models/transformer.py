@@ -17,10 +17,12 @@ Architectures covered: Qwen2.5-Coder (GQA + QKV bias, tied embeddings at
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.attention import attention
 from ..ops.norms import rms_norm
@@ -104,6 +106,41 @@ def _quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 def _dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray,
                    dtype) -> jnp.ndarray:
     """int8 (B, S, H, D) + (B, S, H) scales → ``dtype`` values."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
+def pool_qmax(dtype) -> float:
+    """Clip magnitude of a quantized paged-KV payload dtype (the scale
+    denominator: scale = absmax / qmax)."""
+    if np.dtype(dtype) == np.int8:
+        return 127.0
+    return 448.0  # float8_e4m3fn
+
+
+def quantize_pool_kv(x: jnp.ndarray, dtype) -> Tuple[jnp.ndarray,
+                                                     jnp.ndarray]:
+    """Per-vector absmax quantization over the trailing head_dim axis:
+    ``(..., D)`` full-width → (payload in ``dtype``, ``(...)`` f32
+    scales). Used both inside the fused step (quantize-at-write) and by
+    :func:`rollout.paged_kv.install_blocks` (quantize-at-install), so a
+    block written token-by-token and a block installed wholesale hold
+    bit-identical payloads."""
+    qmax = pool_qmax(dtype)
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax, 1e-8) / qmax
+    y = xf / scale[..., None]
+    if np.dtype(dtype) == np.int8:
+        q = jnp.clip(jnp.round(y), -qmax, qmax).astype(jnp.int8)
+    else:
+        q = jnp.clip(y, -qmax, qmax).astype(dtype)
+    return q, scale
+
+
+def dequantize_pool_kv(q: jnp.ndarray, scale: jnp.ndarray,
+                       dtype) -> jnp.ndarray:
+    """Inverse of :func:`quantize_pool_kv`: ``(..., D)`` payload +
+    ``(...)`` scales → ``dtype`` values."""
     return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
@@ -680,7 +717,8 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
                  tables: jax.Array, seq_row: jax.Array,
                  positions: jax.Array, write_block: jax.Array,
                  write_off: jax.Array, use_kernel: bool = False,
-                 adapters=None, adapter_ids=None):
+                 adapters=None, adapter_ids=None,
+                 k_scale_pool=None, v_scale_pool=None):
     """One transformer block over a paged KV pool (rollout/paged_kv.py).
 
     ``x`` is a flat token batch ``(T, 1, D)`` — T independent
@@ -702,23 +740,47 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
     and matmul shapes' element-wise dot products.
     """
     t = x.shape[0]
+    quantized = k_scale_pool is not None
     h = rms_norm(x, lp["attn_norm"], c.rms_norm_eps)
     q, k, v = _qkv(c, lp, h, cos, sin, adapters, adapter_ids)
     # q (T,1,Hq,Dh), k/v (T,1,Hkv,Dh)
-    k_pool = k_pool.at[write_block, write_off].set(
-        k[:, 0].astype(k_pool.dtype), mode="drop")
-    v_pool = v_pool.at[write_block, write_off].set(
-        v[:, 0].astype(v_pool.dtype), mode="drop")
+    if quantized:
+        # Quantize-at-write: payload and scale scatter through the SAME
+        # (write_block, write_off) indices with the same mode="drop"
+        # out-of-range sentinel, so dropped writes (padding / rescore
+        # entries) leave both tensors untouched and quantization
+        # commutes with the sentinel, fork refcounts, and COW — those
+        # act on whole blocks via the pool movers, never element-wise.
+        kq, ks = quantize_pool_kv(k[:, 0], k_pool.dtype)
+        vq, vs = quantize_pool_kv(v[:, 0], v_pool.dtype)
+        k_pool = k_pool.at[write_block, write_off].set(kq, mode="drop")
+        v_pool = v_pool.at[write_block, write_off].set(vq, mode="drop")
+        k_scale_pool = k_scale_pool.at[write_block, write_off].set(
+            ks, mode="drop")
+        v_scale_pool = v_scale_pool.at[write_block, write_off].set(
+            vs, mode="drop")
+    else:
+        k_pool = k_pool.at[write_block, write_off].set(
+            k[:, 0].astype(k_pool.dtype), mode="drop")
+        v_pool = v_pool.at[write_block, write_off].set(
+            v[:, 0].astype(v_pool.dtype), mode="drop")
     if use_kernel:
         from ..ops.paged_attention import paged_flash_decode
         out = paged_flash_decode(q[:, 0], k_pool, v_pool,
-                                 tables[seq_row], positions + 1)[:, None]
+                                 tables[seq_row], positions + 1,
+                                 k_scale=k_scale_pool,
+                                 v_scale=v_scale_pool)[:, None]
     else:
         nb, bs, hkv, dh = k_pool.shape
         tbl = tables[seq_row]                              # (T, MB)
         mb = tbl.shape[1]
         k_seq = k_pool[tbl].reshape(t, mb * bs, hkv, dh)
         v_seq = v_pool[tbl].reshape(t, mb * bs, hkv, dh)
+        if quantized:
+            k_seq = dequantize_pool_kv(
+                k_seq, k_scale_pool[tbl].reshape(t, mb * bs, hkv), x.dtype)
+            v_seq = dequantize_pool_kv(
+                v_seq, v_scale_pool[tbl].reshape(t, mb * bs, hkv), x.dtype)
         kv_pos = jnp.arange(mb * bs)[None, :]
         valid = kv_pos < positions[:, None] + 1
         out = attention(q, k_seq.astype(x.dtype), v_seq.astype(x.dtype),
@@ -728,6 +790,8 @@ def _paged_layer(c: ModelConfig, lp: Dict[str, jax.Array], x: jax.Array,
     attn_out = _with_adapter(attn_out, attn_in, adapters, adapter_ids, "wo")
     x = x + attn_out
     x, aux = _mlp(c, lp, x)
+    if quantized:
+        return x, (k_pool, v_pool, k_scale_pool, v_scale_pool), aux
     return x, (k_pool, v_pool), aux
 
 
@@ -736,8 +800,9 @@ def forward_paged(
     config: ModelConfig,
     tokens: jax.Array,            # (T,) int32 — flat token batch
     *,
-    pool_k: jax.Array,            # (L, num_blocks, block_size, Hkv, Dh)
-    pool_v: jax.Array,
+    pool,                         # rollout.paged_kv.PagedKVPool (duck-
+                                  # typed pytree: k/v payload arrays,
+                                  # optional k_scale/v_scale/k_hi/v_hi)
     tables: jax.Array,            # (R, MB) int32 — physical block per
                                   # (row, logical block)
     seq_row: jax.Array,           # (T,) int32 — table row per token
@@ -753,34 +818,47 @@ def forward_paged(
     ``(T,)`` token batch is one (sequence, position) pair — a decode
     step or one token of a chunked-prefill segment — reading KV through
     the ``(row, logical_block) -> physical_block`` table. Returns
-    ``(logits (T, V) fp32, pool_k', pool_v')``. Token t's logits
-    predict its next token, so the engine samples from the rows it
-    flagged (decode entries and final prompt tokens) and ignores the
-    rest."""
+    ``(logits (T, V) fp32, pool')``. Token t's logits predict its next
+    token, so the engine samples from the rows it flagged (decode
+    entries and final prompt tokens) and ignores the rest.
+
+    ``pool`` is the whole ``PagedKVPool`` pytree (accepted duck-typed
+    to avoid a models → rollout import cycle). A quantized pool
+    (``k_scale is not None``) stores int8/fp8 payloads with per-token
+    per-head f32 absmax scales, quantized AT WRITE TIME inside this one
+    traced function — no extra device round-trips. An optional
+    ``k_hi``/``v_hi`` full-width prefix holds the first
+    ``pool.hi_layers`` layers (``kv_dtype_per_layer`` ladder: early
+    layers, where divergence concentrates, stay bf16)."""
     c = config
     if c.matmul_precision is not None:
         with jax.default_matmul_precision(c.matmul_precision):
             return _forward_paged_impl(
-                params, c, tokens, pool_k=pool_k, pool_v=pool_v,
+                params, c, tokens, pool=pool,
                 tables=tables, seq_row=seq_row, positions=positions,
                 write_block=write_block, write_off=write_off,
                 use_kernel=use_kernel, adapters=adapters,
                 adapter_ids=adapter_ids)
     return _forward_paged_impl(
-        params, c, tokens, pool_k=pool_k, pool_v=pool_v, tables=tables,
+        params, c, tokens, pool=pool, tables=tables,
         seq_row=seq_row, positions=positions, write_block=write_block,
         write_off=write_off, use_kernel=use_kernel, adapters=adapters,
         adapter_ids=adapter_ids)
 
 
-def _forward_paged_impl(params, c, tokens, *, pool_k, pool_v, tables,
+def _forward_paged_impl(params, c, tokens, *, pool, tables,
                         seq_row, positions, write_block, write_off,
                         use_kernel, adapters=None, adapter_ids=None):
     x = params["embed"][tokens][:, None, :]            # (T, 1, D)
     cos, sin = rope_cos_sin(positions[:, None], c.head_dim, c.rope_theta,
                             scaling=c.rope_scaling)
+    aux0 = jnp.zeros((), jnp.float32)
+    # Both are STATIC under jit: derived from pytree structure (None-ness
+    # and shapes), so the precision ladder never adds a trace argument.
+    n_hi = 0 if pool.k_hi is None else pool.k_hi.shape[0]
+    quantized = pool.k_scale is not None
 
-    def body(carry, inputs):
+    def full_body(carry, inputs):
         x, aux = carry
         # Adapter banks carry a leading L axis (rollout/adapter_pool),
         # so they ride the layer scan as xs; ``adapters is None`` scans
@@ -792,10 +870,45 @@ def _forward_paged_impl(params, c, tokens, *, pool_k, pool_v, tables,
             adapters=ad, adapter_ids=adapter_ids)
         return (x, aux + layer_aux), (k_l, v_l)
 
-    (x, _aux), (k_upd, v_upd) = jax.lax.scan(
-        body, (x, jnp.zeros((), jnp.float32)),
-        (params["layers"], pool_k, pool_v, adapters),
-        unroll=c.scan_unroll)
+    def quant_body(carry, inputs):
+        x, aux = carry
+        lp, k_l, v_l, ks_l, vs_l, ad = inputs
+        x, (k_l, v_l, ks_l, vs_l), layer_aux = _paged_layer(
+            c, lp, x, cos, sin, k_l, v_l, tables, seq_row, positions,
+            write_block, write_off, use_kernel=use_kernel,
+            adapters=ad, adapter_ids=adapter_ids,
+            k_scale_pool=ks_l, v_scale_pool=vs_l)
+        return (x, aux + layer_aux), (k_l, v_l, ks_l, vs_l)
+
+    layers, lo_ad = params["layers"], adapters
+    upd = {}
+    carry = (x, aux0)
+    if n_hi:
+        # Full-width prefix layers scan first, then the quantized tail:
+        # two scans over layer slices instead of one (the per-layer
+        # ladder is a partition, so the slices are contiguous).
+        sl_hi = functools.partial(jax.tree_util.tree_map,
+                                  lambda a: a[:n_hi])
+        sl_lo = functools.partial(jax.tree_util.tree_map,
+                                  lambda a: a[n_hi:])
+        carry, (k_hi, v_hi) = jax.lax.scan(
+            full_body, carry,
+            (sl_hi(layers), pool.k_hi, pool.v_hi, sl_hi(adapters)),
+            unroll=c.scan_unroll)
+        upd["k_hi"], upd["v_hi"] = k_hi, v_hi
+        layers, lo_ad = sl_lo(layers), sl_lo(adapters)
+    if quantized:
+        carry, (k_upd, v_upd, ks_upd, vs_upd) = jax.lax.scan(
+            quant_body, carry,
+            (layers, pool.k, pool.v, pool.k_scale, pool.v_scale, lo_ad),
+            unroll=c.scan_unroll)
+        upd.update(k=k_upd, v=v_upd, k_scale=ks_upd, v_scale=vs_upd)
+    else:
+        carry, (k_upd, v_upd) = jax.lax.scan(
+            full_body, carry, (layers, pool.k, pool.v, lo_ad),
+            unroll=c.scan_unroll)
+        upd.update(k=k_upd, v=v_upd)
+    x, _aux = carry
 
     x = rms_norm(x, params["final_norm"], c.rms_norm_eps)
     head = params.get("lm_head")
@@ -806,7 +919,7 @@ def _forward_paged_impl(params, c, tokens, *, pool_k, pool_v, tables,
             logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
         logits = _dense(x, params, "lm_head", "bsd,dv->bsv")
-    return logits[:, 0].astype(jnp.float32), k_upd, v_upd
+    return logits[:, 0].astype(jnp.float32), pool._replace(**upd)
 
 
 def count_params(params: Params) -> int:

@@ -39,11 +39,13 @@ from ..models.transformer import (KVCache, Params, forward, forward_paged,
 from ..obs import get_registry, get_tracer
 from ..obs.runtime_profile import ProfiledFunction, profiled_device_get
 from ..ops.sampling import sample_token, sampled_logprob
-from .kv_pressure import (HostPrefix, PrefixCandidate, blockify_host,
-                          pick_victim, should_tier, unblockify_host)
-from .paged_kv import (BlockAllocator, BlocksExhausted, PagedKVPool,
-                       copy_blocks, gather_blocks, init_paged_pool,
-                       install_blocks)
+from .kv_pressure import (HostPrefix, PrefixCandidate, dequantize_host,
+                          pick_victim, should_tier)
+from .paged_kv import (BlockAllocator, BlockPayload, BlocksExhausted,
+                       PagedKVPool, copy_blocks, gather_blocks,
+                       gather_blocks_quant, init_paged_pool, install_blocks,
+                       install_blocks_quant, pool_bytes_per_block,
+                       resolve_kv_dtypes)
 from .sampler import SampleParams
 
 
@@ -234,12 +236,12 @@ def _pool_decode_step(params: Params, config: ModelConfig, cur_tok: jax.Array,
 
 @functools.partial(jax.jit,
                    static_argnames=("config", "sample", "use_kernel"),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool",))
 def _paged_fused_step(params: Params, config: ModelConfig,
                       tokens: jax.Array, tables: jax.Array,
                       seq_row: jax.Array, positions: jax.Array,
                       write_block: jax.Array, write_off: jax.Array,
-                      pool_k: jax.Array, pool_v: jax.Array,
+                      pool: PagedKVPool,
                       key: jax.Array, sample: SampleParams,
                       use_kernel: bool,
                       adapters=None, adapter_ids=None):
@@ -255,24 +257,29 @@ def _paged_fused_step(params: Params, config: ModelConfig,
     attached, ``adapters`` (fixed-shape rank-ladder banks) and
     ``adapter_ids`` (per-rung (T,) slot vectors, null slot 0 for base
     rows) ride every call, so tenant churn reuses the same compiled
-    signatures."""
-    logits, pool_k, pool_v = forward_paged(
-        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+    signatures. The pool rides through as the whole PagedKVPool pytree:
+    on quantized ladders (EngineConfig.kv_dtype) the same fused step
+    quantizes each entry's k/v at write time and scatters payload +
+    absmax scales through the SAME sentinel-guarded indices — no extra
+    device round-trips, no new compile per occupancy bucket (the scale
+    tensors are shape-static alongside the payloads)."""
+    logits, pool = forward_paged(
+        params, config, tokens, pool=pool,
         tables=tables, seq_row=seq_row, positions=positions,
         write_block=write_block, write_off=write_off,
         use_kernel=use_kernel, adapters=adapters, adapter_ids=adapter_ids)
     next_tok = sample_token(logits, key, temperature=sample.temperature,
                             top_k=sample.top_k, top_p=sample.top_p)
     logp = sampled_logprob(logits, next_tok)
-    return next_tok, logp, pool_k, pool_v
+    return next_tok, logp, pool
 
 
 @functools.partial(jax.jit, static_argnames=("config", "k", "use_kernel"),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool",))
 def _draft_propose_scan(params: Params, config: ModelConfig,
                         cur_tok: jax.Array, base_pos: jax.Array,
                         spec_mask: jax.Array, tables: jax.Array,
-                        pool_k: jax.Array, pool_v: jax.Array,
+                        pool: PagedKVPool,
                         k: int, use_kernel: bool):
     """Greedy draft proposal loop, entirely on device: ``k`` sequential
     draft-model decode steps over every speculating row at once
@@ -281,51 +288,51 @@ def _draft_propose_scan(params: Params, config: ModelConfig,
     so every speculation depth is its own pre-compiled bucket. Rows
     outside the mask write to the sentinel block (dropped by the
     scatter) and their proposals are ignored by the host. Returns
-    ``(proposals (R, k) int32, pool_k', pool_v')``."""
+    ``(proposals (R, k) int32, pool')``."""
     r = tables.shape[0]
     mb = tables.shape[1]
-    nb = pool_k.shape[1]
-    bs = pool_k.shape[2]
+    nb = pool.k.shape[1]
+    bs = pool.k.shape[2]
     seq_row = jnp.arange(r, dtype=jnp.int32)
 
     def body(carry, _i):
-        pk, pv, tok, pos = carry
+        p, tok, pos = carry
         lb = jnp.clip(pos // bs, 0, mb - 1)
         wb = jnp.where(spec_mask & (pos // bs < mb),
                        tables[seq_row, lb], nb)
-        logits, pk, pv = forward_paged(
-            params, config, tok, pool_k=pk, pool_v=pv, tables=tables,
+        logits, p = forward_paged(
+            params, config, tok, pool=p, tables=tables,
             seq_row=seq_row, positions=pos, write_block=wb,
             write_off=pos % bs, use_kernel=use_kernel)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(spec_mask, nxt, tok)
-        return (pk, pv, nxt, pos + 1), nxt
+        return (p, nxt, pos + 1), nxt
 
-    (pool_k, pool_v, _tok, _pos), props = jax.lax.scan(
-        body, (pool_k, pool_v, cur_tok, base_pos),
+    (pool, _tok, _pos), props = jax.lax.scan(
+        body, (pool, cur_tok, base_pos),
         jnp.arange(k, dtype=jnp.int32))
-    return props.T, pool_k, pool_v
+    return props.T, pool
 
 
 @functools.partial(jax.jit, static_argnames=("config", "use_kernel"),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool",))
 def _draft_feed_step(params: Params, config: ModelConfig,
                      tokens: jax.Array, tables: jax.Array,
                      seq_row: jax.Array, positions: jax.Array,
                      write_block: jax.Array, write_off: jax.Array,
-                     pool_k: jax.Array, pool_v: jax.Array,
+                     pool: PagedKVPool,
                      use_kernel: bool):
     """Draft-cache catch-up: run the draft model over a flat token
     batch purely for its KV writes (logits discarded, no transfer).
     This is how the draft reaches lockstep with the target after
     prefill, continuations, preemption resume, rollback, or a depth-0
     stretch — the host replays the already-known token stream."""
-    _logits, pool_k, pool_v = forward_paged(
-        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+    _logits, pool = forward_paged(
+        params, config, tokens, pool=pool,
         tables=tables, seq_row=seq_row, positions=positions,
         write_block=write_block, write_off=write_off,
         use_kernel=use_kernel)
-    return pool_k, pool_v
+    return pool
 
 
 # Runtime observatory wiring (obs/runtime_profile.py): the two step
@@ -393,6 +400,20 @@ class EngineConfig:
     # whole-pool allocation cannot fit it, truncate-finishes) —
     # counted in senweaver_kv_preemption_storms_total.
     max_preempts: int = 3
+    # Quantized KV ladder (docs/serving.md "Quantized KV ladder"):
+    # "bf16" stores blocks at full model width; "int8"/"fp8" store
+    # quantized payloads + per-(block, position, head) absmax scales,
+    # roughly doubling effective pool capacity per chip. Quantization
+    # happens at write time inside the ONE jitted fused step; decode
+    # reads dequantize fused inside the paged-attention block loop.
+    # Paged layout only (the slot layout has its own kv_quant knob).
+    kv_dtype: str = "bf16"
+    # Per-layer override, e.g. ("bf16", "bf16", "int8", ...): a
+    # contiguous full-width prefix keeps the early layers (where
+    # quantization divergence concentrates) exact while the tail rides
+    # the ladder. Must be num_layers long, a bf16 prefix followed by
+    # one uniform quantized run (rollout/paged_kv.resolve_kv_dtypes).
+    kv_dtype_per_layer: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -597,6 +618,19 @@ class RolloutEngine:
         self.kv_layout = ("slots" if requested == "slots" or fallback
                           else "paged")
         self.kv_layout_fallback = fallback
+        # Quantized-ladder validation happens up front (and regardless
+        # of layout): a silently-ignored kv_dtype on a slots fallback
+        # would serve at double the memory the operator budgeted for.
+        self._kv_payload_dtype, self._kv_hi_layers = resolve_kv_dtypes(
+            config.num_layers, self.engine_config.kv_dtype,
+            self.engine_config.kv_dtype_per_layer)
+        if (self._kv_payload_dtype is not None
+                and self.kv_layout != "paged"):
+            raise ValueError(
+                "EngineConfig.kv_dtype quantized ladder needs the paged "
+                "KV layout"
+                + (f" (fell back to slots: {fallback})" if fallback
+                   else " (kv_layout='slots' has its own kv_quant knob)"))
         # Multi-tenant LoRA (rollout/adapter_pool.py): the pool's banks
         # + per-row slot ids ride the ONE jitted paged step. Paged-only:
         # the slot path has no flat-token gather to hook.
@@ -641,12 +675,20 @@ class RolloutEngine:
             nb = self.engine_config.num_blocks
             if nb is None:
                 nb = (num_slots + 4) * self._blocks_per_row
-            self._alloc = BlockAllocator(nb, bs, registry=get_registry())
+            # Pool before allocator: the allocator's byte ledger
+            # (senweaver_kv_bytes_{device,host}) needs the pool's
+            # per-block footprint, which the kv_dtype ladder shrinks.
+            self.pool = init_paged_pool(
+                config, nb, bs,
+                kv_dtype=self.engine_config.kv_dtype,
+                kv_dtype_per_layer=self.engine_config.kv_dtype_per_layer)
+            self._alloc = BlockAllocator(
+                nb, bs, registry=get_registry(),
+                bytes_per_block=pool_bytes_per_block(self.pool))
             self._storm_total = get_registry().counter(
                 "senweaver_kv_preemption_storms_total",
                 "Requests preempted EngineConfig.max_preempts times and "
                 "latched non-preemptible (starvation guard).")
-            self.pool = init_paged_pool(config, nb, bs)
             self.cache = None
             self.cur_tok = None
             # host-side block table + fill level + decode cursor per row
@@ -1326,6 +1368,10 @@ class RolloutEngine:
                                       / self._alloc.num_blocks)
                 out["kv_swapped_blocks"] = sum(
                     hp.num_blocks for hp in self._prefix_host.values())
+                out["kv_dtype"] = self.engine_config.kv_dtype
+                out["kv_bytes_per_block"] = self._alloc.bytes_per_block
+                out["kv_bytes_device"] = self._alloc.used_bytes
+                out["kv_bytes_host"] = self._alloc.swapped_bytes
             if self.adapter_pool is not None:
                 ap = self.adapter_pool.stats()
                 out["adapters_published"] = len(ap["adapters"])
@@ -1582,10 +1628,33 @@ class RolloutEngine:
                 self._touch_prefix(pid)
                 return pid
             if self.kv_layout == "paged":
-                L = self.pool.k.shape[0]
+                L = self.pool.num_layers
                 hkv, dh = self.pool.k.shape[3], self.pool.k.shape[4]
-                pool_dtype = self.pool.k.dtype
-                pool_quant = False
+                # Two acceptable flavors on a UNIFORMLY quantized pool:
+                # a matching quantized buffer (int8/fp8 payload + scales
+                # splice straight in — the cross-replica backfill stays
+                # quantized end to end) or a full-width one (quantized
+                # at install time by the write scatter). Mixed-ladder
+                # pools (bf16 prefix layers) only take full width —
+                # a foreign uniform payload can't express the prefix —
+                # so a quantized broadcast is dequantized at the door
+                # (payload × scale, one elementwise pass) rather than
+                # bounced; a heterogeneous-ladder fleet still shares
+                # prefixes, it just pays full width on the wide rungs.
+                if (kv.quantized and self.pool.quantized
+                        and self.pool.hi_layers == 0):
+                    pool_dtype = self.pool.k.dtype
+                    pool_quant = True
+                else:
+                    pool_dtype = self.config.dtype
+                    pool_quant = False
+                    if kv.quantized:
+                        kv = KVCache(
+                            k=(kv.k.astype(jnp.float32)
+                               * kv.k_scale[..., None]).astype(pool_dtype),
+                            v=(kv.v.astype(jnp.float32)
+                               * kv.v_scale[..., None]).astype(pool_dtype),
+                            length=kv.length)
             else:
                 L, _, _, hkv, dh = self.cache.k.shape
                 pool_dtype = self.cache.k.dtype
@@ -1603,6 +1672,13 @@ class RolloutEngine:
                 raise PrefixImportError(
                     f"prefix quantization {kv.quantized} != pool "
                     f"quantization {pool_quant}")
+            if pool_quant:
+                want_s = (L, 1, self.max_len, hkv)
+                if (tuple(kv.k_scale.shape) != want_s
+                        or tuple(kv.v_scale.shape) != want_s):
+                    raise PrefixImportError(
+                        f"prefix KV scale shape {tuple(kv.k_scale.shape)}/"
+                        f"{tuple(kv.v_scale.shape)} != {want_s}")
             # One batched admission sync: the declared-length check and
             # the first-token logits come over in a single transfer.
             got = jax.device_get(
@@ -1627,9 +1703,21 @@ class RolloutEngine:
                 # zero-copy-per-request property from the counters.
                 nblk = self._alloc.blocks_for(len(tokens))
                 blocks = self._alloc_blocks_evicting(nblk)
-                k_buf, v_buf = self._blockify(kv, nblk)
-                self.pool = install_blocks(self.pool, k_buf, v_buf,
-                                           jnp.asarray(blocks, jnp.int32))
+                idx = jnp.asarray(blocks, jnp.int32)
+                if pool_quant:
+                    # quantized splice: int8/fp8 bytes + scales land in
+                    # the pool as-is — no dequant/requant round trip
+                    payload = BlockPayload(
+                        k=self._blockify_arr(kv.k, nblk),
+                        v=self._blockify_arr(kv.v, nblk),
+                        k_scale=self._blockify_arr(kv.k_scale, nblk),
+                        v_scale=self._blockify_arr(kv.v_scale, nblk))
+                    self.pool = install_blocks_quant(self.pool, payload,
+                                                     idx)
+                else:
+                    k_buf, v_buf = self._blockify(kv, nblk)
+                    self.pool = install_blocks(self.pool, k_buf, v_buf,
+                                               idx)
                 self._alloc.count_install_copy(nblk)
                 placed = blocks
             elif self.mesh is not None:
@@ -2164,11 +2252,10 @@ class RolloutEngine:
         wo = np.zeros((t,), np.int32)
         for i, (tok, r, p, b, o) in enumerate(entries):
             toks[i], rows[i], pos[i], wb[i], wo[i] = tok, r, p, b, o
-        dk, dv = _draft_feed_step(
+        self._draft_pool = _draft_feed_step(
             sp.params, sp.config, toks, self._draft_tables_device(),
-            rows, pos, wb, wo, self._draft_pool.k, self._draft_pool.v,
+            rows, pos, wb, wo, self._draft_pool,
             self._use_paged_kernel)
-        self._draft_pool = PagedKVPool(k=dk, v=dv)
         for row, n in advanced:
             self._draft_len[row] += n
         self._stats["spec_feed_tokens"] += len(entries)
@@ -2211,11 +2298,10 @@ class RolloutEngine:
             cur[row] = self._cur_tok_host[row]
             base[row] = self._row_len[row]
             mask[row] = True
-        props_dev, dk, dv = _draft_propose_scan(
+        props_dev, self._draft_pool = _draft_propose_scan(
             sp.params, sp.config, cur, base, mask,
-            self._draft_tables_device(), self._draft_pool.k,
-            self._draft_pool.v, k, self._use_paged_kernel)
-        self._draft_pool = PagedKVPool(k=dk, v=dv)
+            self._draft_tables_device(), self._draft_pool,
+            k, self._use_paged_kernel)
         props = profiled_device_get(props_dev, fn="engine.spec_propose")
         plan = {}
         for row in rows:
@@ -2338,14 +2424,20 @@ class RolloutEngine:
         untouched — a swap can tear but never half-apply."""
         tokens, blocks, last = self._prefixes[pid]
         nblk = len(blocks)
-        k, v = gather_blocks(self.pool, np.asarray(blocks, np.int32))
-        k_h, v_h = profiled_device_get((k, v), "engine.swap_out")
-        bs = self._alloc.block_size
-        k_b, v_b = blockify_host(np.asarray(k_h), np.asarray(v_h),
-                                 nblk, bs)
+        # gather_blocks_quant keeps the pool's storage flavor: on a
+        # quantized ladder the host tier holds int8/fp8 bytes + scales
+        # (half the host RAM per block), on bf16 the full payload —
+        # and the layout is already blockified, so no host reshape.
+        payload = gather_blocks_quant(self.pool,
+                                      np.asarray(blocks, np.int32))
+        host = profiled_device_get(payload, "engine.swap_out")
+        np_of = lambda a: None if a is None else np.asarray(a)
         # -- point of no return: pure host bookkeeping from here ------
-        self._prefix_host[pid] = HostPrefix(k=k_b, v=v_b,
-                                            num_tokens=len(tokens))
+        self._prefix_host[pid] = HostPrefix(
+            k=np_of(host.k), v=np_of(host.v),
+            num_tokens=len(tokens),
+            k_scale=np_of(host.k_scale), v_scale=np_of(host.v_scale),
+            k_hi=np_of(host.k_hi), v_hi=np_of(host.v_hi))
         self._prefixes[pid] = (tokens, None, last)
         self._alloc.release(blocks)
         self._alloc.count_swap_out(nblk)
@@ -2367,8 +2459,14 @@ class RolloutEngine:
         except BlocksExhausted:
             return False
         try:
-            self.pool = install_blocks(self.pool, hp.k, hp.v,
-                                       np.asarray(blocks, np.int32))
+            # same storage flavor back in: quantized payloads splice
+            # without a requant, full-width ones scatter as before
+            self.pool = install_blocks_quant(
+                self.pool,
+                BlockPayload(k=hp.k, v=hp.v, k_scale=hp.k_scale,
+                             v_scale=hp.v_scale, k_hi=hp.k_hi,
+                             v_hi=hp.v_hi),
+                np.asarray(blocks, np.int32))
         except Exception:
             self._alloc.release(blocks)
             raise
@@ -2486,46 +2584,90 @@ class RolloutEngine:
                                             allow_preempt=False):
                     raise
 
+    def _blockify_arr(self, a, nblk: int):
+        # guarded-by: caller
+        """Reshape one contiguous one-slot tensor (L, 1, cap, ...) into
+        the block layout (L, nblk, block_size, ...) — payloads and the
+        quantized ladder's (L, 1, cap, Hkv) scale planes alike."""
+        bs = self._alloc.block_size
+        need = nblk * bs
+        a = a[:, 0]
+        if need > a.shape[1]:
+            pad = [(0, 0), (0, need - a.shape[1])] + \
+                [(0, 0)] * (a.ndim - 2)
+            a = jnp.pad(a, pad)
+        return a[:, :need].reshape(a.shape[0], nblk, bs, *a.shape[2:])
+
     def _blockify(self, kv: KVCache, nblk: int):
         # guarded-by: caller
         """Reshape a contiguous one-slot buffer (L, 1, cap, Hkv, Dh)
         into (L, nblk, block_size, Hkv, Dh) for install_blocks."""
-        bs = self._alloc.block_size
-        need = nblk * bs
-        l, _, cap, hkv, dh = kv.k.shape
-        k, v = kv.k[:, 0], kv.v[:, 0]
-        if need > cap:
-            pad = ((0, 0), (0, need - cap), (0, 0), (0, 0))
-            k, v = jnp.pad(k, pad), jnp.pad(v, pad)
-        return (k[:, :need].reshape(l, nblk, bs, hkv, dh),
-                v[:, :need].reshape(l, nblk, bs, hkv, dh))
+        return (self._blockify_arr(kv.k, nblk),
+                self._blockify_arr(kv.v, nblk))
+
+    @staticmethod
+    def _unblockify_to(a, cap: int, xp=jnp):
+        """Block layout (L, nblk, bs, ...) -> one-slot (L, 1, cap, ...),
+        zero-padded past the gathered blocks."""
+        l, nblk, bs = a.shape[:3]
+        a = a.reshape(l, nblk * bs, *a.shape[3:])
+        if a.shape[1] < cap:
+            pad = [(0, 0), (0, cap - a.shape[1])] + \
+                [(0, 0)] * (a.ndim - 2)
+            a = xp.pad(a, pad)
+        return a[:, None, :cap]
 
     def _export_blocks(self, tokens: List[int],
                        blocks: List[int]) -> KVCache:
         # guarded-by: caller
         """Materialize a prefix's block table as the contiguous
-        one-slot buffer the fleet prefix contract speaks."""
-        k, v = gather_blocks(self.pool, jnp.asarray(blocks, jnp.int32))
+        one-slot buffer the fleet prefix contract speaks. Uniformly
+        quantized pools export the QUANTIZED flavor (payload + scales —
+        the broadcast ships half the bytes and a matching peer splices
+        it without a requant); mixed-ladder pools dequantize to the
+        model dtype, which any peer can ingest."""
+        idx = jnp.asarray(blocks, jnp.int32)
         cap = self.max_len
+        length = jnp.full((1,), len(tokens), jnp.int32)
+        pool = self.pool
+        if pool.quantized and pool.hi_layers == 0:
+            p = gather_blocks_quant(pool, idx)
+            return KVCache(
+                k=self._unblockify_to(p.k, cap),
+                v=self._unblockify_to(p.v, cap),
+                k_scale=self._unblockify_to(p.k_scale, cap),
+                v_scale=self._unblockify_to(p.v_scale, cap),
+                length=length)
+        k, v = gather_blocks(pool, idx, dtype=self.config.dtype)
         if k.shape[1] < cap:
             pad = ((0, 0), (0, cap - k.shape[1]), (0, 0), (0, 0))
             k, v = jnp.pad(k, pad), jnp.pad(v, pad)
         return KVCache(k=k[:, None, :cap], v=v[:, None, :cap],
-                       length=jnp.full((1,), len(tokens), jnp.int32))
+                       length=length)
 
     def _export_host(self, pid: int) -> KVCache:
         # guarded-by: caller
         """Fleet-contract one-slot buffer built from a host-tiered
         prefix — all numpy, zero device traffic on the donor; the
-        importer's install scatter ingests host arrays directly."""
+        importer's install scatter ingests host arrays directly.
+        Quantized host payloads export quantized (same flavor rule as
+        _export_blocks); mixed-ladder ones dequantize on the host."""
         hp = self._prefix_host[pid]
-        k, v = unblockify_host(hp)
         cap = self.max_len
+        length = np.full((1,), hp.num_tokens, np.int32)
+        if hp.k_scale is not None and hp.k_hi is None:
+            return KVCache(
+                k=self._unblockify_to(hp.k, cap, xp=np),
+                v=self._unblockify_to(hp.v, cap, xp=np),
+                k_scale=self._unblockify_to(hp.k_scale, cap, xp=np),
+                v_scale=self._unblockify_to(hp.v_scale, cap, xp=np),
+                length=length)
+        k, v = dequantize_host(hp, np.dtype(self.config.dtype))
         if k.shape[1] < cap:
             pad = ((0, 0), (0, cap - k.shape[1]), (0, 0), (0, 0))
             k, v = np.pad(k, pad), np.pad(v, pad)
         return KVCache(k=k[:, None, :cap], v=v[:, None, :cap],
-                       length=np.full((1,), hp.num_tokens, np.int32))
+                       length=length)
 
     def _tables_device(self) -> jnp.ndarray:
         # guarded-by: caller
@@ -2853,17 +2995,16 @@ class RolloutEngine:
             # the jit as numpy (single C++ ingest each); jnp.asarray
             # here would cost a full dispatch per vector per step —
             # profiled at ~half the paged step's host time
-            next_tok, logp, pk, pv = _paged_fused_step(
+            next_tok, logp, self.pool = _paged_fused_step(
                 self.params, self.config,
                 np.asarray(toks_l, np.int32), self._tables_device(),
                 np.asarray(rows_l, np.int32),
                 np.asarray(pos_l, np.int32),
                 np.asarray(wb_l, np.int32),
                 np.asarray(wo_l, np.int32),
-                self.pool.k, self.pool.v, step_key, self.sample,
+                self.pool, step_key, self.sample,
                 self._use_paged_kernel,
                 adapters=adapters, adapter_ids=adapter_ids)
-            self.pool = PagedKVPool(k=pk, v=pv)
             self._stats["decode_steps"] += 1
             # ONE batched device→host transfer per fused step (the
             # analysis JIT110 budget), covering decode tokens AND the

@@ -89,15 +89,38 @@ class HostPrefix(NamedTuple):
     """A prefix swapped out to the host tier: block-layout numpy
     buffers ``(L, nblk, block_size, Hkv, Dh)`` ready to feed the
     ``install_blocks`` scatter directly (pjit ingests host numpy
-    without a staging copy — the PR-10 plan-vector trick)."""
+    without a staging copy — the PR-10 plan-vector trick).
+
+    On a quantized KV ladder (``EngineConfig.kv_dtype``) the payload
+    stays quantized end to end: ``k``/``v`` hold int8/fp8 bytes for the
+    quantized layers, ``k_scale``/``v_scale`` the per-(block, position,
+    head) f32 absmax scales ``(Lq, nblk, block_size, Hkv)``, and
+    ``k_hi``/``v_hi`` the optional full-width early-layer prefix — so
+    the host-RAM tier footprint halves alongside the device pool.
+    All-None trailing fields mean a full-width (bf16-ladder) payload."""
 
     k: np.ndarray
     v: np.ndarray
     num_tokens: int
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
+    k_hi: Optional[np.ndarray] = None
+    v_hi: Optional[np.ndarray] = None
 
     @property
     def num_blocks(self) -> int:
         return int(self.k.shape[1])
+
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def nbytes(self) -> int:
+        """Host-RAM footprint of this entry (the byte ledger feeding
+        ``senweaver_kv_bytes_host``)."""
+        return sum(a.nbytes for a in self[:2] + self[3:]
+                   if a is not None)
 
 
 def blockify_host(k: np.ndarray, v: np.ndarray, nblk: int,
@@ -118,10 +141,37 @@ def blockify_host(k: np.ndarray, v: np.ndarray, nblk: int,
 
 def unblockify_host(hp: HostPrefix) -> Tuple[np.ndarray, np.ndarray]:
     """Contiguous ``(L, num_tokens_padded, Hkv, Dh)`` view of a host
-    prefix — the export shape (caller pads/crops to its cache cap)."""
+    prefix — the export shape (caller pads/crops to its cache cap).
+    Raw payload view: quantized entries come back still quantized (use
+    :func:`dequantize_host` for full-width exports)."""
     l, nblk, bs, hkv, dh = hp.k.shape
     k = hp.k.reshape(l, nblk * bs, hkv, dh)
     v = hp.v.reshape(l, nblk * bs, hkv, dh)
+    return k, v
+
+
+def dequantize_host(hp: HostPrefix,
+                    dtype: np.dtype) -> Tuple[np.ndarray, np.ndarray]:
+    """Full-width ``(L, num_tokens_padded, Hkv, Dh)`` buffers from a
+    host prefix, dequantizing quantized layers (payload × scale) and
+    re-stacking the full-width early-layer prefix in layer order — all
+    numpy, no device traffic (``dtype`` may be an ml_dtypes extended
+    type like bfloat16; the caller passes the model dtype)."""
+
+    def flat(a):
+        return a.reshape(a.shape[0], a.shape[1] * a.shape[2],
+                         *a.shape[3:])
+
+    if hp.k_scale is None:
+        k, v = unblockify_host(hp)
+        return k.astype(dtype, copy=False), v.astype(dtype, copy=False)
+    k = (flat(hp.k).astype(np.float32)
+         * flat(hp.k_scale)[..., None]).astype(dtype)
+    v = (flat(hp.v).astype(np.float32)
+         * flat(hp.v_scale)[..., None]).astype(dtype)
+    if hp.k_hi is not None:
+        k = np.concatenate([flat(hp.k_hi).astype(dtype), k], axis=0)
+        v = np.concatenate([flat(hp.v_hi).astype(dtype), v], axis=0)
     return k, v
 
 

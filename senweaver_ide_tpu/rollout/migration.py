@@ -14,8 +14,11 @@ engine needs to continue the decode bit-for-bit:
 - the request's host state (prompt, emitted tokens, behavior logps,
   budget/eos, preemption accounting);
 - the KV block contents gathered host-side in the SAME blockified
-  layout the host tier and the cross-engine prefix broadcast speak
-  (``kv_pressure.blockify_host``), so restore is one install scatter;
+  layout (and the same storage flavor — a quantized ladder ships
+  int8/fp8 bytes + scales, format v2) the host tier and the
+  cross-engine prefix broadcast speak
+  (``paged_kv.gather_blocks_quant``), so restore is one install
+  scatter;
 - the engine RNG key and the engine-wide sampler params (restore
   refuses a sampler mismatch — a migrated greedy decode must stay
   greedy);
@@ -59,13 +62,17 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..obs.runtime_profile import profiled_device_get
-from .kv_pressure import blockify_host
-from .paged_kv import BlocksExhausted, gather_blocks, install_blocks
+from .paged_kv import (BlockPayload, BlocksExhausted,
+                       gather_blocks_quant, install_blocks_quant)
 
 # Bump when the checkpoint schema changes; restore refuses a foreign
 # format instead of guessing (a half-understood checkpoint resumed
 # wrong is corruption, a refused one is a local finish on the source).
-CHECKPOINT_FORMAT = 1
+# v2 added the quantized-KV ladder fields (kv_dtype, hi_layers, scale
+# and full-width-prefix payloads); v1 checkpoints still decode — their
+# defaults mean "full-width payload", which is exactly what they carry.
+CHECKPOINT_FORMAT = 2
+_ACCEPTED_FORMATS = (1, 2)
 
 
 class MigrationError(RuntimeError):
@@ -113,6 +120,19 @@ class DecodeCheckpoint:
     block_size: int = 0
     kv_k: Optional[np.ndarray] = None
     kv_v: Optional[np.ndarray] = None
+    # Quantized-KV ladder (format v2): the payload is stored in the
+    # SOURCE pool's flavor — ``kv_dtype`` names the ladder rung,
+    # ``hi_layers`` how many early layers ride full-width, the scale
+    # planes are (Lq, nblk, block_size, Hkv) f32, and kv_k/kv_v hold
+    # int8/fp8 bytes for the quantized layers. Restore onto a replica
+    # with a DIFFERENT ladder falls back to recompute-prefill — a
+    # cross-flavor splice would requant already-lossy payloads.
+    kv_dtype: str = "bf16"
+    hi_layers: int = 0
+    kv_k_scale: Optional[np.ndarray] = None
+    kv_v_scale: Optional[np.ndarray] = None
+    kv_k_hi: Optional[np.ndarray] = None
+    kv_v_hi: Optional[np.ndarray] = None
 
     def with_fence(self, *, epoch: int, version: int,
                    deadline: Optional[float] = None) -> "DecodeCheckpoint":
@@ -129,9 +149,9 @@ class DecodeCheckpoint:
         out = dataclasses.asdict(self)
         # asdict deep-copies ndarrays via copy.deepcopy — fine, but
         # keep the originals to avoid the copy on the hot path
-        out["rng_key"] = self.rng_key
-        out["kv_k"] = self.kv_k
-        out["kv_v"] = self.kv_v
+        for name in ("rng_key", "kv_k", "kv_v", "kv_k_scale",
+                     "kv_v_scale", "kv_k_hi", "kv_v_hi"):
+            out[name] = getattr(self, name)
         return out
 
     @classmethod
@@ -141,10 +161,10 @@ class DecodeCheckpoint:
                 f"checkpoint wire payload is {type(wire).__name__}, "
                 "not a dict")
         fmt = wire.get("format_version")
-        if fmt != CHECKPOINT_FORMAT:
+        if fmt not in _ACCEPTED_FORMATS:
             raise MigrationError(
-                f"checkpoint format {fmt!r} != supported "
-                f"{CHECKPOINT_FORMAT} — refusing to guess")
+                f"checkpoint format {fmt!r} not in supported "
+                f"{_ACCEPTED_FORMATS} — refusing to guess")
         names = {f.name for f in dataclasses.fields(cls)}
         unknown = set(wire) - names
         if unknown:
@@ -189,6 +209,7 @@ def checkpoint_from_engine(engine, rid: int, *,
     bs = engine._alloc.block_size
     kv_len = 0
     kv_k = kv_v = None
+    ks = vs = khi = vhi = None
     nblk = 0
     if kv_rows:
         # Gather ONLY the blocks covering live positions, and note that
@@ -202,15 +223,21 @@ def checkpoint_from_engine(engine, rid: int, *,
         nblk = min(len(engine._tables[row]),
                    engine._alloc.blocks_for(kv_len))
         blocks = engine._tables[row][:nblk]
-        k, v = gather_blocks(engine.pool, np.asarray(blocks, np.int32))
-        payload = (k, v, engine._key)
+        # Already blockified AND still in the pool's storage flavor:
+        # a quantized ladder ships int8/fp8 bytes + scales over the
+        # wire (half the transfer), a bf16 one the full payload.
+        p = gather_blocks_quant(engine.pool,
+                                np.asarray(blocks, np.int32))
+        payload = (p, engine._key)
     else:
         payload = (engine._key,)
     host = profiled_device_get(payload, fn="engine.migrate_out")
     if kv_rows:
-        k_h, v_h, key_h = host
-        kv_k, kv_v = blockify_host(np.asarray(k_h), np.asarray(v_h),
-                                   nblk, bs)
+        p_h, key_h = host
+        np_of = lambda a: None if a is None else np.asarray(a)
+        kv_k, kv_v = np_of(p_h.k), np_of(p_h.v)
+        ks, vs = np_of(p_h.k_scale), np_of(p_h.v_scale)
+        khi, vhi = np_of(p_h.k_hi), np_of(p_h.v_hi)
     else:
         (key_h,) = host
     sample = engine.sample
@@ -225,15 +252,22 @@ def checkpoint_from_engine(engine, rid: int, *,
         adapter_id=req.adapter,
         adapter_version=(None if req.adapter_binding is None
                          else int(req.adapter_binding.version)),
-        kv_len=kv_len, block_size=bs, kv_k=kv_k, kv_v=kv_v)
+        kv_len=kv_len, block_size=bs, kv_k=kv_k, kv_v=kv_v,
+        kv_dtype=engine.engine_config.kv_dtype,
+        hi_layers=engine.pool.hi_layers,
+        kv_k_scale=ks, kv_v_scale=vs, kv_k_hi=khi, kv_v_hi=vhi)
 
 
 def _validate_pool_layout(engine, ckpt: DecodeCheckpoint) -> None:
     """Model-level compatibility: a KV payload whose layer/head/dim
     layout or dtype differs came from a DIFFERENT model — always an
-    error, never a silent recompute."""
+    error, never a silent recompute. (The kv_dtype LADDER fence is the
+    caller's: a ladder mismatch is a legal recompute fallback, so this
+    only runs once the flavors already agree.)"""
     l, _nblk, _bs, hkv, dh = ckpt.kv_k.shape
-    pl, _nb, _pbs, phkv, pdh = engine.pool.k.shape
+    l += 0 if ckpt.kv_k_hi is None else int(ckpt.kv_k_hi.shape[0])
+    _nb, _pbs, phkv, pdh = engine.pool.k.shape[1:]
+    pl = engine.pool.num_layers
     if (l, hkv, dh) != (pl, phkv, pdh):
         raise MigrationError(
             f"checkpoint KV layout (L={l}, Hkv={hkv}, Dh={dh}) != "
@@ -251,10 +285,10 @@ def restore_into_engine(engine, ckpt: DecodeCheckpoint) -> int:
     preemption-resume replay recompute — both token-exact."""
     if not isinstance(ckpt, DecodeCheckpoint):
         ckpt = DecodeCheckpoint.from_wire(ckpt)
-    if ckpt.format_version != CHECKPOINT_FORMAT:
+    if ckpt.format_version not in _ACCEPTED_FORMATS:
         raise MigrationError(
-            f"checkpoint format {ckpt.format_version} != supported "
-            f"{CHECKPOINT_FORMAT}")
+            f"checkpoint format {ckpt.format_version} not in supported "
+            f"{_ACCEPTED_FORMATS}")
     if engine.kv_layout != "paged":
         raise MigrationError(
             "live migration needs the paged KV layout (engine fell "
@@ -303,8 +337,14 @@ def restore_into_engine(engine, ckpt: DecodeCheckpoint) -> int:
     engine._requests[rid] = req
     installed = False
     expect_len = len(ckpt.prompt) + len(ckpt.tokens) - 1
+    # kv_dtype fence: a payload in a different ladder flavor (or with a
+    # different full-width layer split) NEVER splices — requantizing an
+    # already-lossy payload compounds the error budget silently. The
+    # recompute path re-prefills exactly instead.
+    ladder_ok = (ckpt.kv_dtype == engine.engine_config.kv_dtype
+                 and int(ckpt.hi_layers) == engine.pool.hi_layers)
     if (ckpt.kv_k is not None and ckpt.kv_len > 0 and req.tokens
-            and ckpt.kv_len == expect_len):
+            and ckpt.kv_len == expect_len and ladder_ok):
         _validate_pool_layout(engine, ckpt)
         nblk = int(ckpt.kv_k.shape[1])
         free = engine._free_slots()
@@ -316,8 +356,13 @@ def restore_into_engine(engine, ckpt: DecodeCheckpoint) -> int:
                 blocks = None   # pool full even after reclaim: recompute
             if blocks is not None:
                 try:
-                    engine.pool = install_blocks(
-                        engine.pool, ckpt.kv_k, ckpt.kv_v,
+                    engine.pool = install_blocks_quant(
+                        engine.pool,
+                        BlockPayload(k=ckpt.kv_k, v=ckpt.kv_v,
+                                     k_scale=ckpt.kv_k_scale,
+                                     v_scale=ckpt.kv_v_scale,
+                                     k_hi=ckpt.kv_k_hi,
+                                     v_hi=ckpt.kv_v_hi),
                         np.asarray(blocks, np.int32))
                 except Exception:
                     engine._alloc.release(blocks)

@@ -34,9 +34,20 @@ through the ``(request, logical_block) -> physical_block`` indirection
 The allocator is pure host bookkeeping (ints in lists — no device sync
 anywhere) guarded by its own reentrant lock, so the engine lock and the
 allocator lock nest in a fixed order (engine → allocator). Device data
-only moves through the three jitted helpers at the bottom
-(:func:`copy_blocks`, :func:`install_blocks`, :func:`gather_blocks`),
-each a single scatter/gather on the pool.
+only moves through the jitted helpers at the bottom
+(:func:`copy_blocks`, :func:`install_blocks`, :func:`gather_blocks` and
+their quantization-preserving twins), each a single scatter/gather on
+the pool.
+
+**Quantized KV ladder** (``EngineConfig.kv_dtype``): the pool can store
+int8/fp8 payloads plus per-(block, position, head) f32 absmax scales —
+roughly 2×/2× the effective block capacity per HBM byte. Quantization
+happens at write time inside the engine's one fused step and at install
+time here; every consumer that needs full-width KV (prefix export,
+slot-layout interop) goes through :func:`gather_blocks`, which
+dequantizes, while the host tier / migration / quantized export path
+uses :func:`gather_blocks_quant`/:func:`install_blocks_quant` to ship
+the raw bytes + scales.
 """
 
 from __future__ import annotations
@@ -48,8 +59,77 @@ from typing import Any, Dict, List, NamedTuple, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from ..models.transformer import ModelConfig
+from ..models.transformer import (ModelConfig, dequantize_pool_kv,
+                                  quantize_pool_kv)
 from ..obs.runtime_profile import ProfiledFunction
+
+# The serving-wide KV precision ladder (EngineConfig.kv_dtype). "bf16"
+# means "full width": the pool stores the model dtype (bf16 on TPU,
+# f32 in the CPU test configs). int8/fp8 store quantized payloads plus
+# per-(token, head) f32 absmax scales.
+KV_DTYPES = ("bf16", "int8", "fp8")
+
+# fp8 support rides the jax build; gate on availability instead of
+# importing unconditionally so older jaxlibs still serve int8/bf16.
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+
+def kv_payload_dtype(name: str):
+    """Payload dtype for one quantized rung of the ladder."""
+    if name == "int8":
+        return jnp.int8
+    if name == "fp8":
+        if _FP8_DTYPE is None:
+            raise ValueError(
+                "kv_dtype='fp8' requires a jax build with "
+                "float8_e4m3fn; this one has none — use int8 or bf16")
+        return _FP8_DTYPE
+    raise ValueError(f"unknown quantized kv_dtype {name!r}; "
+                     f"expected one of {KV_DTYPES}")
+
+
+def resolve_kv_dtypes(num_layers: int, kv_dtype: str,
+                      kv_dtype_per_layer=None):
+    """Validate the precision ladder → ``(payload_dtype | None, hi_layers)``.
+
+    ``payload_dtype`` is None for a full-width pool. A per-layer
+    override must be a contiguous "bf16" PREFIX (the ``hi_layers``
+    full-width layers, where quantization divergence concentrates)
+    followed by one uniform quantized dtype — arbitrary interleavings
+    would need per-layer pool pytrees and buy nothing the prefix split
+    doesn't."""
+    if kv_dtype not in KV_DTYPES:
+        raise ValueError(f"kv_dtype must be one of {KV_DTYPES}, "
+                         f"got {kv_dtype!r}")
+    if kv_dtype_per_layer is None:
+        if kv_dtype == "bf16":
+            return None, 0
+        return kv_payload_dtype(kv_dtype), 0
+    per = tuple(kv_dtype_per_layer)
+    if len(per) != num_layers:
+        raise ValueError(
+            f"kv_dtype_per_layer has {len(per)} entries for "
+            f"{num_layers} layers")
+    for name in per:
+        if name not in KV_DTYPES:
+            raise ValueError(f"kv_dtype_per_layer entry {name!r} not "
+                             f"in {KV_DTYPES}")
+    n_hi = 0
+    while n_hi < num_layers and per[n_hi] == "bf16":
+        n_hi += 1
+    tail = set(per[n_hi:])
+    if not tail:
+        return None, 0          # all-bf16 override → plain pool
+    if len(tail) != 1:
+        raise ValueError(
+            "kv_dtype_per_layer must be a contiguous 'bf16' prefix "
+            f"followed by one uniform quantized dtype, got {per}")
+    (qname,) = tail
+    if kv_dtype != "bf16" and qname != kv_dtype:
+        raise ValueError(
+            f"kv_dtype_per_layer tail {qname!r} contradicts "
+            f"kv_dtype={kv_dtype!r}")
+    return kv_payload_dtype(qname), n_hi
 
 
 class BlocksExhausted(RuntimeError):
@@ -70,10 +150,23 @@ class PagedKVPool(NamedTuple):
     """The device-side block pool. ``k``/``v`` are
     ``(L, num_blocks, block_size, Hkv, Dh)``; block 0..num_blocks-1 are
     real, and writers address "drop this write" as block id
-    ``num_blocks`` (out of range → ``mode="drop"`` scatter no-op)."""
+    ``num_blocks`` (out of range → ``mode="drop"`` scatter no-op).
+
+    A QUANTIZED pool (``kv_dtype`` int8/fp8) stores the payload in
+    ``k``/``v`` at reduced width plus per-(block, position, head) f32
+    absmax scales in ``k_scale``/``v_scale``
+    ``(Lq, num_blocks, block_size, Hkv)``. With a
+    ``kv_dtype_per_layer`` override the first ``hi_layers`` layers
+    live full-width in ``k_hi``/``v_hi`` and the payload tensors hold
+    only the quantized tail (``Lq = L - hi_layers``). All shape- and
+    None-derived properties are static under jit."""
 
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+    k_hi: Optional[jnp.ndarray] = None
+    v_hi: Optional[jnp.ndarray] = None
 
     @property
     def num_blocks(self) -> int:
@@ -83,18 +176,81 @@ class PagedKVPool(NamedTuple):
     def block_size(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+    @property
+    def hi_layers(self) -> int:
+        return 0 if self.k_hi is None else self.k_hi.shape[0]
+
+    @property
+    def num_layers(self) -> int:
+        return self.hi_layers + self.k.shape[0]
+
+    @property
+    def full_dtype(self):
+        """The full-width dtype this pool dequantizes to."""
+        if self.k_hi is not None:
+            return self.k_hi.dtype
+        return self.k.dtype if self.k_scale is None else jnp.bfloat16
+
+
+class BlockPayload(NamedTuple):
+    """Pool-native payload of a set of blocks — the quantization-
+    preserving unit of KV movement (host-RAM tier, migration
+    checkpoints, quantized prefix export). Fields mirror
+    :class:`PagedKVPool` with the pool axis replaced by the gathered
+    block axis ``(·, n, block_size, ...)``; arrays may be device or
+    numpy (both sides of a swap)."""
+
+    k: Any
+    v: Any
+    k_scale: Any = None
+    v_scale: Any = None
+    k_hi: Any = None
+    v_hi: Any = None
+
 
 def init_paged_pool(config: ModelConfig, num_blocks: int,
-                    block_size: int) -> PagedKVPool:
-    """Zeroed pool sized for ``config``. The paged layout does not
-    support the int8 cache (``config.kv_quant``) — the engine falls
-    back to the slot layout there."""
-    head_dim = config.head_dim
-    shape = (config.num_layers, num_blocks, block_size,
-             config.num_kv_heads, head_dim)
-    dtype = config.dtype
-    return PagedKVPool(k=jnp.zeros(shape, dtype=dtype),
-                       v=jnp.zeros(shape, dtype=dtype))
+                    block_size: int, kv_dtype: str = "bf16",
+                    kv_dtype_per_layer=None) -> PagedKVPool:
+    """Zeroed pool sized for ``config``. ``kv_dtype`` selects the
+    serving precision ladder rung; ``kv_dtype_per_layer`` optionally
+    keeps a bf16 prefix of layers full-width (see
+    :func:`resolve_kv_dtypes`). The legacy slot-cache int8 switch
+    (``config.kv_quant``) is a different mechanism — the engine still
+    falls back to the slot layout there."""
+    hkv, dh = config.num_kv_heads, config.head_dim
+    num_layers = config.num_layers
+    payload, n_hi = resolve_kv_dtypes(num_layers, kv_dtype,
+                                      kv_dtype_per_layer)
+    if payload is None:
+        shape = (num_layers, num_blocks, block_size, hkv, dh)
+        return PagedKVPool(k=jnp.zeros(shape, dtype=config.dtype),
+                           v=jnp.zeros(shape, dtype=config.dtype))
+    lq = num_layers - n_hi
+    qshape = (lq, num_blocks, block_size, hkv, dh)
+    sshape = qshape[:-1]
+    hi_shape = (n_hi, num_blocks, block_size, hkv, dh)
+    return PagedKVPool(
+        k=jnp.zeros(qshape, dtype=payload),
+        v=jnp.zeros(qshape, dtype=payload),
+        k_scale=jnp.zeros(sshape, jnp.float32),
+        v_scale=jnp.zeros(sshape, jnp.float32),
+        k_hi=jnp.zeros(hi_shape, config.dtype) if n_hi else None,
+        v_hi=jnp.zeros(hi_shape, config.dtype) if n_hi else None)
+
+
+def pool_bytes_per_block(pool: PagedKVPool) -> int:
+    """Device bytes one block occupies across every pool tensor
+    (payload + scales + full-width prefix) — the unit the allocator's
+    byte gauges multiply by."""
+    total = 0
+    for a in pool:
+        if a is not None:
+            total += int(a.size) * jnp.dtype(a.dtype).itemsize
+    return total // pool.num_blocks
 
 
 class BlockAllocator:
@@ -105,11 +261,16 @@ class BlockAllocator:
     engine → allocator)."""
 
     def __init__(self, num_blocks: int, block_size: int, *,
-                 registry=None):
+                 registry=None, bytes_per_block: int = 0):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_blocks = num_blocks
         self.block_size = block_size
+        # Device bytes per block (payload + scales + full-width prefix,
+        # see pool_bytes_per_block). 0 = unknown; the byte gauges then
+        # publish 0 and block counts remain the only capacity signal.
+        self.bytes_per_block = int(bytes_per_block)
+        self._swapped_blocks = 0
         self._lock = threading.RLock()
         # LIFO free list: recently-freed blocks are re-used first (their
         # pool lines are warmest in HBM/cache).
@@ -166,6 +327,19 @@ class BlockAllocator:
         self._swapped_gauge = registry.gauge(
             "senweaver_kv_swapped_blocks",
             "KV blocks currently resident only in the host-RAM tier.")
+        # Byte-denominated twins of the block gauges: with mixed-dtype
+        # pools during a precision-ladder rollout, a block on an int8
+        # replica holds ~half the bytes of one on a bf16 replica, so
+        # fleet capacity math must happen in bytes. The block-count
+        # gauges above stay as compatibility aliases.
+        self._bytes_device_gauge = registry.gauge(
+            "senweaver_kv_bytes_device",
+            "Device bytes held by allocated KV blocks (payload + "
+            "scales + full-width prefix layers).")
+        self._bytes_host_gauge = registry.gauge(
+            "senweaver_kv_bytes_host",
+            "Host-RAM bytes held by KV blocks swapped to the host "
+            "tier.")
         self._publish_gauges()
 
     # -- introspection (reads; callers may race, values are advisory) ----
@@ -176,6 +350,17 @@ class BlockAllocator:
     @property
     def used_blocks(self) -> int:
         return self.num_blocks - len(self._free)
+
+    @property
+    def used_bytes(self) -> int:
+        """Device bytes held by allocated blocks (0 when the allocator
+        was built without a ``bytes_per_block``)."""
+        return self.used_blocks * self.bytes_per_block
+
+    @property
+    def swapped_bytes(self) -> int:
+        """Host-tier bytes held by swapped-out blocks."""
+        return self._swapped_blocks * self.bytes_per_block
 
     def refcount(self, block: int) -> int:
         return self._ref[block]
@@ -201,9 +386,12 @@ class BlockAllocator:
                           if r > 1]
                 detail = (f"; {len(shared)} shared (block, refs): "
                           f"{shared[:8]}" if shared else "")
+                held_bytes = (f" ({len(held) * self.bytes_per_block} "
+                              f"device bytes)"
+                              if self.bytes_per_block else "")
                 raise AssertionError(
                     f"KV block leak: {len(held)} block(s) still "
-                    f"referenced: {held[:16]}{detail}")
+                    f"referenced{held_bytes}: {held[:16]}{detail}")
 
     # -- allocation ------------------------------------------------------
     def alloc(self, n: int) -> List[int]:
@@ -327,9 +515,13 @@ class BlockAllocator:
             self._swap_in_total.inc(nblk)
 
     def set_swapped_blocks(self, n: int) -> None:
-        """Publish how many blocks live only in the host tier."""
+        """Publish how many blocks live only in the host tier (the
+        block-count gauge is the compatibility alias; the authoritative
+        ledger is the byte gauge beside it)."""
         with self._lock:
+            self._swapped_blocks = n
             self._swapped_gauge.set(n)
+            self._bytes_host_gauge.set(n * self.bytes_per_block)
 
     # -- gauges ----------------------------------------------------------
     def _publish_gauges(self) -> None:
@@ -339,6 +531,7 @@ class BlockAllocator:
         self._blocks_free_gauge.set(free)
         used = self.num_blocks - free
         self._util_gauge.set(used / self.num_blocks)
+        self._bytes_device_gauge.set(used * self.bytes_per_block)
 
     def publish_fragmentation(self, used_tokens: int) -> None:
         """Internal-fragmentation gauge: ``used_tokens`` positions live
@@ -363,12 +556,16 @@ class PagedSeqKV:
 
     def __init__(self, config: ModelConfig, *, max_len: int,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 registry=None):
+                 registry=None, kv_dtype: str = "bf16",
+                 kv_dtype_per_layer=None):
         if num_blocks is None:
             num_blocks = -(-max_len // block_size)
-        self.allocator = BlockAllocator(num_blocks, block_size,
-                                        registry=registry)
-        self.pool = init_paged_pool(config, num_blocks, block_size)
+        self.pool = init_paged_pool(config, num_blocks, block_size,
+                                    kv_dtype=kv_dtype,
+                                    kv_dtype_per_layer=kv_dtype_per_layer)
+        self.allocator = BlockAllocator(
+            num_blocks, block_size, registry=registry,
+            bytes_per_block=pool_bytes_per_block(self.pool))
         self.max_blocks = -(-max_len // block_size)
         self.table: List[int] = []
         self.length = 0
@@ -406,29 +603,112 @@ class PagedSeqKV:
 def copy_blocks(pool: PagedKVPool, src: jnp.ndarray,
                 dst: jnp.ndarray) -> PagedKVPool:
     """Copy pool blocks ``src[i] -> dst[i]`` (both ``(n,)`` int32) in
-    one gather+scatter per tensor — the COW copy."""
-    return PagedKVPool(k=pool.k.at[:, dst].set(pool.k[:, src]),
-                       v=pool.v.at[:, dst].set(pool.v[:, src]))
+    one gather+scatter per tensor — the COW copy. tree_map covers every
+    pool tensor (payload, scales, full-width prefix), so quantize-at-
+    write commutes with COW: a copied block carries its scales with
+    it."""
+    return jax.tree_util.tree_map(
+        lambda a: a.at[:, dst].set(a[:, src]), pool)
 
 
 @functools.partial(jax.jit, donate_argnames=("pool",))
 def install_blocks(pool: PagedKVPool, k_buf: jnp.ndarray,
                    v_buf: jnp.ndarray, dst: jnp.ndarray) -> PagedKVPool:
-    """Scatter contiguous buffers ``(L, n, block_size, Hkv, Dh)`` into
-    pool blocks ``dst`` ``(n,)`` — the cross-engine prefix import."""
-    return PagedKVPool(k=pool.k.at[:, dst].set(k_buf),
-                       v=pool.v.at[:, dst].set(v_buf))
+    """Scatter FULL-WIDTH buffers ``(L, n, block_size, Hkv, Dh)`` into
+    pool blocks ``dst`` ``(n,)`` — the cross-engine prefix import.
+    Quantized pools quantize at install (same absmax math as the fused
+    step's quantize-at-write, so installed and decoded blocks hold
+    bit-identical payloads); the ``hi_layers`` prefix stays full
+    width."""
+    if pool.k_scale is None:
+        return pool._replace(
+            k=pool.k.at[:, dst].set(k_buf.astype(pool.k.dtype)),
+            v=pool.v.at[:, dst].set(v_buf.astype(pool.v.dtype)))
+    n_hi = pool.hi_layers
+    upd = {}
+    if n_hi:
+        upd["k_hi"] = pool.k_hi.at[:, dst].set(
+            k_buf[:n_hi].astype(pool.k_hi.dtype))
+        upd["v_hi"] = pool.v_hi.at[:, dst].set(
+            v_buf[:n_hi].astype(pool.v_hi.dtype))
+    kq, ks = quantize_pool_kv(k_buf[n_hi:], pool.k.dtype)
+    vq, vs = quantize_pool_kv(v_buf[n_hi:], pool.v.dtype)
+    upd["k"] = pool.k.at[:, dst].set(kq)
+    upd["v"] = pool.v.at[:, dst].set(vq)
+    upd["k_scale"] = pool.k_scale.at[:, dst].set(ks)
+    upd["v_scale"] = pool.v_scale.at[:, dst].set(vs)
+    return pool._replace(**upd)
+
+
+@functools.partial(jax.jit, donate_argnames=("pool",))
+def install_blocks_quant(pool: PagedKVPool, payload: BlockPayload,
+                         dst: jnp.ndarray) -> PagedKVPool:
+    """Scatter a pool-native :class:`BlockPayload` into blocks ``dst``
+    — the quantization-preserving inverse of
+    :func:`gather_blocks_quant` (host-tier restore, migration install,
+    quantized prefix import). The payload layout must match the pool's
+    (same ladder rung); mismatches are a caller bug surfaced here."""
+    if (payload.k_scale is None) != (pool.k_scale is None) or \
+            (payload.k_hi is None) != (pool.k_hi is None):
+        raise ValueError(
+            "BlockPayload quantization layout does not match the pool "
+            "(payload must come from a pool on the same kv_dtype rung)")
+    upd = {"k": pool.k.at[:, dst].set(
+               jnp.asarray(payload.k, pool.k.dtype)),
+           "v": pool.v.at[:, dst].set(
+               jnp.asarray(payload.v, pool.v.dtype))}
+    if pool.k_scale is not None:
+        upd["k_scale"] = pool.k_scale.at[:, dst].set(
+            jnp.asarray(payload.k_scale, jnp.float32))
+        upd["v_scale"] = pool.v_scale.at[:, dst].set(
+            jnp.asarray(payload.v_scale, jnp.float32))
+    if pool.k_hi is not None:
+        upd["k_hi"] = pool.k_hi.at[:, dst].set(
+            jnp.asarray(payload.k_hi, pool.k_hi.dtype))
+        upd["v_hi"] = pool.v_hi.at[:, dst].set(
+            jnp.asarray(payload.v_hi, pool.v_hi.dtype))
+    return pool._replace(**upd)
+
+
+@functools.partial(jax.jit, static_argnames=("dtype",))
+def gather_blocks(pool: PagedKVPool, idx: jnp.ndarray, dtype=None):
+    """Contiguous FULL-WIDTH ``(L, n*block_size, Hkv, Dh)`` view of
+    pool blocks ``idx`` ``(n,)`` — the prefix export. Quantized pools
+    dequantize here (and re-prepend the full-width prefix layers), so
+    every caller sees the same fleet-wide layout regardless of the
+    replica's ladder rung. ``dtype`` overrides the output dtype
+    (defaults to the pool's full-width dtype)."""
+    bs = pool.k.shape[2]
+    n = idx.shape[0]
+    if dtype is None:
+        dtype = pool.full_dtype
+
+    def flat(a):
+        return a[:, idx].reshape(a.shape[0], n * bs, *a.shape[3:])
+
+    if pool.k_scale is None:
+        return flat(pool.k).astype(dtype), flat(pool.v).astype(dtype)
+    k = dequantize_pool_kv(flat(pool.k), flat(pool.k_scale), dtype)
+    v = dequantize_pool_kv(flat(pool.v), flat(pool.v_scale), dtype)
+    if pool.k_hi is not None:
+        k = jnp.concatenate([flat(pool.k_hi).astype(dtype), k], axis=0)
+        v = jnp.concatenate([flat(pool.v_hi).astype(dtype), v], axis=0)
+    return k, v
 
 
 @jax.jit
-def gather_blocks(pool: PagedKVPool, idx: jnp.ndarray):
-    """Contiguous ``(L, n*block_size, Hkv, Dh)`` view of pool blocks
-    ``idx`` ``(n,)`` — the prefix export."""
-    l, _, bs, hkv, dh = pool.k.shape
-    n = idx.shape[0]
-    k = pool.k[:, idx].reshape(l, n * bs, hkv, dh)
-    v = pool.v[:, idx].reshape(l, n * bs, hkv, dh)
-    return k, v
+def gather_blocks_quant(pool: PagedKVPool,
+                        idx: jnp.ndarray) -> BlockPayload:
+    """Raw block-layout payload of pool blocks ``idx`` — quantized
+    payloads STAY quantized (int8/fp8 bytes + scales), halving host-
+    tier footprint and migration/export wire bytes relative to the
+    dequantizing :func:`gather_blocks`."""
+    def grab(a):
+        return None if a is None else a[:, idx]
+    return BlockPayload(k=grab(pool.k), v=grab(pool.v),
+                        k_scale=grab(pool.k_scale),
+                        v_scale=grab(pool.v_scale),
+                        k_hi=grab(pool.k_hi), v_hi=grab(pool.v_hi))
 
 
 # Runtime observatory wiring (obs/runtime_profile.py): block movement is
@@ -439,5 +719,9 @@ copy_blocks = ProfiledFunction(copy_blocks, "paged_kv.copy",
                                storm_threshold=32)
 install_blocks = ProfiledFunction(install_blocks, "paged_kv.install",
                                   storm_threshold=32)
+install_blocks_quant = ProfiledFunction(
+    install_blocks_quant, "paged_kv.install_quant", storm_threshold=32)
 gather_blocks = ProfiledFunction(gather_blocks, "paged_kv.gather",
                                  storm_threshold=32)
+gather_blocks_quant = ProfiledFunction(
+    gather_blocks_quant, "paged_kv.gather_quant", storm_threshold=32)

@@ -58,22 +58,24 @@ def _verify_forward(params: Params, config: ModelConfig, tokens: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("config", "last_only"),
-                   donate_argnames=("pool_k", "pool_v"))
+                   donate_argnames=("pool",))
 def _verify_forward_paged(params: Params, config: ModelConfig,
                           tokens: jax.Array, tables: jax.Array,
                           positions: jax.Array, write_block: jax.Array,
-                          write_off: jax.Array, pool_k: jax.Array,
-                          pool_v: jax.Array, last_only: bool):
+                          write_off: jax.Array, pool: PagedKVPool,
+                          last_only: bool):
     """Paged verify: feed (k,) tokens through the block-table forward.
     ``last_only`` slices the final row in-jit (prefill — avoids
-    materializing (n_prompt, V) fp32 on host just to keep one row)."""
-    logits, pool_k, pool_v = forward_paged(
-        params, config, tokens, pool_k=pool_k, pool_v=pool_v,
+    materializing (n_prompt, V) fp32 on host just to keep one row).
+    The pool rides through as the whole pytree, so quantized ladders
+    (scales + optional full-width prefix) verify through the same jit."""
+    logits, pool = forward_paged(
+        params, config, tokens, pool=pool,
         tables=tables, seq_row=jnp.zeros_like(tokens),
         positions=positions, write_block=write_block, write_off=write_off)
     if last_only:
         logits = logits[-1:]
-    return logits, pool_k, pool_v
+    return logits, pool
 
 
 # Runtime observatory wiring (obs/runtime_profile.py): the verify
@@ -106,7 +108,7 @@ class SpeculativeDecoder:
     def __init__(self, target_params: Params, target_config: ModelConfig,
                  draft_params: Params, draft_config: ModelConfig, *,
                  k: int = 4, kv_layout: str = "slots",
-                 block_size: int = 16):
+                 block_size: int = 16, kv_dtype: str = "bf16"):
         if target_config.vocab_size != draft_config.vocab_size:
             raise ValueError(
                 "draft and target must share a vocabulary "
@@ -134,6 +136,15 @@ class SpeculativeDecoder:
         # (target, draft) caches so tests can assert no block leaks.
         self.kv_layout = kv_layout
         self.block_size = block_size
+        # Quantized KV ladder on the TARGET cache only (paged layout):
+        # acceptance compares the target's argmax against proposals, so
+        # the exactness budget is the target's; the draft cache stays
+        # full-width — it is small and its quality only moves the
+        # acceptance RATE, never the output distribution.
+        if kv_dtype != "bf16" and kv_layout != "paged":
+            raise ValueError("kv_dtype quantized ladder needs "
+                             "kv_layout='paged'")
+        self.kv_dtype = kv_dtype
         self._last_paged_kv: Optional[Tuple[PagedSeqKV, PagedSeqKV]] = None
         self.rounds = 0          # verify forwards issued (observability)
         self.accepted = 0        # proposals accepted across rounds
@@ -159,7 +170,8 @@ class SpeculativeDecoder:
         paged = self.kv_layout == "paged"
         if paged:
             t_kv = PagedSeqKV(self.tc, max_len=max_len,
-                              block_size=self.block_size)
+                              block_size=self.block_size,
+                              kv_dtype=self.kv_dtype)
             d_kv = PagedSeqKV(self.dc, max_len=max_len,
                               block_size=self.block_size)
             self._last_paged_kv = (t_kv, d_kv)
@@ -295,13 +307,12 @@ class SpeculativeDecoder:
         kv.ensure(start + len(toks))
         bs = kv.allocator.block_size
         poss = list(range(start, start + len(toks)))
-        logits, pk, pv = _verify_forward_paged(
+        logits, kv.pool = _verify_forward_paged(
             params, config, jnp.asarray(toks, jnp.int32),
             kv.tables_array(), jnp.asarray(poss, jnp.int32),
             jnp.asarray([kv.table[p // bs] for p in poss], jnp.int32),
             jnp.asarray([p % bs for p in poss], jnp.int32),
-            kv.pool.k, kv.pool.v, last_only)
-        kv.pool = PagedKVPool(k=pk, v=pv)
+            kv.pool, last_only)
         kv.length = start + len(toks)
         return logits
 

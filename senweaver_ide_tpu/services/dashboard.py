@@ -402,6 +402,12 @@ class DashboardService:
                 "swaps_out": total("senweaver_kv_swaps_out_total"),
                 "swaps_in": total("senweaver_kv_swaps_in_total"),
                 "swapped_blocks": total("senweaver_kv_swapped_blocks"),
+                # quantized-ladder byte ledger: device KV held by live
+                # blocks and KV parked in the host tier, at whatever
+                # rung each pool runs (int8 pools report ~3x fewer
+                # bytes per block than bf16)
+                "bytes_device": total("senweaver_kv_bytes_device"),
+                "bytes_host": total("senweaver_kv_bytes_host"),
                 "preemption_storms": total(
                     "senweaver_kv_preemption_storms_total"),
                 "kv_gated": total("senweaver_serve_kv_gated"),

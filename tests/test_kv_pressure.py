@@ -135,8 +135,8 @@ def test_torn_swap_falls_back_to_eviction_leak_free(model, monkeypatch):
 
     def boom(pool, ids):
         raise ChaosError("injected gather kill mid-swap")
-    monkeypatch.setattr("senweaver_ide_tpu.rollout.engine.gather_blocks",
-                        boom)
+    monkeypatch.setattr(
+        "senweaver_ide_tpu.rollout.engine.gather_blocks_quant", boom)
 
     # 4+16 tokens = 5 blocks against 4 free: exhaustion tries to tier
     # the prefix, the gather dies, eviction reclaims instead

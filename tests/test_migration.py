@@ -156,6 +156,80 @@ def test_block_size_mismatch_falls_back_to_recompute(model):
     b._alloc.check_leaks()
 
 
+def test_kv_dtype_fence_falls_back_to_recompute(model):
+    """A checkpoint snapshotted on the int8 ladder must NEVER splice
+    its payload into a different-flavor pool: restoring onto a bf16
+    engine takes the recompute path (zero install copies) and still
+    completes; restoring onto a matching int8 engine splices the
+    quantized payload + scales and is token-exact within the rung."""
+    cfg = EngineConfig(kv_layout="paged", block_size=4,
+                       kv_dtype="int8")
+    ref_eng = make_engine(model, engine_config=cfg)
+    ref_rid = ref_eng.submit(PROMPT, max_new_tokens=12)
+    ref = ref_eng.run()[ref_rid]
+
+    a = make_engine(model, engine_config=cfg)
+    rid = a.submit(PROMPT, max_new_tokens=12)
+    for _ in range(4):
+        a.step()
+    ckpt = a.checkpoint_request(rid)
+    assert ckpt.format_version == CHECKPOINT_FORMAT
+    assert ckpt.kv_dtype == "int8"
+    assert ckpt.kv_k_scale is not None and ckpt.kv_v_scale is not None
+    assert ckpt.kv_k.dtype == np.int8
+    ckpt = DecodeCheckpoint.from_wire(ckpt.to_wire())  # wire round-trip
+    a.release_request(rid)
+
+    # same ladder: quantized fast-path splice, token-exact in-rung
+    b = make_engine(model, engine_config=cfg)
+    b_rid = b.restore_request(ckpt)
+    out = b.run()[b_rid]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert b.stats()["kv_install_copies"] == ckpt.kv_k.shape[1]
+
+    # cross-ladder: the fence drops the payload and re-prefills — the
+    # decode completes without ever installing foreign bytes
+    c = make_engine(model, engine_config=EngineConfig(
+        kv_layout="paged", block_size=4))
+    c_rid = c.restore_request(ckpt)
+    out_c = c.run()[c_rid]
+    assert len(out_c) == 12
+    assert out_c[:len(ckpt.tokens)] == list(ckpt.tokens)  # replayed
+    assert c.stats()["kv_install_copies"] == 0
+    assert c.stats()["migrations_in"] == 1
+    for eng in (a, b, c):
+        eng._alloc.check_leaks()
+
+
+def test_v1_checkpoint_wire_still_decodes(model):
+    """Format fencing, not format breakage: a pre-ladder (v1) wire
+    payload — no kv_dtype, no scale tensors — must still decode with
+    full-width semantics and restore through the fast path."""
+    a = make_engine(model)
+    rid = a.submit(PROMPT, max_new_tokens=12)
+    for _ in range(3):
+        a.step()
+    ckpt = a.checkpoint_request(rid)
+    wire = ckpt.to_wire()
+    assert wire["format_version"] == 2
+    v1 = {k: v for k, v in wire.items()
+          if k not in ("kv_dtype", "hi_layers", "kv_k_scale",
+                       "kv_v_scale", "kv_k_hi", "kv_v_hi")}
+    v1["format_version"] = 1
+    old = DecodeCheckpoint.from_wire(v1)
+    assert old.kv_dtype == "bf16" and old.hi_layers == 0
+    assert old.kv_k_scale is None
+    a.release_request(rid)
+
+    ref = reference(model)
+    b = make_engine(model)
+    b_rid = b.restore_request(old)
+    out = b.run()[b_rid]
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert b.stats()["kv_install_copies"] > 0   # fast path, not replay
+    b._alloc.check_leaks()
+
+
 def test_paused_request_is_frozen_until_resume(model):
     """Between snapshot and release the source row must not advance:
     freeze, step the engine, thaw — output still token-exact."""

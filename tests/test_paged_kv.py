@@ -248,6 +248,45 @@ def test_cross_layout_golden_decode(model):
     paged._alloc.check_leaks()
 
 
+@pytest.mark.parametrize("ladder", [
+    {"kv_dtype": "int8"},
+    {"kv_dtype": "int8", "kv_dtype_per_layer": ("bf16", "int8")},
+])
+def test_cross_layout_golden_decode_quantized(model, ladder):
+    """The golden gate extended down the precision ladder: quantized
+    paged layouts track the full-width golden stream within an explicit
+    divergence budget (the tiny random-init model's near-uniform logits
+    make bitwise equality across precision rungs meaningless — the gate
+    bounds token divergence instead), at strictly fewer bytes per
+    block."""
+    params, config = model
+    prompts = [[5, 9, 2, 7, 1, 3], [11, 3], [4, 4, 8, 1, 2, 6, 9, 5]]
+
+    golden = make_paged(model, num_slots=2)
+    g_rids = [golden.submit(p, max_new_tokens=10) for p in prompts]
+    g_out = golden.run()
+
+    quant = RolloutEngine(params, config, num_slots=2, max_len=64,
+                          sample=GREEDY,
+                          engine_config=EngineConfig(
+                              kv_layout="paged", block_size=4, **ladder))
+    q_rids = [quant.submit(p, max_new_tokens=10) for p in prompts]
+    q_out = quant.run()
+
+    total = match = 0
+    for gr, qr in zip(g_rids, q_rids):
+        assert len(g_out[gr]) == len(q_out[qr])
+        total += len(g_out[gr])
+        match += sum(int(a == b)
+                     for a, b in zip(g_out[gr], q_out[qr]))
+    assert match / total >= 0.6, (match, total)   # declared budget
+    assert quant.stats()["kv_dtype"] == "int8"
+    assert quant.stats()["kv_bytes_per_block"] \
+        < golden.stats()["kv_bytes_per_block"]
+    quant._alloc.check_leaks()
+    golden._alloc.check_leaks()
+
+
 # ---- fleet: shared-prefix import is graft-only per request ---------------
 
 def test_fleet_prefix_graft_zero_copy_per_request(model):
