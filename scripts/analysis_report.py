@@ -7,12 +7,14 @@ Usage::
         [--baseline senweaver_ide_tpu/analysis/baseline.json] [--json]
 
 Companion to ``scripts/serve_report.py`` and friends — this one answers
-"what does the linter see?": every current finding from the JIT purity
-pass and the lock-discipline pass, rolled up per rule and per module,
-plus the delta against the checked-in baseline (new findings that would
-fail the gate, entries the baseline still carries, and stale entries
-whose code has since been fixed). ``--json`` emits the same summary as
-a machine-readable object for CI artifacts.
+"what does the linter see?": every current finding from the JIT purity,
+lock-discipline, rpc-idempotency, metric-contract, and
+resource-lifetime passes, rolled up per rule and per module, plus the
+delta against the checked-in baseline (new findings that would fail the
+gate, entries the baseline still carries, and stale entries whose code
+has since been fixed). Clean rule families are listed too, so the
+report names what was checked, not just what failed. ``--json`` emits
+the same summary as a machine-readable object for CI artifacts.
 
 Exit codes follow the gate: 0 when the package is clean modulo the
 baseline, 1 when there are new or stale findings, 2 on bad inputs.
@@ -52,10 +54,21 @@ def summarize(root: str, baseline_path: str) -> Dict[str, Any]:
         parts = rel.split(os.sep)
         by_module[parts[1] if len(parts) > 2 else parts[-1]] += 1
 
+    # Family rollup over ALL registered rules (JIT/LOCK/RPC/MET/RES),
+    # so a clean family still shows up as checked-and-zero.
+    by_family: Dict[str, int] = {}
+    for rid in analysis.RULES:
+        family = rid.rstrip("0123456789")
+        by_family.setdefault(family, 0)
+    for rid, n in by_rule.items():
+        by_family[rid.rstrip("0123456789")] = (
+            by_family.get(rid.rstrip("0123456789"), 0) + n)
+
     return {
         "root": root,
         "baseline": baseline_path,
         "total_findings": len(found),
+        "by_family": dict(sorted(by_family.items())),
         "by_rule": dict(sorted(by_rule.items())),
         "by_module": dict(sorted(by_module.items())),
         "rules": {rid: analysis.RULES[rid]
@@ -73,7 +86,11 @@ def render(summary: Dict[str, Any]) -> str:
     lines = [f"analysis report for {summary['root']}",
              f"  findings: {summary['total_findings']}  "
              f"(gate {'PASS' if summary['gate_passes'] else 'FAIL'})",
-             "", "  by rule:"]
+             "", "  by family:"]
+    for fam, n in summary["by_family"].items():
+        lines.append(f"    {fam:<6} {n:>3}")
+    lines.append("")
+    lines.append("  by rule:")
     for rid, n in summary["by_rule"].items():
         desc = summary["rules"].get(rid, "")
         lines.append(f"    {rid}  {n:>3}  {desc}")

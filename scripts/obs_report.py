@@ -37,6 +37,14 @@ export_jsonl``) or snapshot records carrying ``rollups`` (a
 named, currently-firing alerts, and the last K incidents as triage
 one-liners. The companion question across processes: "and how was the
 REST of the fleet doing while it ran?".
+
+``--contract`` needs no JSONL at all: it prints the STATIC metric
+contract — the emitted inventory scanned from registry call sites
+(``analysis/metric_lint.build_inventory``), the documented rows from
+``docs/observability.md``, the dashboard's name reads, and the diff
+between the three sides. A non-empty diff is the same drift the
+``MET101``/``MET102`` gate fails in tier-1; this is the interactive
+view of it. Exit code 1 when the contract does not round-trip.
 """
 
 from __future__ import annotations
@@ -251,6 +259,40 @@ def render_fleet(fleet: Dict, *, last_k: int = 5) -> str:
     return "\n".join(lines)
 
 
+def render_contract(package_root: str) -> "tuple":
+    """(text, clean) — the static metric-contract inventory diff."""
+    from senweaver_ide_tpu.analysis import metric_lint
+
+    sites, consumers, rows = metric_lint.build_inventory(package_root)
+    findings = metric_lint.cross_check(sites, rows, consumers)
+
+    def _star(name, wild):
+        return name + ("*" if wild else "")
+
+    emitted = sorted({(_star(s.name, s.wildcard), s.mtype)
+                      for s in sites if s.name is not None})
+    lines = ["metric contract:",
+             f"  emitted: {len(emitted)} distinct name(s) from "
+             f"{len(sites)} call site(s)"]
+    for name, mtype in emitted:
+        lines.append(f"    {mtype:<9} {name}")
+    lines.append(f"  documented rows: "
+                 f"{len({(r.name, r.wildcard) for r in rows})}   "
+                 f"dashboard reads: "
+                 f"{len({(c.name, c.wildcard) for c in consumers})}")
+    drift = [f for f in findings if f.rule in ("MET101", "MET102")]
+    conflicts = [f for f in findings if f.rule == "MET103"]
+    if not drift and not conflicts:
+        lines.append("  round-trip: EXACT — code, docs, and dashboard "
+                     "agree")
+    else:
+        lines.append(f"  round-trip: DRIFTED — {len(drift)} mismatch(es)"
+                     f", {len(conflicts)} conflict(s)")
+        for f in drift + conflicts:
+            lines.append(f"    {f.rule} {f.path}:{f.line}  {f.message}")
+    return "\n".join(lines), not drift and not conflicts
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Per-stage latency summary of an obs span JSONL.")
@@ -279,13 +321,24 @@ def main(argv=None) -> int:
     parser.add_argument("--incidents", type=int, default=5,
                         help="incidents to show in the --fleet block "
                              "(default: 5)")
+    parser.add_argument("--contract", action="store_true",
+                        help="print the static metric-contract "
+                             "inventory (emissions vs docs vs "
+                             "dashboard) — no JSONL needed; exit 1 on "
+                             "drift")
     args = parser.parse_args(argv)
 
     if args.path is None and not (args.health or args.runtime
-                                  or args.fleet):
+                                  or args.fleet or args.contract):
         print("obs_report: need a span JSONL path or at least one of "
-              "--health/--runtime/--fleet", file=sys.stderr)
+              "--health/--runtime/--fleet/--contract", file=sys.stderr)
         return 2
+    contract_clean = True
+    if args.contract:
+        pkg = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "senweaver_ide_tpu")
+        text, contract_clean = render_contract(pkg)
+        print(text)
     spans = []
     if args.path is not None:
         if not os.path.exists(args.path):
@@ -339,7 +392,7 @@ def main(argv=None) -> int:
             return 2
         print("\n" + render_fleet(load_fleet_jsonl(args.fleet),
                                   last_k=args.incidents))
-    return 0
+    return 0 if contract_clean else 1
 
 
 if __name__ == "__main__":

@@ -97,6 +97,9 @@ class MetricsScrapeMixin:
 
     def _m_scrape(self, scraper_id: str = "fleet",
                   full: bool = False) -> Dict[str, Any]:
+        """Cached-mutating: advancing the cursor then losing the
+        response frame would drop that delta forever, so a retried
+        request id must REPLAY the recorded payload."""
         registry, journal, clock = self.scrape_sources()
         cursors = self._scrape_state()
         with self._scrape_cursors_lock:
@@ -484,14 +487,28 @@ class MetricsFederator:
             full = bool(self._resync.get(name))
         request_id = f"scrape:{self.scraper_id}:{name}:{seq}"
         params = {"scraper_id": self.scraper_id, "full": full}
-        for _attempt in range(self.retries + 1):
+        # Lazy import: resilience.retry only pulls resilience.faults, so
+        # this stays cycle-free even though obs can't import serve.
+        from ..resilience.retry import RetryBudget, RetryPolicy
+        budget = RetryBudget(
+            RetryPolicy(max_retries=self.retries, base_delay_s=0.0,
+                        jitter=False),
+            now=self.clock())
+        while True:
             try:
                 return transport.call(SCRAPE_METHOD, params,
                                       request_id=request_id)
             except Exception as e:
                 # Duck-typed rpc taxonomy (obs can't import serve):
-                # retriable wire weather gets ONE more try on the SAME
-                # idempotency key; anything else is an outage.
+                # retriable wire weather retries on the SAME idempotency
+                # key under the shared budget; anything else is an
+                # outage.
                 if not getattr(e, "retriable", False):
                     return None
-        return None
+                delay = budget.next_delay(
+                    now=self.clock(),
+                    retry_after_s=getattr(e, "retry_after_s", None))
+                if delay is None:
+                    return None
+                if delay > 0:
+                    time.sleep(delay)

@@ -14,11 +14,11 @@ publishes:
 - ``senweaver_rounds_total`` / ``senweaver_episodes_total`` /
   ``senweaver_trajectories_total`` counters,
 - ``senweaver_step_flops_per_sec`` and, when a peak-FLOPs figure is
-  known, ``senweaver_mfu``.
+  known, ``senweaver_train_mfu``.
 
 MFU: when the runtime observatory (``obs/runtime_profile.py``) has an
 XLA ``cost_analysis()`` FLOPs figure for the profiled GRPO step, the
-``senweaver_mfu`` gauge publishes the MEASURED utilization — compiled
+``senweaver_train_mfu`` gauge publishes the MEASURED utilization — compiled
 FLOPs per update over the round's wall time — instead of the analytic
 ``6 * params * tokens`` estimate (fwd 2x + bwd 4x), which remains the
 fallback when cost analysis is off. ``mfu_source`` in the returned dict
@@ -118,7 +118,7 @@ class StepTelemetry:
             "(cost_analysis-measured when the runtime ledger has the "
             "GRPO step, 6N/token analytic estimate otherwise).")
         self._mfu = r.gauge(
-            "senweaver_mfu",
+            "senweaver_train_mfu",
             "Model-FLOPs utilization of the last train step "
             "(vs. peak_flops; measured or analytic per "
             "senweaver_step_flops_per_sec).")

@@ -454,10 +454,12 @@ class _DraftMetricsView:
         return name.replace("senweaver_kv_", "senweaver_spec_draft_kv_")
 
     def gauge(self, name, desc=""):
-        return self._registry.gauge(self._rename(name), desc)
+        return self._registry.gauge(     # metric-name: senweaver_spec_draft_kv_*
+            self._rename(name), desc)
 
     def counter(self, name, desc=""):
-        return self._registry.counter(self._rename(name), desc)
+        return self._registry.counter(   # metric-name: senweaver_spec_draft_kv_*
+            self._rename(name), desc)
 
 
 @dataclasses.dataclass
@@ -894,7 +896,7 @@ class RolloutEngine:
                     "Target weight publishes since the draft was last "
                     "republished (0 = draft tracks the policy)."),
                 wasted_total=reg.counter(
-                    "senweaver_spec_wasted_draft_tokens",
+                    "senweaver_spec_wasted_draft_tokens_total",
                     "Draft tokens proposed but rejected by "
                     "verification (pure wasted draft+verify work)."))
             self._draft_pool = init_paged_pool(draft_config, nb, bs)

@@ -51,20 +51,29 @@ from .replica import DEAD
 # whose response was lost must REPLAY, never double-stage), as does
 # federation ``scrape`` (a retried scrape must replay the same delta,
 # its cursor already advanced).
+LEARNER_MUTATING_METHODS = frozenset({"publish", "publish_adapter",
+                                      "scrape"})
 # Lease mutations are deliberately NOT cached — re-executing them on a
 # retry is safe (acquire grants a fresh higher epoch, renew/release
 # are idempotent on live state), whereas caching them lets a restarted
 # client whose request ids collide with a previous incarnation replay
 # that incarnation's lease grant and run at a zombie epoch, defeating
-# the fencing. Status/signals are reads and must see fresh state.
-LEARNER_MUTATING_METHODS = frozenset({"publish", "publish_adapter",
-                                      "scrape"})
+# the fencing (rpc_lint RPC103 keeps them OUT of the cached set).
+# publish_status rides along: each manual-pump poll advances the fleet
+# one step, so it mutates, but an extra step on a retry is harmless.
+LEARNER_REEXECUTE_SAFE_METHODS = frozenset({
+    "acquire_lease", "renew_lease", "release_lease", "publish_status"})
+# Reads; never cached, must see fresh state.
+LEARNER_READONLY_METHODS = frozenset({"signals", "fleet_stats",
+                                      "health"})
 
 
 class FleetRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     """Lease + fenced-publish dispatch table over one ServingFleet."""
 
     mutating_methods = LEARNER_MUTATING_METHODS
+    readonly_methods = LEARNER_READONLY_METHODS
+    reexecute_safe_methods = LEARNER_REEXECUTE_SAFE_METHODS
     # Stitched-trace role: spans from this handler belong to the
     # fleet/learner gateway process (see obs/propagation.py).
     span_service = "fleet"
@@ -82,23 +91,33 @@ class FleetRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
 
     # -- lease ---------------------------------------------------------------
     def _m_acquire_lease(self, holder, steal=False) -> Dict[str, Any]:
+        """Reexecute-safe, never cached: re-execution grants a fresh
+        HIGHER epoch, while a cached replay could hand a restarted
+        client a previous incarnation's (zombie) epoch."""
         lease = self.lease_store.acquire(str(holder), now=self.clock(),
                                          steal=bool(steal))
         return {"epoch": lease.epoch, "expires_at": lease.expires_at,
                 "ttl_s": self.lease_store.ttl_s}
 
     def _m_renew_lease(self, holder, epoch) -> Dict[str, Any]:
+        """Reexecute-safe: renewal is idempotent on LIVE state; a
+        cached replay could acknowledge an epoch that has since been
+        superseded."""
         lease = self.lease_store.renew(str(holder), int(epoch),
                                        now=self.clock())
         return {"epoch": lease.epoch, "expires_at": lease.expires_at}
 
     def _m_release_lease(self, holder, epoch) -> Dict[str, Any]:
+        """Reexecute-safe: releasing an already-released epoch is a
+        no-op on live state, so retries need no cache."""
         return {"released": self.lease_store.release(str(holder),
                                                      int(epoch))}
 
     # -- publish saga --------------------------------------------------------
     def _m_publish(self, params, epoch, version,
                    eager=False) -> Dict[str, Any]:
+        """Cached-mutating: a staged publish whose response was lost
+        must REPLAY on retry, never double-stage."""
         # Fencing check 1: the epoch must be the LIVE lease (raises
         # LeaseLost across the wire). Check 2 is the publisher's own
         # monotonic high-water mark — both must pass. ``eager``
@@ -112,6 +131,8 @@ class FleetRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
 
     def _m_publish_adapter(self, tenant_id, lora, epoch,
                            version=None) -> Dict[str, Any]:
+        """Cached-mutating: a lost-response retry replays the first
+        apply instead of re-installing the adapter."""
         # Same double fencing as _m_publish (live lease here, per-
         # tenant monotonic watermark in WeightPublisher), but the
         # apply is immediate and no-drain: there is no roll to poll.
@@ -123,6 +144,9 @@ class FleetRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
                 "epoch": int(epoch), "applied": True}
 
     def _m_publish_status(self) -> Dict[str, Any]:
+        """Reexecute-safe: each poll may pump the fleet one step, so it
+        mutates, but a retry's extra step is harmless and the caller
+        needs FRESH roll progress, never a cached replay."""
         # Manual-pump fleets advance one step per poll so a loopback
         # learner's status loop drives the roll it waits on; threaded
         # fleets are already pumped by their dispatcher.
@@ -189,9 +213,17 @@ LEASE_MUTATING_METHODS = frozenset({"scrape"})
 # request ids collide with a previous incarnation REPLAY that
 # incarnation's epoch and write as a zombie. Re-EXECUTING lease ops on
 # a retried request id is always safe (acquire grants a fresh higher
-# epoch; renew/release/validate act on live state), so no lease op is
-# cached. ``scrape`` (federation delta shipping) is the one exception:
-# its per-scraper cursor makes replays the only safe retry.
+# epoch; renew/release act on live state), so the mutating lease ops
+# live in the reexecute-safe set (rpc_lint RPC103 fails the gate if
+# one ever migrates into the cached set). ``scrape`` (federation delta
+# shipping) is the one exception: its per-scraper cursor makes replays
+# the only safe retry.
+LEASE_REEXECUTE_SAFE_METHODS = frozenset({
+    "acquire_lease", "renew_lease", "release_lease"})
+# validate_lease only READS (it raises when the epoch isn't live);
+# lease_info/health are plain reads.
+LEASE_READONLY_METHODS = frozenset({"validate_lease", "lease_info",
+                                    "health"})
 
 
 class LeaseRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
@@ -204,6 +236,8 @@ class LeaseRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     depend on N fleet clocks agreeing."""
 
     mutating_methods = LEASE_MUTATING_METHODS
+    readonly_methods = LEASE_READONLY_METHODS
+    reexecute_safe_methods = LEASE_REEXECUTE_SAFE_METHODS
     span_service = "lease"
 
     def __init__(self, store: Optional[LeaseStore] = None, *,
@@ -215,17 +249,22 @@ class LeaseRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
         self.clock = clock if clock is not None else _time.monotonic
 
     def _m_acquire_lease(self, holder, steal=False) -> Dict[str, Any]:
+        """Reexecute-safe, never cached: re-execution grants a fresh
+        HIGHER epoch; a cached replay would resurrect a zombie one."""
         lease = self.store.acquire(str(holder), now=self.clock(),
                                    steal=bool(steal))
         return {"epoch": lease.epoch, "expires_at": lease.expires_at,
                 "ttl_s": self.store.ttl_s}
 
     def _m_renew_lease(self, holder, epoch) -> Dict[str, Any]:
+        """Reexecute-safe: idempotent on live state; replay could
+        acknowledge a superseded epoch."""
         lease = self.store.renew(str(holder), int(epoch),
                                  now=self.clock())
         return {"epoch": lease.epoch, "expires_at": lease.expires_at}
 
     def _m_release_lease(self, holder, epoch) -> Dict[str, Any]:
+        """Reexecute-safe: double-release is a no-op on live state."""
         return {"released": self.store.release(str(holder), int(epoch))}
 
     def _m_validate_lease(self, epoch) -> Dict[str, Any]:
@@ -326,6 +365,7 @@ EXPERIENCE_MUTATING_METHODS = frozenset({"submit_episodes", "scrape"})
 # replaying keeps the collector's view of each episode's FIRST outcome
 # stable (an episode accepted then evicted must not flap to "stale" on
 # the retry of the same request).
+EXPERIENCE_READONLY_METHODS = frozenset({"stream_stats", "health"})
 
 
 class ExperienceRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
@@ -334,6 +374,7 @@ class ExperienceRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     ``intake(episodes)`` / ``stream_stats()``)."""
 
     mutating_methods = EXPERIENCE_MUTATING_METHODS
+    readonly_methods = EXPERIENCE_READONLY_METHODS
     span_service = "learner"
 
     def __init__(self, learner, *, idempotency_cache_size: int = 1024):
@@ -341,6 +382,9 @@ class ExperienceRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
         self.learner = learner
 
     def _m_submit_episodes(self, episodes) -> Dict[str, Any]:
+        """Cached-mutating: a batch whose ack frame was lost must
+        REPLAY the recorded acks on retry — re-offering would flap an
+        accepted-then-evicted episode's outcome to "stale"."""
         from ..training.experience import StreamedEpisode
         eps = [e if isinstance(e, StreamedEpisode)
                else StreamedEpisode.from_wire(dict(e))

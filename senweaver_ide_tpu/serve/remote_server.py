@@ -45,10 +45,27 @@ MUTATING_METHODS = frozenset({
     "checkpoint_request", "restore_checkpoint", "resume_request",
     "release_request"})
 
+# Reads are never cached and must see fresh state — a cached ``step``
+# replay is correct, a cached ``health`` a lie. Every dispatchable
+# method must appear in exactly one classification set (rpc_lint
+# RPC101 enforces it).
+READONLY_METHODS = frozenset({
+    "health", "meta", "is_done", "result", "result_logps",
+    "export_prefix", "stats"})
+
 
 class RpcHandlerBase:
     """Dispatch table + idempotency cache; subclasses provide ``_m_*``
-    methods and declare which of them mutate via ``mutating_methods``.
+    methods and classify each one into exactly one of three sets:
+
+    ``mutating_methods``        consult/populate the idempotency cache —
+                                a retried call REPLAYS its first outcome
+    ``readonly_methods``        never cached; must see fresh state
+    ``reexecute_safe_methods``  mutating but deliberately UNCACHED —
+                                re-execution on a retry is safe, replay
+                                is dangerous (the lease family: a cached
+                                grant replayed by a restarted client
+                                would resurrect a zombie epoch)
 
     The cache is the exactly-once half of the fleet's retry contract: a
     retried mutating call (the client saw a timeout; the server may or
@@ -56,6 +73,8 @@ class RpcHandlerBase:
     application ERRORS — instead of executing twice."""
 
     mutating_methods: frozenset = frozenset()
+    readonly_methods: frozenset = frozenset()
+    reexecute_safe_methods: frozenset = frozenset()
     # Span attribute naming the process role in a stitched trace
     # ("engine" host, "fleet" learner gateway, ...).
     span_service: str = "rpc"
@@ -155,6 +174,7 @@ class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
     plus the federation ``scrape`` endpoint from the mixin)."""
 
     mutating_methods = MUTATING_METHODS
+    readonly_methods = READONLY_METHODS
     span_service = "engine"
 
     def __init__(self, engine, *, idempotency_cache_size: int = 4096,
@@ -189,12 +209,17 @@ class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
 
     def _m_submit(self, prompt, max_new_tokens=128, prefix_id=None,
                   eos_id=None, hold_slot=False, continue_from=None) -> int:
+        """Cached-mutating: a retried submit must replay the SAME
+        request id — re-executing would enqueue the prompt twice."""
         return self.engine.submit(
             list(prompt), max_new_tokens=max_new_tokens,
             prefix_id=prefix_id, eos_id=eos_id, hold_slot=hold_slot,
             continue_from=continue_from)
 
     def _m_step(self) -> Dict[str, Any]:
+        """Cached-mutating: each step advances decode state, so a
+        lost-response retry must replay that step's tokens — executing
+        a second step would silently drop a token window."""
         # JSON object keys are strings; the client int()s them back.
         return {str(rid): toks
                 for rid, toks in self.engine.step().items()}
@@ -209,22 +234,33 @@ class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
         return [float(x) for x in self.engine.result_logps(int(rid))]
 
     def _m_release_slot(self, rid) -> None:
+        """Cached-mutating: replay keeps a retried release from
+        freeing a slot that was already reassigned to a new request."""
         self.engine.release_slot(int(rid))
 
     def _m_register_prefix(self, tokens) -> int:
+        """Cached-mutating: replay returns the SAME prefix id — a
+        second registration would pin a duplicate KV prefix."""
         return int(self.engine.register_prefix(list(tokens)))
 
     def _m_export_prefix(self, prefix_id):
         return self.engine.export_prefix(int(prefix_id))
 
     def _m_import_prefix(self, tokens, kv, last_logits=None) -> int:
+        """Cached-mutating: replay returns the first install's prefix
+        id instead of allocating the KV blocks a second time."""
         return int(self.engine.import_prefix(list(tokens), kv,
                                              last_logits))
 
     def _m_release_prefix(self, prefix_id) -> None:
+        """Cached-mutating: replay keeps a retried release from
+        double-decrementing the prefix refcount."""
         self.engine.release_prefix(int(prefix_id))
 
     def _m_update_params(self, params, version=None, epoch=None) -> None:
+        """Cached-mutating: a retried install replays the first
+        outcome; fresh re-execution would trip the (epoch, version)
+        fence below and misreport a stale publish."""
         if version is not None:
             from .weights import StalePublishError
             v, e = int(version), int(epoch or 0)
@@ -241,19 +277,29 @@ class EngineRpcHandler(MetricsScrapeMixin, RpcHandlerBase):
 
     # -- live migration (serve/scheduler.py) ---------------------------------
     def _m_checkpoint_request(self, rid, pause=True) -> Dict[str, Any]:
+        """Cached-mutating: freezes the row, so a lost-response retry
+        must replay the SAME snapshot, not cut a second one."""
         ckpt = self.engine.checkpoint_request(int(rid),
                                               pause=bool(pause))
         return ckpt.to_wire()
 
     def _m_restore_checkpoint(self, ckpt) -> int:
+        """Cached-mutating: the at-least-once install whose cache hit
+        makes it exactly-once — replay returns the first restore's rid
+        instead of materializing the decode twice."""
         from ..rollout.migration import DecodeCheckpoint
         return int(self.engine.restore_request(
             DecodeCheckpoint.from_wire(ckpt)))
 
     def _m_resume_request(self, rid) -> None:
+        """Cached-mutating: replay keeps a retried resume from
+        double-unpausing a row the scheduler re-froze since."""
         self.engine.resume_request(int(rid))
 
     def _m_release_request(self, rid) -> bool:
+        """Cached-mutating: replay preserves the first release's
+        verdict — re-executing would report False for a row that THIS
+        call already released."""
         return bool(self.engine.release_request(int(rid)))
 
     def _m_stats(self) -> Dict[str, Any]:

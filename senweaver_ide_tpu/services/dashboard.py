@@ -447,7 +447,7 @@ class DashboardService:
                 "draft_staleness":
                     gauge("senweaver_spec_draft_staleness"),
                 "wasted_draft_tokens":
-                    total("senweaver_spec_wasted_draft_tokens"),
+                    total("senweaver_spec_wasted_draft_tokens_total"),
                 "distill_steps":
                     total("senweaver_spec_distill_steps_total"),
                 "distill_loss": gauge("senweaver_spec_distill_loss"),
@@ -687,7 +687,7 @@ class DashboardService:
                     tps.value(phase="collect")
             else:
                 summary["tokens_per_sec"] = None
-            mfu = self.registry.get("senweaver_mfu")
+            mfu = self.registry.get("senweaver_train_mfu")
             summary["mfu"] = mfu.value() if mfu is not None else None
             rounds = self.registry.get("senweaver_rounds_total")
             summary["rounds_total"] = (rounds.value()

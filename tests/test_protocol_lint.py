@@ -1,0 +1,642 @@
+"""Unit tests for the distributed-protocol lints (PR 20): rpc_lint
+(RPC101-105), metric_lint (MET101-104), resource_lint (RES101-103) —
+one true-positive and one true-negative fixture per rule, the PR-7
+zombie-lease regression fixture, and the whole-package contract gates
+(baseline budget, zero MET baseline entries, live learner_server clean
+under RPC103 while the zombie fixture is convicted).
+
+Everything here is pure AST — no jax, no sockets — so this file is fast
+and runs identically on any platform.
+"""
+
+import textwrap
+from pathlib import Path
+
+from senweaver_ide_tpu import analysis
+from senweaver_ide_tpu.analysis import metric_lint, resource_lint, rpc_lint
+from senweaver_ide_tpu.analysis.findings import load_baseline
+
+_PKG = Path(analysis.__file__).resolve().parent.parent
+
+
+def _rpc(src):
+    return rpc_lint.lint_source(textwrap.dedent(src))
+
+
+def _met(src, doc=""):
+    return metric_lint.lint_source(textwrap.dedent(src),
+                                   doc_markdown=textwrap.dedent(doc))
+
+
+def _res(src):
+    return resource_lint.lint_source(textwrap.dedent(src))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# RPC101 — dispatchable method with unreviewed replay semantics
+# ---------------------------------------------------------------------------
+
+def test_rpc101_true_positive_unclassified():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"publish"})
+
+            def _m_publish(self, x):
+                '''Cached-mutating: a retry must replay, not re-stage.'''
+                return x
+
+            def _m_mystery(self, x):
+                return x
+    """)
+    assert any(f.rule == "RPC101" and f.symbol == "H._m_mystery"
+               for f in fs)
+    # the classified sibling is NOT flagged
+    assert not any(f.rule == "RPC101" and "publish" in f.symbol
+                   for f in fs)
+
+
+def test_rpc101_true_negative_all_classified():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"publish"})
+            readonly_methods = frozenset({"mystery"})
+
+            def _m_publish(self, x):
+                '''Cached-mutating: a retry must replay, not re-stage.'''
+                return x
+
+            def _m_mystery(self, x):
+                return x
+    """)
+    assert "RPC101" not in _rules(fs)
+
+
+def test_rpc101_true_positive_multiply_classified():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"stats"})
+            readonly_methods = frozenset({"stats"})
+
+            def _m_stats(self):
+                '''replay-safe read'''
+                return {}
+    """)
+    (f,) = [f for f in fs if f.rule == "RPC101"]
+    assert "multiple sets" in f.message
+
+
+def test_rpc101_classification_inherited_from_base():
+    # Sets declared on a parent handler cover the subclass's methods.
+    fs = _rpc("""
+        class Base(RpcHandlerBase):
+            readonly_methods = frozenset({"health"})
+
+        class H(Base):
+            def _m_health(self):
+                return {"ok": True}
+    """)
+    assert "RPC101" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RPC102 — client-side mutating call without an idempotency key
+# ---------------------------------------------------------------------------
+
+_RPC102_HANDLER = """
+    class H(RpcHandlerBase):
+        mutating_methods = frozenset({"publish"})
+        readonly_methods = frozenset({"signals"})
+
+        def _m_publish(self, x):
+            '''Cached-mutating: a retry must replay the staged publish.'''
+            return x
+
+        def _m_signals(self):
+            return {}
+"""
+
+
+def test_rpc102_true_positive_missing_key():
+    fs = _rpc(_RPC102_HANDLER + """
+    def client(transport):
+        return transport.call("publish", {"x": 1})
+    """)
+    assert any(f.rule == "RPC102" and f.symbol == "client" for f in fs)
+
+
+def test_rpc102_true_positive_explicit_none_key():
+    fs = _rpc(_RPC102_HANDLER + """
+    def client(transport):
+        return transport.call("publish", {"x": 1}, request_id=None)
+    """)
+    assert any(f.rule == "RPC102" for f in fs)
+
+
+def test_rpc102_true_negative_with_key_and_readonly():
+    fs = _rpc(_RPC102_HANDLER + """
+    def client(transport, op_id):
+        transport.call("publish", {"x": 1}, request_id=f"pub:{op_id}")
+        return transport.call("signals", {})   # readonly: no key needed
+    """)
+    assert "RPC102" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RPC103 — lease-shaped method in the CACHED mutating set (PR-7 class)
+# ---------------------------------------------------------------------------
+
+def test_rpc103_pr7_zombie_lease_regression_fixture():
+    # The exact PR-7 bug class: idempotency-caching a lease grant lets
+    # a restarted client replay a previous incarnation's (zombie)
+    # epoch. This fixture MUST stay convicted.
+    fs = _rpc("""
+        class LeaseHandler(RpcHandlerBase):
+            mutating_methods = frozenset({"acquire_lease", "renew_lease"})
+
+            def _m_acquire_lease(self, holder):
+                '''replayed grants are the bug'''
+                return 1
+
+            def _m_renew_lease(self, holder, epoch):
+                '''replay'''
+                return 1
+    """)
+    symbols = {f.symbol for f in fs if f.rule == "RPC103"}
+    assert symbols == {"LeaseHandler.acquire_lease",
+                       "LeaseHandler.renew_lease"}
+    assert all("zombie" in f.message
+               for f in fs if f.rule == "RPC103")
+
+
+def test_rpc103_true_negative_reexecute_safe():
+    fs = _rpc("""
+        class LeaseHandler(RpcHandlerBase):
+            reexecute_safe_methods = frozenset({"acquire_lease"})
+
+            def _m_acquire_lease(self, holder):
+                '''Reexecute-safe: re-execution grants a fresh epoch.'''
+                return 1
+    """)
+    assert "RPC103" not in _rules(fs)
+
+
+def test_rpc103_release_prefix_is_not_lease_shaped():
+    # "lease" is a substring of "release": release_prefix/release_slot
+    # must NOT trip the lease heuristic.
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"release_prefix", "release_slot"})
+
+            def _m_release_prefix(self, key):
+                '''Cached-mutating: replay the recorded release.'''
+                return 1
+
+            def _m_release_slot(self, sid):
+                '''Cached-mutating: replay the recorded release.'''
+                return 1
+    """)
+    assert "RPC103" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RPC104 — hand-rolled retry loop around a transport call
+# ---------------------------------------------------------------------------
+
+def test_rpc104_true_positive_bare_loop():
+    fs = _rpc("""
+        def poll(transport):
+            for _attempt in range(3):
+                try:
+                    return transport.call("health", {})
+                except Exception:
+                    continue
+            return None
+    """)
+    assert any(f.rule == "RPC104" and f.symbol == "poll" for f in fs)
+
+
+def test_rpc104_true_negative_retry_budget():
+    fs = _rpc("""
+        def poll(transport, budget, clock):
+            while True:
+                try:
+                    return transport.call("health", {})
+                except Exception:
+                    delay = budget.next_delay(now=clock())
+                    if delay is None:
+                        raise
+    """)
+    assert "RPC104" not in _rules(fs)
+
+
+def test_rpc104_true_negative_justified_hatch():
+    fs = _rpc("""
+        def drain(transport, items):
+            # retry: not a retry — one call per item, no reissue
+            for item in items:
+                transport.call("health", {"item": item})
+    """)
+    assert "RPC104" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RPC105 — mutating handler without a replay-semantics justification
+# ---------------------------------------------------------------------------
+
+def test_rpc105_true_positive_undocumented():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"publish"})
+
+            def _m_publish(self, x):
+                return x
+    """)
+    assert any(f.rule == "RPC105" and f.symbol == "H._m_publish"
+               for f in fs)
+
+
+def test_rpc105_true_negative_docstring():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"publish"})
+
+            def _m_publish(self, x):
+                '''Cached-mutating: a lost-response retry must REPLAY
+                the staged publish, never double-stage it.'''
+                return x
+    """)
+    assert "RPC105" not in _rules(fs)
+
+
+def test_rpc105_true_negative_comment_hatch():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            mutating_methods = frozenset({"publish"})
+
+            def _m_publish(self, x):
+                # replay: idempotent upsert — replay and re-execution agree
+                return x
+    """)
+    assert "RPC105" not in _rules(fs)
+
+
+def test_rpc105_readonly_methods_need_no_justification():
+    fs = _rpc("""
+        class H(RpcHandlerBase):
+            readonly_methods = frozenset({"health"})
+
+            def _m_health(self):
+                return {"ok": True}
+    """)
+    assert "RPC105" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# MET101 — emitted but undocumented (or doc row disagrees)
+# ---------------------------------------------------------------------------
+
+_DOC_OK = """
+    | metric | type | source |
+    | --- | --- | --- |
+    | `senweaver_foo_bar_total` | counter | somewhere |
+"""
+
+
+def test_met101_true_positive_undocumented():
+    fs = _met("""
+        def setup(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+    """, doc="| metric | type |\n| --- | --- |\n")
+    assert any(f.rule == "MET101"
+               and f.symbol == "senweaver_foo_bar_total" for f in fs)
+
+
+def test_met101_true_positive_type_conflict_with_doc():
+    fs = _met("""
+        def setup(registry):
+            registry.gauge("senweaver_foo_bar_total", "Help.")
+    """, doc=_DOC_OK)
+    assert any(f.rule == "MET101" and "documented as" in f.message
+               for f in fs)
+
+
+def test_met101_true_negative_documented():
+    fs = _met("""
+        def setup(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+    """, doc=_DOC_OK)
+    assert "MET101" not in _rules(fs)
+
+
+def test_met101_wildcard_emission_matches_wildcard_row():
+    fs = _met("""
+        def setup(registry, name):
+            registry.gauge(f"senweaver_family_{name}", "Help.")
+    """, doc="""
+        | metric | type |
+        | --- | --- |
+        | `senweaver_family_*` | gauge |
+    """)
+    assert "MET101" not in _rules(fs)
+    assert "MET104" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# MET102 — documented or dashboard-read but never emitted
+# ---------------------------------------------------------------------------
+
+def test_met102_true_positive_stale_doc_row():
+    fs = _met("", doc=_DOC_OK)
+    assert any(f.rule == "MET102"
+               and f.symbol == "senweaver_foo_bar_total" for f in fs)
+
+
+def test_met102_true_positive_dead_dashboard_read():
+    fs = _met("""
+        def tile(registry):
+            return registry.get("senweaver_ghost_gauge")
+    """)
+    assert any(f.rule == "MET102" and "nothing emits" in f.message
+               for f in fs)
+
+
+def test_met102_true_negative_round_trip():
+    fs = _met("""
+        def setup(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+
+        def tile(registry):
+            return registry.get("senweaver_foo_bar_total")
+    """, doc=_DOC_OK)
+    assert "MET102" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# MET103 — one name, conflicting registrations
+# ---------------------------------------------------------------------------
+
+def test_met103_true_positive_type_conflict():
+    fs = _met("""
+        def a(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+
+        def b(registry):
+            registry.gauge("senweaver_foo_bar_total", "Help.")
+    """, doc=_DOC_OK)
+    assert any(f.rule == "MET103" and "registered as gauge" in f.message
+               for f in fs)
+
+
+def test_met103_true_positive_label_conflict():
+    fs = _met("""
+        def a(registry):
+            registry.gauge("senweaver_foo_bar", "H.", labelnames=("x",))
+
+        def b(registry):
+            registry.gauge("senweaver_foo_bar", "H.", labelnames=("y",))
+    """, doc="""
+        | metric | type |
+        | --- | --- |
+        | `senweaver_foo_bar{x}` | gauge |
+    """)
+    assert any(f.rule == "MET103" and "labels" in f.message for f in fs)
+
+
+def test_met103_true_negative_idempotent_registration():
+    fs = _met("""
+        def a(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+
+        def b(registry):
+            registry.counter("senweaver_foo_bar_total", "Help.")
+    """, doc=_DOC_OK)
+    assert "MET103" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# MET104 — name grammar + dynamic-name escape hatch
+# ---------------------------------------------------------------------------
+
+def test_met104_true_positive_counter_without_total():
+    fs = _met("""
+        def setup(registry):
+            registry.counter("senweaver_foo_bar", "Help.")
+    """, doc="""
+        | metric | type |
+        | --- | --- |
+        | `senweaver_foo_bar` | counter |
+    """)
+    assert any(f.rule == "MET104" and "_total" in f.message for f in fs)
+
+
+def test_met104_true_positive_outside_grammar():
+    fs = _met("""
+        def setup(registry):
+            registry.gauge("queue_depth", "Help.")
+    """)
+    assert any(f.rule == "MET104" and f.symbol == "queue_depth"
+               for f in fs)
+
+
+def test_met104_true_positive_unresolvable_dynamic_name():
+    fs = _met("""
+        def setup(registry, name):
+            registry.gauge(name, "Help.")
+    """)
+    assert any(f.rule == "MET104" and "dynamic" in f.symbol for f in fs)
+
+
+def test_met104_true_negative_annotation_hatch():
+    fs = _met("""
+        def setup(registry, name):
+            registry.gauge(name,    # metric-name: senweaver_family_*
+                           "Help.")
+    """, doc="""
+        | metric | type |
+        | --- | --- |
+        | `senweaver_family_*` | gauge |
+    """)
+    assert _rules(fs) == set()
+
+
+def test_met104_forwarding_helper_stays_quiet():
+    # A view-object helper forwarding its own ``name`` param is not a
+    # registration site (the receiver is not registry-shaped).
+    fs = _met("""
+        class View:
+            def gauge(self, name, help_text=""):
+                return self._inner.gauge(name, help_text)
+    """)
+    assert "MET104" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RES101 — KV block table leaks on an exit path
+# ---------------------------------------------------------------------------
+
+def test_res101_true_positive_leak_at_raise():
+    fs = _res("""
+        class Engine:
+            def admit(self, n):
+                blocks = self.allocator.alloc(n)
+                if n > self.limit:
+                    raise ValueError(n)
+                self.table[n] = blocks
+    """)
+    (f,) = [f for f in fs if f.rule == "RES101"]
+    assert f.symbol == "Engine.admit" and "blocks" in f.message
+
+
+def test_res101_true_negative_release_before_raise():
+    fs = _res("""
+        class Engine:
+            def admit(self, n):
+                blocks = self.allocator.alloc(n)
+                if n > self.limit:
+                    self.allocator.release(blocks)
+                    raise ValueError(n)
+                self.table[n] = blocks
+    """)
+    assert "RES101" not in _rules(fs)
+
+
+def test_res101_true_negative_try_finally():
+    fs = _res("""
+        class Engine:
+            def probe(self, n):
+                blocks = self.allocator.alloc(n)
+                try:
+                    return self.score(blocks)
+                finally:
+                    self.allocator.release(blocks)
+    """)
+    assert "RES101" not in _rules(fs)
+
+
+def test_res101_true_negative_ownership_hatch():
+    fs = _res("""
+        class Engine:
+            def fork(self, n):
+                blocks = self.allocator.fork_n(n)  # ownership: transferred-to DecodeState
+                return blocks
+    """)
+    assert "RES101" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RES102 — adapter-pool binding retained without release
+# ---------------------------------------------------------------------------
+
+def test_res102_true_positive_bare_read_does_not_consume():
+    # ``if binding is None`` is a READ, not a hand-off: the raise path
+    # still owns the retained binding.
+    fs = _res("""
+        class Server:
+            def bind(self, tenant):
+                binding = self.pool.retain(tenant)
+                if binding is None:
+                    raise KeyError(tenant)
+                return binding
+    """)
+    assert any(f.rule == "RES102" and "raise" in f.message for f in fs)
+
+
+def test_res102_true_negative_release_on_error_path():
+    fs = _res("""
+        class Server:
+            def bind(self, tenant):
+                binding = self.pool.retain(tenant)
+                try:
+                    self.activate(binding)
+                except Exception:
+                    self.pool.release(binding)
+                    raise
+                return binding
+    """)
+    assert "RES102" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# RES103 — cache/pending entry without a completion path
+# ---------------------------------------------------------------------------
+
+def test_res103_true_positive_unbounded_pending():
+    fs = _res("""
+        class Tracker:
+            def start(self, rid, fut):
+                self._pending[rid] = fut
+    """)
+    (f,) = [f for f in fs if f.rule == "RES103"]
+    assert f.symbol == "Tracker._pending"
+
+
+def test_res103_true_negative_pop_completion():
+    fs = _res("""
+        class Tracker:
+            def start(self, rid, fut):
+                self._pending[rid] = fut
+
+            def finish(self, rid):
+                return self._pending.pop(rid, None)
+    """)
+    assert "RES103" not in _rules(fs)
+
+
+def test_res103_true_negative_del_completion():
+    fs = _res("""
+        class Cache:
+            def put(self, key, value):
+                self._cache[key] = value
+
+            def evict(self, key):
+                del self._cache[key]
+    """)
+    assert "RES103" not in _rules(fs)
+
+
+# ---------------------------------------------------------------------------
+# live-codebase contract (what the acceptance criteria pin)
+# ---------------------------------------------------------------------------
+
+def test_live_learner_server_is_rpc103_clean():
+    # The PR-7 fix holds: every lease op lives in reexecute_safe, so the
+    # same rule that convicts the zombie fixture passes the live server.
+    path = _PKG / "serve" / "learner_server.py"
+    fs = rpc_lint.lint_source(path.read_text(), str(path))
+    assert [f for f in fs if f.rule == "RPC103"] == []
+
+
+def test_live_package_protocol_lints_are_clean():
+    # The three new linters hold on the live tree with NO baseline debt
+    # (the JIT ledger entries are jit_lint's, not ours).
+    for mod in (rpc_lint, metric_lint, resource_lint):
+        fs = mod.lint_package(str(_PKG))
+        msgs = "\n".join(f.format() for f in fs)
+        assert fs == [], f"{mod.__name__} findings:\n{msgs}"
+
+
+def test_baseline_has_no_protocol_entries():
+    entries = load_baseline()
+    assert len(entries) <= 10
+    for e in entries:
+        assert not e["rule"].startswith(("RPC", "MET", "RES")), e
+
+
+def test_new_rules_registered_in_package_gate():
+    for rule in ("RPC101", "RPC102", "RPC103", "RPC104", "RPC105",
+                 "MET101", "MET102", "MET103", "MET104",
+                 "RES101", "RES102", "RES103"):
+        assert rule in analysis.RULES
+
+
+def test_metric_inventory_round_trips_exactly():
+    # MET101 and MET102 both clean means the emitted inventory, the doc
+    # tables, and the dashboard reads are in exact agreement.
+    sites, consumers, rows = metric_lint.build_inventory(str(_PKG))
+    fs = metric_lint.cross_check(sites, rows, consumers)
+    msgs = "\n".join(f.format() for f in fs)
+    assert [f for f in fs if f.rule in ("MET101", "MET102")] == [], msgs
